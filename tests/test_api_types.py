@@ -1,0 +1,269 @@
+"""API layer tests: types round-trip, defaults, validation, TPU topology.
+
+Test strategy per SURVEY.md §4: the reference has zero tests; unit tests of the
+schema/defaulting/validation layer are level (1) of the pyramid.
+"""
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.defaults import set_defaults
+from trainingjob_operator_tpu.api.tpu import (
+    chips_in_topology,
+    mesh_axes_for,
+    parse_topology,
+    resolve_slice_shape,
+    total_hosts,
+)
+from trainingjob_operator_tpu.api.types import (
+    CleanPodPolicy,
+    EdlPolicy,
+    EndingPolicy,
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUSpec,
+    TPUTrainingJob,
+    TrainingJobPhase,
+    is_failed_phase,
+)
+from trainingjob_operator_tpu.api.validation import validate_job, validate_job_or_raise, ValidationError
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+
+# A manifest in the reference's shape (example/paddle-mnist.yaml), retargeted.
+MNIST_YAML = """
+apiVersion: "tpu.trainingjob.dev/v1"
+kind: "TPUTrainingJob"
+metadata:
+  name: "paddle-mnist"
+spec:
+  cleanPodPolicy: All
+  restartingExitCode: 137,128
+  replicaSpecs:
+    trainer:
+      replicas: 1
+      completePolicy: All
+      failPolicy: Rank0
+      restartLimit: 1
+      restartPolicy: OnNodeFailWithExitCode
+      template:
+        spec:
+          hostNetwork: true
+          restartPolicy: Never
+          containers:
+            - name: "aitj-trainer"
+              image: "example/mnist"
+              ports:
+                - name: "aitj-24446"
+                  containerPort: 24446
+              command: ["/bin/bash"]
+              args: ["-c", "python train.py"]
+"""
+
+
+def make_job(name="job", replicas=2, **spec_kw) -> TPUTrainingJob:
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.spec.replica_specs["trainer"] = ReplicaSpec(
+        replicas=replicas,
+        template=PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="aitj-main", image="img",
+                      ports=[ContainerPort(name="aitj-2222", container_port=2222)])
+        ])),
+        **spec_kw,
+    )
+    return job
+
+
+class TestYamlRoundTrip:
+    def test_parse_reference_shaped_manifest(self):
+        job = TPUTrainingJob.from_yaml(MNIST_YAML)
+        assert job.name == "paddle-mnist"
+        assert job.spec.clean_pod_policy == CleanPodPolicy.ALL
+        assert job.spec.restarting_exit_code == "137,128"
+        trainer = job.spec.replica_specs["trainer"]
+        assert trainer.replicas == 1
+        assert trainer.fail_policy == EndingPolicy.RANK0
+        assert trainer.restart_limit == 1
+        assert trainer.restart_policy == RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE
+        assert trainer.template.spec.host_network is True
+        c = trainer.template.spec.containers[0]
+        assert c.name == "aitj-trainer"
+        assert c.ports[0].container_port == 24446
+
+    def test_round_trip_preserves_spec(self):
+        job = TPUTrainingJob.from_yaml(MNIST_YAML)
+        job2 = TPUTrainingJob.from_yaml(job.to_yaml())
+        assert job2.to_dict() == job.to_dict()
+
+    def test_accepts_reference_kind_spelling(self):
+        job = TPUTrainingJob.from_dict(
+            {"kind": "AITrainingJob", "metadata": {"name": "x"}, "spec": {}})
+        assert job.name == "x"
+
+    def test_rejects_unknown_kind(self):
+        with pytest.raises(ValueError):
+            TPUTrainingJob.from_dict({"kind": "Deployment", "metadata": {"name": "x"}})
+
+    def test_status_round_trip(self):
+        job = make_job()
+        job.status.phase = TrainingJobPhase.RUNNING
+        job.status.restart_counts["trainer"] = 3
+        job.status.start_time = 1000.0
+        d = job.to_dict()
+        job2 = TPUTrainingJob.from_dict(d)
+        assert job2.status.phase == TrainingJobPhase.RUNNING
+        assert job2.status.restart_counts == {"trainer": 3}
+        assert job2.status.start_time == 1000.0
+
+
+class TestDefaults:
+    def test_job_defaults(self):
+        # Reference: defaults.go:34-53.
+        job = make_job()
+        set_defaults(job)
+        assert job.spec.clean_pod_policy == CleanPodPolicy.ALL
+        assert job.spec.fail_policy == EndingPolicy.ANY
+        assert job.spec.complete_policy == EndingPolicy.ALL
+
+    def test_replica_defaults(self):
+        # Reference: defaults.go:15-31.
+        job = TPUTrainingJob(metadata=ObjectMeta(name="j"))
+        job.spec.replica_specs["w"] = ReplicaSpec(
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="c")])))
+        set_defaults(job)
+        w = job.spec.replica_specs["w"]
+        assert w.replicas == 1
+        assert w.restart_policy == RestartPolicy.NEVER
+        assert w.restart_scope == RestartScope.ALL
+        assert w.fail_policy == EndingPolicy.ANY
+        assert w.complete_policy == EndingPolicy.ALL
+        assert w.edl_policy == EdlPolicy.NEVER
+        assert w.min_replicas == 1 and w.max_replicas == 1
+
+    def test_defaults_do_not_override_explicit(self):
+        job = make_job(replicas=4, restart_policy=RestartPolicy.ALWAYS,
+                       restart_scope=RestartScope.POD,
+                       fail_policy=EndingPolicy.ALL,
+                       complete_policy=EndingPolicy.ANY,
+                       min_replicas=2, max_replicas=8,
+                       edl_policy=EdlPolicy.AUTO)
+        set_defaults(job)
+        t = job.spec.replica_specs["trainer"]
+        assert (t.replicas, t.min_replicas, t.max_replicas) == (4, 2, 8)
+        assert t.restart_policy == RestartPolicy.ALWAYS
+        assert t.restart_scope == RestartScope.POD
+        assert t.edl_policy == EdlPolicy.AUTO
+
+
+class TestValidation:
+    def test_valid_job_passes(self):
+        job = set_defaults(make_job())
+        assert validate_job(job) == []
+
+    def test_missing_name(self):
+        job = make_job(name="")
+        assert any("metadata.name" in e for e in validate_job(job))
+
+    def test_empty_replica_specs(self):
+        job = TPUTrainingJob(metadata=ObjectMeta(name="j"))
+        assert any("replicaSpecs" in e for e in validate_job(job))
+
+    def test_empty_containers_rejected(self):
+        # Reference intent: validation.go:17-19 (dead code there, real here).
+        job = TPUTrainingJob(metadata=ObjectMeta(name="j"))
+        job.spec.replica_specs["w"] = ReplicaSpec()
+        assert any("containers" in e for e in validate_job(job))
+
+    def test_image_required_mode(self):
+        # Reference intent: validation.go:20-25.
+        job = TPUTrainingJob(metadata=ObjectMeta(name="j"))
+        job.spec.replica_specs["w"] = ReplicaSpec(
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="c")])))
+        assert validate_job(job, require_image=False) == []
+        assert any("no image" in e for e in validate_job(job, require_image=True))
+
+    def test_bad_enums(self):
+        job = make_job(restart_policy="Sometimes")
+        job.spec.fail_policy = "Most"
+        errs = validate_job(job)
+        assert any("restartPolicy" in e for e in errs)
+        assert any("failPolicy" in e for e in errs)
+
+    def test_bad_exit_codes(self):
+        job = make_job()
+        job.spec.restarting_exit_code = "137,x"
+        assert any("restartingExitCode" in e for e in validate_job(job))
+
+    def test_min_max_consistency(self):
+        job = make_job(replicas=4, min_replicas=6, max_replicas=5)
+        errs = validate_job(job)
+        assert any("minReplicas > maxReplicas" in e for e in errs)
+
+    def test_raise_helper(self):
+        with pytest.raises(ValidationError):
+            validate_job_or_raise(TPUTrainingJob())
+
+    def test_bad_topology(self):
+        job = make_job()
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(topology="4xz")
+        assert any("topology" in e for e in validate_job(job))
+
+
+class TestPhases:
+    def test_ending_phase_classification(self):
+        # Reference: status.go:89-99 -- Succeeded is ending but not failed.
+        assert not is_failed_phase(TrainingJobPhase.SUCCEEDED)
+        assert is_failed_phase(TrainingJobPhase.FAILED)
+        assert is_failed_phase(TrainingJobPhase.TIMEOUT)
+        assert is_failed_phase(TrainingJobPhase.PREEMPTED)
+        assert is_failed_phase(TrainingJobPhase.NODE_FAIL)
+        assert not is_failed_phase(TrainingJobPhase.RUNNING)
+
+    def test_succeeded_spelling_matches_reference(self):
+        # Reference: types.go:111 spells the phase "Succeed".
+        assert TrainingJobPhase.SUCCEEDED == "Succeed"
+
+
+class TestTPUTopology:
+    def test_parse(self):
+        assert parse_topology("4x4") == (4, 4)
+        assert parse_topology("2x2x4") == (2, 2, 4)
+        with pytest.raises(ValueError):
+            parse_topology("4")
+        with pytest.raises(ValueError):
+            parse_topology("4x0")
+
+    def test_chips_and_hosts_v5e(self):
+        # v5e: 4 chips per TPU-VM host.
+        assert chips_in_topology("2x4") == 8
+        s = resolve_slice_shape(TPUSpec(accelerator="tpu-v5-lite-podslice", topology="4x4"))
+        assert s.chips == 16 and s.hosts == 4 and s.chips_per_host == 4
+        s32 = resolve_slice_shape(TPUSpec(topology="4x8"))
+        assert s32.chips == 32 and s32.hosts == 8
+
+    def test_single_host_slice(self):
+        s = resolve_slice_shape(TPUSpec(topology="2x2"))
+        assert s.hosts == 1 and s.chips == 4
+
+    def test_total_hosts_multislice(self):
+        tpu = TPUSpec(topology="4x4", slice_count=4)
+        assert total_hosts(tpu) == 16
+
+    def test_node_selectors(self):
+        s = resolve_slice_shape(TPUSpec(accelerator="tpu-v5-lite-podslice",
+                                        topology="2x4"))
+        sel = s.node_selectors(preemptible=True)
+        assert sel[constants.GKE_TPU_ACCELERATOR_SELECTOR] == "tpu-v5-lite-podslice"
+        assert sel[constants.GKE_TPU_TOPOLOGY_SELECTOR] == "2x4"
+        assert sel[constants.GKE_SPOT_SELECTOR] == "true"
+        assert s.tpu_resources() == {constants.TPU_RESOURCE: 4}
+
+    def test_mesh_axes(self):
+        axes = mesh_axes_for(TPUSpec(topology="4x4", slice_count=2))
+        assert axes == [("slice", 2), ("host", 4), ("chip", 4)]

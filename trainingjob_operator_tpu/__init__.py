@@ -1,0 +1,21 @@
+"""tpu-trainingjob: a TPU-native elastic training-job framework.
+
+Built from scratch with the capabilities of
+``elasticdeeplearning/trainingjob-operator`` (reference layout: ``cmd/``,
+``pkg/apis/aitrainingjob``, ``pkg/controller``, ``pkg/client``, ``pkg/signals``),
+re-designed TPU-first:
+
+- ``api``        -- the ``TPUTrainingJob`` resource model (reference: pkg/apis/).
+- ``core``       -- the minimal pod/service/node object model the control plane
+                    reconciles over (reference: k8s.io/api/core/v1 subset).
+- ``client``     -- object tracker, typed clients, informers, listers, workqueue,
+                    expectations (reference: pkg/client/ + client-go machinery).
+- ``controller`` -- the reconcile engine / fault-tolerance state machine
+                    (reference: pkg/controller/).
+- ``runtime``    -- cluster backends: in-memory sim, local subprocess, gated k8s.
+- ``workloads``  -- JAX/XLA training entrypoints exercised by the operator.
+- ``parallel``   -- mesh/sharding/collective layer (dp/fsdp/tp/sp, ring attention).
+- ``ops``        -- TPU kernels (Pallas) with XLA fallbacks.
+"""
+
+__version__ = "0.1.0"

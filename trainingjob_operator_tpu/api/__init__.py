@@ -1,0 +1,46 @@
+"""The ``TPUTrainingJob`` resource model.
+
+Reference: ``pkg/apis/aitrainingjob/`` -- same spec/status/phase/policy surface,
+extended with first-class TPU fields (accelerator/topology/slice semantics) and
+*implemented* min/max elasticity (the reference declares MinReplicas/MaxReplicas
+and EdlPolicy but never consumes them; see SURVEY.md §2.6).
+"""
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    CleanPodPolicy,
+    EdlPolicy,
+    EndingPolicy,
+    ReplicaSpec,
+    ReplicaStatus,
+    RestartPolicy,
+    RestartScope,
+    TPUSpec,
+    TPUTrainingJob,
+    TrainingJobCondition,
+    TrainingJobPhase,
+    TrainingJobSpec,
+    TrainingJobStatus,
+)
+from trainingjob_operator_tpu.api.defaults import set_defaults
+from trainingjob_operator_tpu.api.validation import ValidationError, validate_job
+
+__all__ = [
+    "constants",
+    "CleanPodPolicy",
+    "EdlPolicy",
+    "EndingPolicy",
+    "ReplicaSpec",
+    "ReplicaStatus",
+    "RestartPolicy",
+    "RestartScope",
+    "TPUSpec",
+    "TPUTrainingJob",
+    "TrainingJobCondition",
+    "TrainingJobPhase",
+    "TrainingJobSpec",
+    "TrainingJobStatus",
+    "set_defaults",
+    "ValidationError",
+    "validate_job",
+]

@@ -1,0 +1,94 @@
+"""API group constants, labels, env-var names, and event reasons.
+
+Reference: pkg/apis/aitrainingjob/v1/constants.go and register.go.  Group and
+kind names are TPU-native; the label/env contract keeps the reference's shape
+(TRAININGJOB_* identity env, {RT}_INSTANCES/PORTS/HOSTS rendezvous env) and
+adds the TPU/JAX bootstrap set.
+"""
+
+# --- group/version/kind (reference: v1/register.go:27-33) -------------------
+GROUP_NAME = "tpu.trainingjob.dev"
+GROUP_VERSION = "v1"
+KIND = "TPUTrainingJob"
+KIND_PLURAL = "tputrainingjobs"
+SHORT_NAME = "tpujob"
+API_VERSION = f"{GROUP_NAME}/{GROUP_VERSION}"
+
+CONTROLLER_NAME = "TPUTrainingJobOperator"
+
+# --- labels (reference: constants.go:3-11) ----------------------------------
+REPLICA_NAME_LABEL = "TrainingJobReplicaName"
+REPLICA_INDEX_LABEL = "TrainingJobReplicaIndex"
+JOB_NAME_LABEL = "TrainingJobName"
+FRAMEWORK_LABEL = "FrameworkType"
+GROUP_NAME_LABEL = "GroupName"
+PRIORITY_LABEL = "priority"
+RESTART_COUNT_LABEL = "RestartCount"
+POD_ROLE_LABEL = "PodRole"
+# TPU extensions
+SLICE_ID_LABEL = "TPUSliceID"
+GANG_LABEL = "TPUGang"
+
+# --- identity env vars injected into every container
+# (reference: constants.go:13-21, pkg/controller/pod.go:600-628) -------------
+REPLICA_NAME_ENV = "TRAININGJOB_REPLICA_NAME"
+REPLICA_INDEX_ENV = "TRAININGJOB_REPLICA_INDEX"
+REPLICA_RESTART_COUNT_ENV = "TRAININGJOB_REPLICA_RESTARTCOUNT"
+JOB_NAME_ENV = "TRAININGJOB_NAME"
+JOB_NAMESPACE_ENV = "TRAININGJOB_NAMESPACE"
+SERVICE_ENV = "TRAININGJOB_SERVICE"
+PORTS_ENV = "TRAININGJOB_PORTS"
+# TPU/JAX bootstrap env (new; the TPU-native "communication backend" contract:
+# SURVEY.md §5.8 -- worker identity + coordinator address for
+# jax.distributed.initialize, slice topology for mesh construction)
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+TPU_WORKER_HOSTNAMES_ENV = "TPU_WORKER_HOSTNAMES"
+TPU_ACCELERATOR_ENV = "TRAININGJOB_TPU_ACCELERATOR"
+TPU_TOPOLOGY_ENV = "TRAININGJOB_TPU_TOPOLOGY"
+COORDINATOR_ADDRESS_ENV = "TRAININGJOB_COORDINATOR_ADDRESS"
+NUM_PROCESSES_ENV = "TRAININGJOB_NUM_PROCESSES"
+PROCESS_ID_ENV = "TRAININGJOB_PROCESS_ID"
+SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
+NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
+MEGASCALE_COORDINATOR_ENV = "MEGASCALE_COORDINATOR_ADDRESS"
+CHECKPOINT_DIR_ENV = "TRAININGJOB_CHECKPOINT_DIR"
+ELASTIC_REPLICAS_ENV = "TRAININGJOB_ELASTIC_REPLICAS"
+
+# --- GKE TPU node selectors / resources (north star: BASELINE.json) ---------
+GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+GKE_SPOT_SELECTOR = "cloud.google.com/gke-spot"
+TPU_RESOURCE = "google.com/tpu"
+
+# --- container/port name convention (reference: constants.go:41-44) ---------
+CONTAINER_PREFIX = "aitj-"
+PORT_PREFIX = "aitj-"
+DEFAULT_COORDINATOR_PORT = 8476
+
+# --- event reasons (reference: constants.go:23-39) --------------------------
+POD_TEMPLATE_RESTART_POLICY_REASON = "SettedPodTemplateRestartPolicy"
+EXITED_WITH_CODE_REASON = "ExitedWithCode"
+
+PENDING_REASON = "TrainingJobPending"
+CREATING_REASON = "TrainingJobCreating"
+RUNNING_REASON = "TrainingJobRunning"
+SUCCEEDED_REASON = "TrainingJobSucceed"
+FAILED_REASON = "TrainingJobFailed"
+TIMEOUT_REASON = "TrainingJobTimeout"
+RESTARTING_REASON = "TrainingJobRestarting"
+TERMINATING_REASON = "TrainingJobTerminating"
+PREEMPTED_REASON = "TrainingJobPreempted"
+NODE_FAIL_REASON = "TrainingJobNodeFail"
+SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
+
+# --- fatal container-waiting reasons (reference: constants.go:46-56) --------
+ERROR_CONTAINER_STATUS = (
+    "CreateContainerConfigError",
+    "CreateContainerError",
+    "ImagePullBackOff",
+    "ImageInspectError",
+    "ErrImagePull",
+    "ErrImageNeverPull",
+    "RegistryUnavailable",
+    "InvalidImageName",
+)

@@ -1,0 +1,55 @@
+"""Defaulting for TPUTrainingJob.
+
+Reference: pkg/apis/aitrainingjob/v1/defaults.go:15-53, applied at sync time
+(controller.go:297).  Same defaults, plus elastic and TPU defaults.
+"""
+
+from __future__ import annotations
+
+from trainingjob_operator_tpu.api.types import (
+    CleanPodPolicy,
+    EndingPolicy,
+    EdlPolicy,
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUTrainingJob,
+)
+
+
+def set_default_replica(spec: ReplicaSpec) -> None:
+    """Reference: defaults.go:15-31."""
+    if spec.replicas is None:
+        spec.replicas = 1
+    if not spec.restart_policy:
+        spec.restart_policy = RestartPolicy.NEVER
+    if not spec.restart_scope:
+        spec.restart_scope = RestartScope.ALL
+    if not spec.fail_policy:
+        spec.fail_policy = EndingPolicy.ANY
+    if not spec.complete_policy:
+        spec.complete_policy = EndingPolicy.ALL
+    # Elastic defaults (new): min/max default to the fixed width; edl policy
+    # defaults to Never so behavior matches the reference unless opted in.
+    if spec.min_replicas is None:
+        spec.min_replicas = spec.replicas
+    if spec.max_replicas is None:
+        spec.max_replicas = max(spec.replicas, spec.min_replicas)
+    if not spec.edl_policy:
+        spec.edl_policy = EdlPolicy.NEVER
+    if spec.tpu is not None and spec.tpu.slice_count < 1:
+        spec.tpu.slice_count = 1
+
+
+def set_defaults(job: TPUTrainingJob) -> TPUTrainingJob:
+    """Reference: SetDefaults_AITrainingJob, defaults.go:34-53.  Mutates and
+    returns the job."""
+    if job.spec.clean_pod_policy is None:
+        job.spec.clean_pod_policy = CleanPodPolicy.ALL
+    if not job.spec.fail_policy:
+        job.spec.fail_policy = EndingPolicy.ANY
+    if not job.spec.complete_policy:
+        job.spec.complete_policy = EndingPolicy.ALL
+    for spec in job.spec.replica_specs.values():
+        set_default_replica(spec)
+    return job

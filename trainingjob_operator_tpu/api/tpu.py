@@ -1,0 +1,94 @@
+"""TPU accelerator/topology model.
+
+Maps a ``TPUSpec`` (accelerator + topology + slice count) to concrete
+provisioning facts: chips per slice, hosts per slice, GKE nodeSelectors and
+``google.com/tpu`` resource counts.  This is the TPU-native replacement for the
+reference's implicit "a replica is one generic pod" assumption
+(reference: pkg/controller/pod.go:186-193 creates one pod per index; here an
+index maps to one TPU-VM *host* of a slice, and a replica group maps to
+``slice_count`` gang-scheduled slices).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import TPUSpec
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """'4x4' -> (4, 4); '2x2x4' -> (2, 2, 4)."""
+    try:
+        dims = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"invalid TPU topology {topology!r}") from e
+    if len(dims) not in (2, 3) or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid TPU topology {topology!r}")
+    return dims
+
+
+def chips_in_topology(topology: str) -> int:
+    return math.prod(parse_topology(topology))
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Resolved provisioning facts for one slice of a replica group."""
+
+    accelerator: str
+    topology: str
+    chips: int
+    hosts: int            # pods (TPU-VM hosts) per slice
+    chips_per_host: int
+
+    def node_selectors(self, preemptible: bool = False) -> Dict[str, str]:
+        sel = {
+            constants.GKE_TPU_ACCELERATOR_SELECTOR: self.accelerator,
+            constants.GKE_TPU_TOPOLOGY_SELECTOR: self.topology,
+        }
+        if preemptible:
+            sel[constants.GKE_SPOT_SELECTOR] = "true"
+        return sel
+
+    def tpu_resources(self) -> Dict[str, int]:
+        return {constants.TPU_RESOURCE: self.chips_per_host}
+
+
+def resolve_slice_shape(tpu: TPUSpec) -> SliceShape:
+    """Compute hosts-per-slice from topology and chips/host.
+
+    v5e examples: topology 2x4 = 8 chips = 2 hosts; 4x4 = 16 chips = 4 hosts;
+    4x8 = 32 chips = 8 hosts (4 chips per TPU-VM host).
+    """
+    if not tpu.topology:
+        raise ValueError("TPUSpec.topology is required to resolve a slice shape")
+    chips = chips_in_topology(tpu.topology)
+    cph = max(1, tpu.chips_per_host)
+    hosts = max(1, math.ceil(chips / cph))
+    return SliceShape(
+        accelerator=tpu.accelerator or "tpu-v5-lite-podslice",
+        topology=tpu.topology,
+        chips=chips,
+        hosts=hosts,
+        chips_per_host=min(cph, chips),
+    )
+
+
+def total_hosts(tpu: TPUSpec) -> int:
+    """Total pods for the replica group: hosts/slice x slice_count."""
+    return resolve_slice_shape(tpu).hosts * max(1, tpu.slice_count)
+
+
+def mesh_axes_for(tpu: TPUSpec) -> List[Tuple[str, int]]:
+    """Suggested workload mesh: DCN data-parallel across slices, ICI within.
+
+    The operator provisions topology; the workload layer turns this into a
+    ``jax.sharding.Mesh`` (parallel/mesh.py).  Returned as (axis, size) pairs:
+    [("slice", slice_count), ("host", hosts), ("chip", chips_per_host)].
+    """
+    shape = resolve_slice_shape(tpu)
+    return [("slice", max(1, tpu.slice_count)), ("host", shape.hosts),
+            ("chip", shape.chips_per_host)]

@@ -1,0 +1,471 @@
+"""TPUTrainingJob API types: spec, status, phases, policies.
+
+Reference: pkg/apis/aitrainingjob/v1/types.go + replica.go + framework.go.
+Same field surface and enum spellings, with TPU-first extensions:
+
+- ``TPUSpec`` per replica group (accelerator/topology/slice semantics) that the
+  controller turns into GKE nodeSelectors, ``google.com/tpu`` resources and
+  JAX/TPU env injection.
+- ``min_replicas``/``max_replicas``/``edl_policy`` carry *implemented* elastic
+  semantics (the reference declares them but never consumes them,
+  zz_generated.deepcopy.go:90-96 is their only use; SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.core.objects import (
+    Condition,
+    ObjectMeta,
+    PodTemplateSpec,
+    from_iso,
+    iso,
+)
+
+
+# ---------------------------------------------------------------------------
+# Enums (string constants; spellings match reference types.go / replica.go)
+# ---------------------------------------------------------------------------
+
+class TrainingJobPhase:
+    """Reference: v1/types.go:100-124 (10 phases, incl. the "" None phase)."""
+
+    NONE = ""
+    PENDING = "Pending"
+    CREATING = "Creating"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeed"  # sic -- reference spells the phase "Succeed"
+    FAILED = "Failed"
+    TIMEOUT = "Timeout"
+    RESTARTING = "Restarting"
+    TERMINATING = "Terminating"
+    PREEMPTED = "Preempted"
+    NODE_FAIL = "NodeFail"
+    # TPU extension: elastic resize in progress (treated as a live phase).
+    SCALING = "Scaling"
+
+
+#: Phases that end a job (reference: constants.go:58-64).
+ENDING_PHASES = (
+    TrainingJobPhase.SUCCEEDED,
+    TrainingJobPhase.FAILED,
+    TrainingJobPhase.TIMEOUT,
+    TrainingJobPhase.PREEMPTED,
+    TrainingJobPhase.NODE_FAIL,
+)
+
+#: Phases in which the reconcile loop runs (reference: controller.go:298-304).
+RECONCILABLE_PHASES = (
+    TrainingJobPhase.NONE,
+    TrainingJobPhase.PENDING,
+    TrainingJobPhase.CREATING,
+    TrainingJobPhase.RUNNING,
+    TrainingJobPhase.RESTARTING,
+    TrainingJobPhase.TERMINATING,
+    TrainingJobPhase.SCALING,
+)
+
+#: phase -> condition reason (reference: constants.go:65-77).
+PHASE_REASON = {
+    TrainingJobPhase.NONE: "",
+    TrainingJobPhase.PENDING: constants.PENDING_REASON,
+    TrainingJobPhase.CREATING: constants.CREATING_REASON,
+    TrainingJobPhase.RUNNING: constants.RUNNING_REASON,
+    TrainingJobPhase.SUCCEEDED: constants.SUCCEEDED_REASON,
+    TrainingJobPhase.FAILED: constants.FAILED_REASON,
+    TrainingJobPhase.TIMEOUT: constants.TIMEOUT_REASON,
+    TrainingJobPhase.RESTARTING: constants.RESTARTING_REASON,
+    TrainingJobPhase.TERMINATING: constants.TERMINATING_REASON,
+    TrainingJobPhase.PREEMPTED: constants.PREEMPTED_REASON,
+    TrainingJobPhase.NODE_FAIL: constants.NODE_FAIL_REASON,
+    TrainingJobPhase.SCALING: constants.SCALING_REASON,
+}
+
+
+class RestartPolicy:
+    """Reference: v1/replica.go:25-30 (6 values)."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    ON_NODE_FAIL = "OnNodeFail"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+    ON_NODE_FAIL_WITH_EXIT_CODE = "OnNodeFailWithExitCode"
+
+    ALL = (ALWAYS, ON_FAILURE, ON_NODE_FAIL, NEVER, EXIT_CODE,
+           ON_NODE_FAIL_WITH_EXIT_CODE)
+
+
+class RestartScope:
+    """Reference: v1/replica.go:31-33."""
+
+    ALL = "All"
+    REPLICA = "Replica"
+    POD = "Pod"
+
+    VALUES = (ALL, REPLICA, POD)
+
+
+class EndingPolicy:
+    """Reference: v1/replica.go:57-63."""
+
+    ALL = "All"
+    RANK0 = "Rank0"
+    ANY = "Any"
+    NONE = "None"
+
+    VALUES = (ALL, RANK0, ANY, NONE)
+
+
+class EdlPolicy:
+    """Reference: v1/replica.go:51-56.  Implemented here (elastic resize),
+    unlike the reference where the field is dead (SURVEY.md §2.6)."""
+
+    AUTO = "Auto"
+    MANUAL = "Manual"
+    NEVER = "Never"
+
+    VALUES = (AUTO, MANUAL, NEVER)
+
+
+class CleanPodPolicy:
+    """Reference: v1/types.go:67-72."""
+
+    ALL = "All"
+    NONE = "None"
+
+    VALUES = (ALL, NONE)
+
+
+# ---------------------------------------------------------------------------
+# TPU extension spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TPUSpec:
+    """First-class TPU fields for a replica group (north star: BASELINE.json).
+
+    A replica group with a ``TPUSpec`` is provisioned as TPU pod-slices: one pod
+    per TPU-VM host, ``slice_count`` slices, gang-scheduled per slice, with GKE
+    ``cloud.google.com/gke-tpu-*`` nodeSelectors and JAX/TPU env injection.
+    """
+
+    accelerator: str = ""          # e.g. "tpu-v5-lite-podslice" / "tpu-v5e"
+    topology: str = ""             # e.g. "2x4", "4x4", "4x8"
+    slice_count: int = 1           # number of slices (multislice data-parallel)
+    chips_per_host: int = 4        # v5e TPU-VM host = 4 chips
+    preemptible: bool = False      # spot/preemptible capacity
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.accelerator:
+            d["accelerator"] = self.accelerator
+        if self.topology:
+            d["topology"] = self.topology
+        if self.slice_count != 1:
+            d["sliceCount"] = self.slice_count
+        if self.chips_per_host != 4:
+            d["chipsPerHost"] = self.chips_per_host
+        if self.preemptible:
+            d["preemptible"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUSpec":
+        return cls(
+            accelerator=d.get("accelerator", ""),
+            topology=d.get("topology", ""),
+            slice_count=int(d.get("sliceCount", 1)),
+            chips_per_host=int(d.get("chipsPerHost", 4)),
+            preemptible=bool(d.get("preemptible", False)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSpec / ReplicaStatus
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ReplicaSpec:
+    """Reference: v1/replica.go:9-20."""
+
+    replicas: Optional[int] = None
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    restart_limit: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: str = ""
+    restart_scope: str = ""
+    fail_policy: str = ""
+    complete_policy: str = ""
+    edl_policy: str = ""
+    tpu: Optional[TPUSpec] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.min_replicas is not None:
+            d["minReplicas"] = self.min_replicas
+        if self.max_replicas is not None:
+            d["maxReplicas"] = self.max_replicas
+        if self.restart_limit is not None:
+            d["restartLimit"] = self.restart_limit
+        d["template"] = self.template.to_dict()
+        if self.restart_policy:
+            d["restartPolicy"] = self.restart_policy
+        if self.restart_scope:
+            d["restartScope"] = self.restart_scope
+        if self.fail_policy:
+            d["failPolicy"] = self.fail_policy
+        if self.complete_policy:
+            d["completePolicy"] = self.complete_policy
+        if self.edl_policy:
+            d["edlPolicy"] = self.edl_policy
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaSpec":
+        return cls(
+            replicas=_opt_int(d.get("replicas")),
+            min_replicas=_opt_int(d.get("minReplicas")),
+            max_replicas=_opt_int(d.get("maxReplicas")),
+            restart_limit=_opt_int(d.get("restartLimit")),
+            template=PodTemplateSpec.from_dict(d.get("template") or {}),
+            restart_policy=d.get("restartPolicy", ""),
+            restart_scope=d.get("restartScope", ""),
+            fail_policy=d.get("failPolicy", ""),
+            complete_policy=d.get("completePolicy", ""),
+            edl_policy=d.get("edlPolicy", ""),
+            tpu=TPUSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+        )
+
+
+@dataclass
+class ReplicaStatus:
+    """Reference: v1/replica.go:36-49 (6 counters)."""
+
+    pending: int = 0
+    scheduled: int = 0
+    active: int = 0
+    succeeded: int = 0
+    restarting: int = 0
+    failed: int = 0
+
+    def reset(self) -> None:
+        self.pending = self.scheduled = self.active = 0
+        self.succeeded = self.restarting = self.failed = 0
+
+    def total(self) -> int:
+        return (self.pending + self.scheduled + self.active + self.succeeded
+                + self.restarting + self.failed)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"pending": self.pending, "scheduled": self.scheduled,
+                "active": self.active, "succeeded": self.succeeded,
+                "restarting": self.restarting, "failed": self.failed}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaStatus":
+        return cls(
+            pending=int(d.get("pending", 0)),
+            scheduled=int(d.get("scheduled", 0)),
+            active=int(d.get("active", 0)),
+            succeeded=int(d.get("succeeded", 0)),
+            restarting=int(d.get("restarting", 0)),
+            failed=int(d.get("failed", 0)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Job spec / status / condition
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrainingJobSpec:
+    """Reference: v1/types.go:41-62."""
+
+    restarting_exit_code: str = ""          # e.g. "137,128"
+    framework_type: str = ""                # e.g. "jax", "paddle", "tensorflow"
+    fault_tolerant: bool = False
+    priority: str = ""
+    scheduler_name: str = ""
+    time_limit: Optional[int] = None        # seconds
+    clean_pod_policy: Optional[str] = None  # CleanPodPolicy
+    fail_policy: str = ""                   # EndingPolicy
+    complete_policy: str = ""               # EndingPolicy
+    replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.restarting_exit_code:
+            d["restartingExitCode"] = self.restarting_exit_code
+        if self.framework_type:
+            d["frameworkType"] = self.framework_type
+        if self.fault_tolerant:
+            d["faultTolerant"] = True
+        if self.priority:
+            d["priority"] = self.priority
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        if self.time_limit is not None:
+            d["timeLimit"] = self.time_limit
+        if self.clean_pod_policy is not None:
+            d["cleanPodPolicy"] = self.clean_pod_policy
+        if self.fail_policy:
+            d["failPolicy"] = self.fail_policy
+        if self.complete_policy:
+            d["completePolicy"] = self.complete_policy
+        d["replicaSpecs"] = {name: s.to_dict() for name, s in self.replica_specs.items()}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainingJobSpec":
+        return cls(
+            restarting_exit_code=str(d.get("restartingExitCode", "")),
+            framework_type=d.get("frameworkType", ""),
+            fault_tolerant=bool(d.get("faultTolerant", False)),
+            priority=d.get("priority", ""),
+            scheduler_name=d.get("schedulerName", ""),
+            time_limit=_opt_int(d.get("timeLimit")),
+            clean_pod_policy=d.get("cleanPodPolicy"),
+            fail_policy=d.get("failPolicy", ""),
+            complete_policy=d.get("completePolicy", ""),
+            replica_specs={name: ReplicaSpec.from_dict(s)
+                           for name, s in (d.get("replicaSpecs") or {}).items()},
+        )
+
+
+# The job condition reuses the shared Condition shape
+# (reference: v1/types.go:128-142).
+TrainingJobCondition = Condition
+
+
+@dataclass
+class TrainingJobStatus:
+    """Reference: v1/types.go:76-95 (with the json-tag quirks fixed,
+    SURVEY.md §8: RestartCountes typo'd tag, RestartReplicaName missing tag)."""
+
+    phase: str = TrainingJobPhase.NONE
+    conditions: List[Condition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    restart_counts: Dict[str, int] = field(default_factory=dict)
+    restart_replica_name: str = ""
+    start_time: Optional[float] = None
+    start_running_time: Optional[float] = None
+    end_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+    # TPU extension: current elastic width per replica group (replicas actually
+    # provisioned right now; differs from spec.replicas while degraded).
+    elastic_replicas: Dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"phase": self.phase}
+        if self.conditions:
+            d["conditions"] = [c.to_dict() for c in self.conditions]
+        if self.replica_statuses:
+            d["replicaStatuses"] = {n: s.to_dict() for n, s in self.replica_statuses.items()}
+        if self.restart_counts:
+            d["restartCounts"] = dict(self.restart_counts)
+        if self.restart_replica_name:
+            d["restartReplicaName"] = self.restart_replica_name
+        if self.start_time is not None:
+            d["startTime"] = iso(self.start_time)
+        if self.start_running_time is not None:
+            d["startRunningTime"] = iso(self.start_running_time)
+        if self.end_time is not None:
+            d["endTime"] = iso(self.end_time)
+        if self.last_reconcile_time is not None:
+            d["lastReconcileTime"] = iso(self.last_reconcile_time)
+        if self.elastic_replicas:
+            d["elasticReplicas"] = dict(self.elastic_replicas)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainingJobStatus":
+        return cls(
+            phase=d.get("phase", TrainingJobPhase.NONE),
+            conditions=[Condition.from_dict(c) for c in d.get("conditions") or []],
+            replica_statuses={n: ReplicaStatus.from_dict(s)
+                              for n, s in (d.get("replicaStatuses") or {}).items()},
+            restart_counts={n: int(v) for n, v in (d.get("restartCounts") or {}).items()},
+            restart_replica_name=d.get("restartReplicaName", ""),
+            start_time=from_iso(d.get("startTime")),
+            start_running_time=from_iso(d.get("startRunningTime")),
+            end_time=from_iso(d.get("endTime")),
+            last_reconcile_time=from_iso(d.get("lastReconcileTime")),
+            elastic_replicas={n: int(v) for n, v in (d.get("elasticReplicas") or {}).items()},
+        )
+
+
+@dataclass
+class TPUTrainingJob:
+    """The CR (reference: v1/types.go:29-38)."""
+
+    KIND = constants.KIND
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
+    status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def deepcopy(self) -> "TPUTrainingJob":
+        """Reference: zz_generated.deepcopy.go DeepCopy."""
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": constants.API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUTrainingJob":
+        api_version = d.get("apiVersion", constants.API_VERSION)
+        kind = d.get("kind", cls.KIND)
+        # Accept the reference's group/kind spelling for drop-in manifests.
+        accepted_kinds = (cls.KIND, "AITrainingJob")
+        if kind not in accepted_kinds:
+            raise ValueError(f"unexpected kind {kind!r}, want one of {accepted_kinds}")
+        del api_version  # any version accepted; schema is forward-compatible
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=TrainingJobSpec.from_dict(d.get("spec") or {}),
+            status=TrainingJobStatus.from_dict(d.get("status") or {}),
+        )
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "TPUTrainingJob":
+        import yaml
+
+        return cls.from_dict(yaml.safe_load(text))
+
+    def to_yaml(self) -> str:
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+
+def _opt_int(v: Any) -> Optional[int]:
+    return None if v is None else int(v)
+
+
+def is_failed_phase(phase: str) -> bool:
+    """An ending phase that is not Succeeded (reference: status.go:89-99)."""
+    return phase in ENDING_PHASES and phase != TrainingJobPhase.SUCCEEDED

@@ -1,0 +1,676 @@
+"""Core object model: the pod/service/node/event subset the control plane needs.
+
+This is a from-scratch, Python-native equivalent of the slice of
+``k8s.io/api/core/v1`` consumed by the reference controller
+(reference: pkg/controller/pod.go, service.go, garbage_collection.go).  Objects
+are mutable dataclasses; the object tracker (client/tracker.py) stores deep
+copies and hands out deep copies, so holding a reference to an object never
+aliases the "cluster" state -- the same discipline the k8s informer cache
+enforces by convention.
+
+Times are ``float`` POSIX timestamps (``now()``); serialization renders them
+ISO-8601.  Every object serializes to/from plain dicts with camelCase keys so
+YAML manifests look like the reference's (reference: example/paddle-mnist.yaml).
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime as _dt
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def now() -> float:
+    """Current time as a POSIX timestamp."""
+    return time.time()
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def iso(ts: Optional[float]) -> Optional[str]:
+    if ts is None:
+        return None
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc).isoformat()
+
+
+def from_iso(s: Optional[str]) -> Optional[float]:
+    if s is None:
+        return None
+    if isinstance(s, (int, float)):
+        return float(s)
+    return _dt.datetime.fromisoformat(s).timestamp()
+
+
+# ---------------------------------------------------------------------------
+# Enums (string constants, matching corev1 spellings)
+# ---------------------------------------------------------------------------
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+class ConditionStatus:
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+class NodeConditionType:
+    READY = "Ready"
+
+
+class PodConditionType:
+    SCHEDULED = "PodScheduled"
+    READY = "Ready"
+
+
+class RestartPolicy:
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OwnerReference:
+    """Reference: metav1.OwnerReference as built by GenOwnerReference
+    (reference: pkg/controller/controller.go:161-173)."""
+
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "name": self.name,
+            "uid": self.uid,
+            "controller": self.controller,
+            "blockOwnerDeletion": self.block_owner_deletion,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OwnerReference":
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=bool(d.get("controller", False)),
+            block_owner_deletion=bool(d.get("blockOwnerDeletion", False)),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generate_name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+    deletion_grace_period_seconds: Optional[int] = None
+
+    def controller_of(self) -> Optional[OwnerReference]:
+        """metav1.GetControllerOf equivalent."""
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            d["uid"] = self.uid
+        if self.resource_version:
+            d["resourceVersion"] = str(self.resource_version)
+        if self.generate_name:
+            d["generateName"] = self.generate_name
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.owner_references:
+            d["ownerReferences"] = [r.to_dict() for r in self.owner_references]
+        if self.creation_timestamp is not None:
+            d["creationTimestamp"] = iso(self.creation_timestamp)
+        if self.deletion_timestamp is not None:
+            d["deletionTimestamp"] = iso(self.deletion_timestamp)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectMeta":
+        rv = d.get("resourceVersion", 0)
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=int(rv) if rv else 0,
+            generate_name=d.get("generateName", ""),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=[OwnerReference.from_dict(r) for r in d.get("ownerReferences") or []],
+            creation_timestamp=from_iso(d.get("creationTimestamp")),
+            deletion_timestamp=from_iso(d.get("deletionTimestamp")),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EnvVar":
+        return cls(name=d.get("name", ""), value=str(d.get("value", "")))
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "containerPort": self.container_port}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ContainerPort":
+        return cls(name=d.get("name", ""), container_port=int(d.get("containerPort", 0)))
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    working_dir: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name}
+        if self.image:
+            d["image"] = self.image
+        if self.command:
+            d["command"] = list(self.command)
+        if self.args:
+            d["args"] = list(self.args)
+        if self.env:
+            d["env"] = [e.to_dict() for e in self.env]
+        if self.ports:
+            d["ports"] = [p.to_dict() for p in self.ports]
+        if self.resources:
+            d["resources"] = copy.deepcopy(self.resources)
+        if self.working_dir:
+            d["workingDir"] = self.working_dir
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Container":
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            command=list(d.get("command") or []),
+            args=list(d.get("args") or []),
+            env=[EnvVar.from_dict(e) for e in d.get("env") or []],
+            ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
+            resources=copy.deepcopy(d.get("resources") or {}),
+            working_dir=d.get("workingDir", ""),
+        )
+
+
+@dataclass
+class ContainerState:
+    """One-of waiting/running/terminated, like corev1.ContainerState."""
+
+    waiting_reason: Optional[str] = None
+    waiting_message: Optional[str] = None
+    running_started_at: Optional[float] = None
+    terminated_exit_code: Optional[int] = None
+    terminated_reason: Optional[str] = None
+    terminated_message: Optional[str] = None
+
+    @property
+    def waiting(self) -> bool:
+        return self.waiting_reason is not None
+
+    @property
+    def running(self) -> bool:
+        return self.running_started_at is not None and self.terminated_exit_code is None
+
+    @property
+    def terminated(self) -> bool:
+        return self.terminated_exit_code is not None
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self.terminated:
+            return {"terminated": {"exitCode": self.terminated_exit_code,
+                                   "reason": self.terminated_reason or "",
+                                   "message": self.terminated_message or ""}}
+        if self.waiting:
+            return {"waiting": {"reason": self.waiting_reason,
+                                "message": self.waiting_message or ""}}
+        if self.running_started_at is not None:
+            return {"running": {"startedAt": iso(self.running_started_at)}}
+        return {}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ContainerState":
+        s = cls()
+        if "terminated" in d:
+            t = d["terminated"]
+            s.terminated_exit_code = int(t.get("exitCode", 0))
+            s.terminated_reason = t.get("reason")
+            s.terminated_message = t.get("message")
+        elif "waiting" in d:
+            s.waiting_reason = d["waiting"].get("reason", "")
+            s.waiting_message = d["waiting"].get("message")
+        elif "running" in d:
+            s.running_started_at = from_iso(d["running"].get("startedAt"))
+        return s
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: ContainerState = field(default_factory=ContainerState)
+    restart_count: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "state": self.state.to_dict(),
+                "restartCount": self.restart_count}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ContainerStatus":
+        return cls(
+            name=d.get("name", ""),
+            state=ContainerState.from_dict(d.get("state") or {}),
+            restart_count=int(d.get("restartCount", 0)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Conditions (shared shape for pods, nodes and jobs)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = ConditionStatus.TRUE
+    reason: str = ""
+    message: str = ""
+    last_probe_time: Optional[float] = None
+    last_transition_time: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastProbeTime": iso(self.last_probe_time),
+            "lastTransitionTime": iso(self.last_transition_time),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Condition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", ConditionStatus.TRUE),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_probe_time=from_iso(d.get("lastProbeTime")),
+            last_transition_time=from_iso(d.get("lastTransitionTime")),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    restart_policy: str = RestartPolicy.ALWAYS
+    scheduler_name: str = ""
+    host_network: bool = False
+    subdomain: str = ""
+    priority_class_name: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"containers": [c.to_dict() for c in self.containers]}
+        if self.init_containers:
+            d["initContainers"] = [c.to_dict() for c in self.init_containers]
+        if self.node_name:
+            d["nodeName"] = self.node_name
+        if self.node_selector:
+            d["nodeSelector"] = dict(self.node_selector)
+        if self.restart_policy:
+            d["restartPolicy"] = self.restart_policy
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        if self.host_network:
+            d["hostNetwork"] = True
+        if self.subdomain:
+            d["subdomain"] = self.subdomain
+        if self.priority_class_name:
+            d["priorityClassName"] = self.priority_class_name
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PodSpec":
+        return cls(
+            containers=[Container.from_dict(c) for c in d.get("containers") or []],
+            init_containers=[Container.from_dict(c) for c in d.get("initContainers") or []],
+            node_name=d.get("nodeName", ""),
+            node_selector=dict(d.get("nodeSelector") or {}),
+            restart_policy=d.get("restartPolicy", RestartPolicy.ALWAYS),
+            scheduler_name=d.get("schedulerName", ""),
+            host_network=bool(d.get("hostNetwork", False)),
+            subdomain=d.get("subdomain", ""),
+            priority_class_name=d.get("priorityClassName", ""),
+        )
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.PENDING
+    conditions: List[Condition] = field(default_factory=list)
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[float] = None
+    reason: str = ""
+    message: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"phase": self.phase}
+        if self.conditions:
+            d["conditions"] = [c.to_dict() for c in self.conditions]
+        if self.container_statuses:
+            d["containerStatuses"] = [c.to_dict() for c in self.container_statuses]
+        if self.start_time is not None:
+            d["startTime"] = iso(self.start_time)
+        if self.reason:
+            d["reason"] = self.reason
+        if self.message:
+            d["message"] = self.message
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PodStatus":
+        return cls(
+            phase=d.get("phase", PodPhase.PENDING),
+            conditions=[Condition.from_dict(c) for c in d.get("conditions") or []],
+            container_statuses=[ContainerStatus.from_dict(c)
+                                for c in d.get("containerStatuses") or []],
+            start_time=from_iso(d.get("startTime")),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+        )
+
+
+@dataclass
+class Pod:
+    KIND = "Pod"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "metadata": self.metadata.to_dict(),
+                "spec": self.spec.to_dict(), "status": self.status.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Pod":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+            status=PodStatus.from_dict(d.get("status") or {}),
+        )
+
+
+@dataclass
+class PodTemplateSpec:
+    """Reference: corev1.PodTemplateSpec used by ReplicaSpec.Template
+    (reference: pkg/apis/aitrainingjob/v1/replica.go:14)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metadata": self.metadata.to_dict(), "spec": self.spec.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PodTemplateSpec":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Service
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "port": self.port}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServicePort":
+        return cls(name=d.get("name", ""), port=int(d.get("port", 0)))
+
+
+@dataclass
+class ServiceSpec:
+    cluster_ip: str = ""  # "None" => headless (reference: service.go:180)
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"clusterIP": self.cluster_ip, "selector": dict(self.selector),
+                "ports": [p.to_dict() for p in self.ports]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServiceSpec":
+        return cls(
+            cluster_ip=d.get("clusterIP", ""),
+            selector=dict(d.get("selector") or {}),
+            ports=[ServicePort.from_dict(p) for p in d.get("ports") or []],
+        )
+
+
+@dataclass
+class Service:
+    KIND = "Service"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "metadata": self.metadata.to_dict(),
+                "spec": self.spec.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Service":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=ServiceSpec.from_dict(d.get("spec") or {}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NodeStatus:
+    conditions: List[Condition] = field(default_factory=list)
+    # TPU extension: capacity advertised by the node, e.g. {"google.com/tpu": 4}.
+    capacity: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"conditions": [c.to_dict() for c in self.conditions],
+                "capacity": copy.deepcopy(self.capacity)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NodeStatus":
+        return cls(
+            conditions=[Condition.from_dict(c) for c in d.get("conditions") or []],
+            capacity=copy.deepcopy(d.get("capacity") or {}),
+        )
+
+
+@dataclass
+class Node:
+    KIND = "Node"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def is_ready(self) -> bool:
+        """A node is Ready iff it has condition Ready=True
+        (reference: pkg/controller/pod.go:446-453)."""
+        for cond in self.status.conditions:
+            if cond.type == NodeConditionType.READY and cond.status == ConditionStatus.TRUE:
+                return True
+        return False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "metadata": self.metadata.to_dict(),
+                "status": self.status.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Node":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            status=NodeStatus.from_dict(d.get("status") or {}),
+        )
+
+
+def make_ready_node(name: str, ready: bool = True, labels: Optional[Dict[str, str]] = None,
+                    capacity: Optional[Dict[str, Any]] = None) -> Node:
+    """Convenience constructor used by the sim runtime and tests."""
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
+        status=NodeStatus(
+            conditions=[Condition(
+                type=NodeConditionType.READY,
+                status=ConditionStatus.TRUE if ready else ConditionStatus.FALSE,
+                last_transition_time=now(),
+            )],
+            capacity=dict(capacity or {}),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Event (observability; reference: client-go record.EventRecorder usage,
+# pkg/controller/controller.go:88-102)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Event:
+    KIND = "Event"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    source: str = ""
+    timestamp: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "involvedObject": {"kind": self.involved_kind, "name": self.involved_name,
+                               "namespace": self.involved_namespace},
+            "type": self.type,
+            "reason": self.reason,
+            "message": self.message,
+            "source": {"component": self.source},
+            "eventTime": iso(self.timestamp),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Event":
+        inv = d.get("involvedObject") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            involved_kind=inv.get("kind", ""),
+            involved_name=inv.get("name", ""),
+            involved_namespace=inv.get("namespace", ""),
+            type=d.get("type", "Normal"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            source=(d.get("source") or {}).get("component", ""),
+            timestamp=from_iso(d.get("eventTime")),
+        )
