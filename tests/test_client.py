@@ -1,0 +1,344 @@
+"""Client plumbing tests: tracker CRUD/watch/graceful-delete, informers,
+workqueue semantics, expectations."""
+
+import threading
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.client import (
+    AlreadyExistsError,
+    Clientset,
+    ConflictError,
+    ControllerExpectations,
+    InformerFactory,
+    NotFoundError,
+    ObjectTracker,
+    RateLimitingQueue,
+)
+from trainingjob_operator_tpu.client.expectations import pods_key
+from trainingjob_operator_tpu.client.tracker import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    meta_namespace_key,
+    split_meta_namespace_key,
+)
+from trainingjob_operator_tpu.core.objects import ObjectMeta, Pod
+
+
+def make_pod(name, namespace="default", labels=None) -> Pod:
+    return Pod(metadata=ObjectMeta(name=name, namespace=namespace,
+                                   labels=dict(labels or {})))
+
+
+class TestTracker:
+    def test_create_get_roundtrip_and_isolation(self):
+        t = ObjectTracker()
+        pod = make_pod("p1")
+        created = t.create(pod)
+        assert created.metadata.uid
+        assert created.metadata.resource_version == 1
+        # Mutating the returned object must not touch the store.
+        created.metadata.labels["x"] = "y"
+        assert t.get("Pod", "default", "p1").metadata.labels == {}
+
+    def test_create_duplicate(self):
+        t = ObjectTracker()
+        t.create(make_pod("p1"))
+        with pytest.raises(AlreadyExistsError):
+            t.create(make_pod("p1"))
+
+    def test_get_missing(self):
+        t = ObjectTracker()
+        with pytest.raises(NotFoundError):
+            t.get("Pod", "default", "nope")
+
+    def test_list_namespace_and_labels(self):
+        t = ObjectTracker()
+        t.create(make_pod("a", "ns1", {"role": "trainer"}))
+        t.create(make_pod("b", "ns1", {"role": "ps"}))
+        t.create(make_pod("c", "ns2", {"role": "trainer"}))
+        assert len(t.list("Pod")) == 3
+        assert len(t.list("Pod", "ns1")) == 2
+        assert [p.name for p in t.list("Pod", "ns1", {"role": "trainer"})] == ["a"]
+
+    def test_update_conflict_on_stale_version(self):
+        t = ObjectTracker()
+        t.create(make_pod("p"))
+        fresh = t.get("Pod", "default", "p")
+        stale = t.get("Pod", "default", "p")
+        fresh.metadata.labels["a"] = "1"
+        t.update(fresh)
+        stale.metadata.labels["b"] = "2"
+        with pytest.raises(ConflictError):
+            t.update(stale)
+
+    def test_watch_events(self):
+        t = ObjectTracker()
+        events = []
+        t.watch("Pod", lambda e: events.append((e.type, e.obj.name)))
+        t.create(make_pod("p"))
+        pod = t.get("Pod", "default", "p")
+        t.update(pod)
+        t.delete("Pod", "default", "p")
+        assert events == [(ADDED, "p"), (MODIFIED, "p"), (DELETED, "p")]
+
+    def test_graceful_delete_with_finalizer(self):
+        t = ObjectTracker()
+        seen = []
+        t.register_finalizer("Pod", lambda obj: seen.append(obj.name))
+        t.create(make_pod("p"))
+        t.delete("Pod", "default", "p", grace_period=30)
+        # Still present, marked terminating.
+        pod = t.get("Pod", "default", "p")
+        assert pod.metadata.deletion_timestamp is not None
+        assert seen == ["p"]
+        t.finalize_delete("Pod", "default", "p")
+        with pytest.raises(NotFoundError):
+            t.get("Pod", "default", "p")
+
+    def test_force_delete_bypasses_finalizer(self):
+        # Reference: forceDeletePod grace=0 (pod.go:469-481).
+        t = ObjectTracker()
+        t.register_finalizer("Pod", lambda obj: None)
+        t.create(make_pod("p"))
+        t.delete("Pod", "default", "p", grace_period=0)
+        with pytest.raises(NotFoundError):
+            t.get("Pod", "default", "p")
+
+    def test_keys(self):
+        pod = make_pod("n", "ns")
+        assert meta_namespace_key(pod) == "ns/n"
+        assert split_meta_namespace_key("ns/n") == ("ns", "n")
+        assert split_meta_namespace_key("n") == ("", "n")
+
+    def test_generate_name(self):
+        t = ObjectTracker()
+        pod = Pod(metadata=ObjectMeta(name="", generate_name="job-worker-",
+                                      namespace="default"))
+        created = t.create(pod)
+        assert created.name.startswith("job-worker-")
+
+
+class TestClientset:
+    def test_typed_clients_share_tracker(self):
+        cs = Clientset()
+        cs.pods.create(make_pod("p"))
+        assert cs.tracker.count("Pod") == 1
+        job = TPUTrainingJob(metadata=ObjectMeta(name="j"))
+        cs.trainingjobs.create(job)
+        got = cs.trainingjobs.get("default", "j")
+        got.status.phase = "Running"
+        cs.trainingjobs.update_status(got)
+        assert cs.trainingjobs.get("default", "j").status.phase == "Running"
+
+
+class TestInformers:
+    def test_handlers_fire(self):
+        cs = Clientset()
+        factory = InformerFactory(cs.tracker)
+        log = []
+        factory.informer("Pod").add_event_handler(
+            on_add=lambda o: log.append(("add", o.name)),
+            on_update=lambda old, new: log.append(("upd", new.name)),
+            on_delete=lambda o: log.append(("del", o.name)),
+        )
+        cs.pods.create(make_pod("p"))
+        pod = cs.pods.get("default", "p")
+        cs.pods.update(pod)
+        cs.pods.delete("default", "p")
+        assert log == [("add", "p"), ("upd", "p"), ("del", "p")]
+
+    def test_update_handler_sees_old_object(self):
+        cs = Clientset()
+        factory = InformerFactory(cs.tracker)
+        pairs = []
+        factory.informer("Pod").add_event_handler(
+            on_update=lambda old, new: pairs.append(
+                (old.metadata.labels.get("v"), new.metadata.labels.get("v"))))
+        cs.pods.create(make_pod("p", labels={"v": "1"}))
+        pod = cs.pods.get("default", "p")
+        pod.metadata.labels["v"] = "2"
+        cs.pods.update(pod)
+        assert pairs == [("1", "2")]
+
+    def test_resync_redelivers(self):
+        cs = Clientset()
+        factory = InformerFactory(cs.tracker)
+        cs.pods.create(make_pod("p"))
+        log = []
+        factory.informer("Pod").add_event_handler(
+            on_update=lambda old, new: log.append(new.name))
+        factory.resync_all()
+        assert log == ["p"]
+
+    def test_lister_reads_through(self):
+        cs = Clientset()
+        factory = InformerFactory(cs.tracker)
+        lister = factory.lister("Pod")
+        cs.pods.create(make_pod("p"))
+        assert lister.get("default", "p").name == "p"
+        assert lister.try_get("default", "gone") is None
+
+
+class TestWorkqueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        q.add("a")
+        assert len(q) == 1
+
+    def test_dirty_requeue_while_processing(self):
+        # Single-writer-per-key guarantee (SURVEY.md §5.2).
+        q = RateLimitingQueue()
+        q.add("a")
+        item, _ = q.get()
+        assert item == "a"
+        q.add("a")          # re-added while processing -> dirty
+        assert len(q) == 0  # not queued yet
+        q.done("a")
+        assert len(q) == 1  # requeued on done
+        item2, _ = q.get()
+        assert item2 == "a"
+
+    def test_add_after(self):
+        q = RateLimitingQueue()
+        q.add_after("a", 0.08)
+        assert len(q) == 0
+        item, _ = q.get(timeout=2.0)
+        assert item == "a"
+
+    def test_rate_limited_backoff_growth(self):
+        q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+        q.add_rate_limited("a")
+        assert q.num_requeues("a") == 1
+        item, _ = q.get(timeout=2.0)
+        assert item == "a"
+        q.done("a")
+        q.forget("a")
+        assert q.num_requeues("a") == 0
+
+    def test_shutdown_unblocks_get(self):
+        q = RateLimitingQueue()
+        result = {}
+
+        def consumer():
+            item, shutdown = q.get()
+            result["shutdown"] = shutdown
+
+        th = threading.Thread(target=consumer)
+        th.start()
+        time.sleep(0.05)
+        q.shut_down()
+        th.join(timeout=2)
+        assert result["shutdown"] is True
+
+    def test_get_timeout(self):
+        q = RateLimitingQueue()
+        item, shutdown = q.get(timeout=0.05)
+        assert item is None and shutdown is False
+
+
+class TestExpectations:
+    def test_satisfied_lifecycle(self):
+        e = ControllerExpectations()
+        key = pods_key("default/job", "trainer")
+        assert e.satisfied(key)  # never set
+        e.expect_creations(key, 2)
+        assert not e.satisfied(key)
+        e.creation_observed(key)
+        assert not e.satisfied(key)
+        e.creation_observed(key)
+        assert e.satisfied(key)
+
+    def test_deletions(self):
+        e = ControllerExpectations()
+        key = pods_key("default/job", "trainer")
+        e.expect_deletions(key, 1)
+        assert not e.satisfied(key)
+        e.deletion_observed(key)
+        assert e.satisfied(key)
+
+    def test_expiry(self, monkeypatch):
+        import trainingjob_operator_tpu.client.expectations as exp
+
+        e = ControllerExpectations()
+        key = "k"
+        e.expect_creations(key, 1)
+        assert not e.satisfied(key)
+        monkeypatch.setattr(exp, "EXPECTATION_TIMEOUT", 0.0)
+        time.sleep(0.01)
+        assert e.satisfied(key)
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_create_does_not_mutate_caller_generate_name(self):
+        t = ObjectTracker()
+        from trainingjob_operator_tpu.core.objects import ObjectMeta as OM, Pod as P
+        pod = P(metadata=OM(name="", generate_name="w-", namespace="default"))
+        a = t.create(pod)
+        b = t.create(pod)  # same caller object reused -> second generated name
+        assert pod.metadata.name == ""
+        assert a.name != b.name and a.name.startswith("w-") and b.name.startswith("w-")
+
+    def test_nodes_cluster_scoped(self):
+        from trainingjob_operator_tpu.core.objects import make_ready_node, Node, ObjectMeta as OM
+        cs = Clientset()
+        cs.nodes.create(Node(metadata=OM(name="n1")))  # default ns normalized
+        assert cs.nodes.get_node("n1").name == "n1"
+        assert len(cs.nodes.list()) == 1
+
+    def test_event_order_under_concurrent_writers(self):
+        import threading as th
+        t = ObjectTracker()
+        t.create(make_pod("p"))
+        versions = []
+        t.watch("Pod", lambda e: versions.append(e.obj.metadata.resource_version))
+
+        def writer():
+            for _ in range(50):
+                while True:
+                    pod = t.get("Pod", "default", "p")
+                    pod.metadata.labels["x"] = str(time.time())
+                    try:
+                        t.update(pod)
+                        break
+                    except ConflictError:
+                        continue
+
+        threads = [th.Thread(target=writer) for _ in range(4)]
+        [x.start() for x in threads]
+        [x.join() for x in threads]
+        assert versions == sorted(versions), "watch events delivered out of commit order"
+        assert len(versions) == 200
+
+
+class TestDefaultsElasticRange:
+    def test_range_only_spec_defaults_to_min(self):
+        from trainingjob_operator_tpu.api.types import TPUTrainingJob, ReplicaSpec
+        from trainingjob_operator_tpu.api.defaults import set_defaults
+        from trainingjob_operator_tpu.api.validation import validate_job
+        from trainingjob_operator_tpu.core.objects import (
+            Container, ObjectMeta as OM, PodSpec, PodTemplateSpec)
+        job = TPUTrainingJob(metadata=OM(name="j"))
+        job.spec.replica_specs["w"] = ReplicaSpec(
+            min_replicas=2, max_replicas=8,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="c")])))
+        set_defaults(job)
+        assert job.spec.replica_specs["w"].replicas == 2
+        assert validate_job(job) == []
+
+    def test_tpu_without_topology_rejected(self):
+        from trainingjob_operator_tpu.api.types import TPUSpec, TPUTrainingJob, ReplicaSpec
+        from trainingjob_operator_tpu.api.validation import validate_job
+        from trainingjob_operator_tpu.core.objects import (
+            Container, ObjectMeta as OM, PodSpec, PodTemplateSpec)
+        job = TPUTrainingJob(metadata=OM(name="j"))
+        job.spec.replica_specs["w"] = ReplicaSpec(
+            tpu=TPUSpec(accelerator="tpu-v5e"),
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="c")])))
+        assert any("topology: required" in e for e in validate_job(job))

@@ -20,7 +20,9 @@ from trainingjob_operator_tpu.api.types import (
 def set_default_replica(spec: ReplicaSpec) -> None:
     """Reference: defaults.go:15-31."""
     if spec.replicas is None:
-        spec.replicas = 1
+        # An elastic spec may give only a [min, max] range; start at min
+        # (reference defaults a missing Replicas to 1, defaults.go:16-18).
+        spec.replicas = spec.min_replicas if spec.min_replicas is not None else 1
     if not spec.restart_policy:
         spec.restart_policy = RestartPolicy.NEVER
     if not spec.restart_scope:

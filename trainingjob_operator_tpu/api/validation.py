@@ -92,7 +92,9 @@ def validate_job(job: TPUTrainingJob, require_image: bool = False) -> List[str]:
 
         if rspec.tpu is not None:
             tpu = rspec.tpu
-            if tpu.topology and not _valid_topology(tpu.topology):
+            if not tpu.topology:
+                errs.append(f"{prefix}.tpu.topology: required when tpu is set")
+            elif not _valid_topology(tpu.topology):
                 errs.append(f"{prefix}.tpu.topology: invalid topology {tpu.topology!r}")
             if tpu.slice_count < 1:
                 errs.append(f"{prefix}.tpu.sliceCount: must be >= 1")
@@ -116,8 +118,12 @@ def _is_int(s: str) -> bool:
 
 
 def _valid_topology(topology: str) -> bool:
-    """Topologies are 'AxB' or 'AxBxC' with positive integer extents."""
-    parts = topology.lower().split("x")
-    if len(parts) not in (2, 3):
+    """Valid iff the resolver's grammar accepts it (single source of truth:
+    api/tpu.py parse_topology)."""
+    from trainingjob_operator_tpu.api.tpu import parse_topology
+
+    try:
+        parse_topology(topology)
+        return True
+    except ValueError:
         return False
-    return all(_is_int(p) and int(p) > 0 for p in parts)

@@ -1,0 +1,38 @@
+"""Client plumbing: object tracker, typed clients, informers, listers,
+workqueue, expectations.
+
+Reference: ``pkg/client/`` (generated clientset/informers/listers) plus the
+client-go machinery the controller imports (workqueue, expectations --
+SURVEY.md §1 "external load-bearing dependencies").  Here the cluster store is
+an in-process object tracker with watch semantics -- the same design as the
+reference's fake clientset (pkg/client/clientset/versioned/fake/
+clientset_generated.go:33, object-tracker-backed), promoted to the primary
+backend so the whole control plane runs and is tested without a kube apiserver.
+A real-Kubernetes backend can implement the same ``Clientset`` surface
+(runtime/kube.py, gated on the kubernetes package).
+"""
+
+from trainingjob_operator_tpu.client.tracker import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    ObjectTracker,
+    WatchEvent,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.informers import InformerFactory, Lister
+from trainingjob_operator_tpu.client.workqueue import RateLimitingQueue
+from trainingjob_operator_tpu.client.expectations import ControllerExpectations
+
+__all__ = [
+    "AlreadyExistsError",
+    "ConflictError",
+    "NotFoundError",
+    "ObjectTracker",
+    "WatchEvent",
+    "Clientset",
+    "InformerFactory",
+    "Lister",
+    "RateLimitingQueue",
+    "ControllerExpectations",
+]

@@ -1,0 +1,108 @@
+"""Typed clients over the object tracker.
+
+Reference: pkg/client/clientset/versioned/typed/aitrainingjob/v1/
+aitrainingjob.go:38-49 (REST CRUD for the CR) and the corev1 clients the
+controller uses for pods/services/nodes/events.  One ``Clientset`` bundles the
+typed clients, mirroring ``createClientSets`` (cmd/app/server.go:111-151)
+collapsing to a single backend handle.
+
+``TrainingJobClient.update_status`` exists and is what the controller calls --
+fixing the reference quirk of writing status through plain ``Update`` despite
+the subresource method existing (SURVEY.md §8, status.go:290).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.client.tracker import ObjectTracker
+from trainingjob_operator_tpu.core.objects import Event, Node, Pod, Service, new_uid, now
+
+
+class _TypedClient:
+    KIND = ""
+
+    def __init__(self, tracker: ObjectTracker):
+        self._tracker = tracker
+
+    def create(self, obj):
+        return self._tracker.create(obj)
+
+    def get(self, namespace: str, name: str):
+        return self._tracker.get(self.KIND, namespace, name)
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None):
+        return self._tracker.list(self.KIND, namespace, label_selector)
+
+    def update(self, obj):
+        return self._tracker.update(obj)
+
+    def delete(self, namespace: str, name: str, grace_period: Optional[int] = None):
+        return self._tracker.delete(self.KIND, namespace, name, grace_period)
+
+
+class TrainingJobClient(_TypedClient):
+    KIND = constants.KIND
+
+    def update_status(self, job: TPUTrainingJob) -> TPUTrainingJob:
+        """Status-subresource-style update (whole-object store underneath,
+        like the fake clientset's UpdateStatus)."""
+        return self._tracker.update(job)
+
+
+class PodClient(_TypedClient):
+    KIND = Pod.KIND
+
+
+class ServiceClient(_TypedClient):
+    KIND = Service.KIND
+
+
+class NodeClient(_TypedClient):
+    """Nodes are cluster-scoped: namespace is always normalized to ""."""
+
+    KIND = Node.KIND
+
+    def create(self, obj: Node) -> Node:
+        obj = copy.deepcopy(obj)
+        obj.metadata.namespace = ""
+        return self._tracker.create(obj)
+
+    def get(self, namespace: str, name: str) -> Node:
+        return self._tracker.get(self.KIND, "", name)
+
+    def get_node(self, name: str) -> Node:
+        return self._tracker.get(self.KIND, "", name)
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None):
+        return self._tracker.list(self.KIND, "", label_selector)
+
+    def update(self, obj: Node) -> Node:
+        obj = copy.deepcopy(obj)
+        obj.metadata.namespace = ""
+        return self._tracker.update(obj)
+
+    def delete(self, namespace: str, name: str, grace_period: Optional[int] = None):
+        return self._tracker.delete(self.KIND, "", name, grace_period)
+
+
+class EventClient(_TypedClient):
+    KIND = Event.KIND
+
+
+class Clientset:
+    """The one handle the controller takes; swap the tracker for a real
+    cluster adapter to run against Kubernetes."""
+
+    def __init__(self, tracker: Optional[ObjectTracker] = None):
+        self.tracker = tracker or ObjectTracker()
+        self.trainingjobs = TrainingJobClient(self.tracker)
+        self.pods = PodClient(self.tracker)
+        self.services = ServiceClient(self.tracker)
+        self.nodes = NodeClient(self.tracker)
+        self.events = EventClient(self.tracker)

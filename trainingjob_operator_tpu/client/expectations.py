@@ -1,0 +1,89 @@
+"""Controller expectations: in-flight create/delete accounting.
+
+Reference: k8s.io/kubernetes/pkg/controller ControllerExpectations
+(controller.go:63,112), with the kubeflow-common key helpers
+``GenExpectationPodsKey``/``GenExpectationServicesKey`` (controller.go:399-400).
+
+The reconcile loop skips a job while its expected creations/deletions have not
+yet been observed by the informer (reference: controller.go:295,390-404) --
+this is what prevents re-entrant syncs from double-creating pods.  Entries
+expire after 5 minutes (client-go's ExpectationsTimeout) so a lost event can't
+wedge a job forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+EXPECTATION_TIMEOUT = 5 * 60.0
+
+
+def pods_key(job_key: str, replica_type: str) -> str:
+    """Reference: kubeflow common GenExpectationPodsKey."""
+    return f"{job_key}/{replica_type}/pods"
+
+
+def services_key(job_key: str, replica_type: str) -> str:
+    return f"{job_key}/{replica_type}/services"
+
+
+@dataclass
+class _Entry:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = field(default_factory=time.monotonic)
+
+
+class ControllerExpectations:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or (e.adds <= 0 and e.dels <= 0):
+                e = _Entry()
+                self._entries[key] = e
+            e.adds += count
+            e.timestamp = time.monotonic()
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or (e.adds <= 0 and e.dels <= 0):
+                e = _Entry()
+                self._entries[key] = e
+            e.dels += count
+            e.timestamp = time.monotonic()
+
+    def creation_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.adds -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.dels -= 1
+
+    def satisfied(self, key: str) -> bool:
+        """Fulfilled, expired, or never set -- all mean "go ahead and sync"."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return True
+            if e.adds <= 0 and e.dels <= 0:
+                return True
+            if time.monotonic() - e.timestamp > EXPECTATION_TIMEOUT:
+                return True
+            return False
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)

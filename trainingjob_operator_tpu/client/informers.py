@@ -1,0 +1,136 @@
+"""Shared informers and listers.
+
+Reference: pkg/client/informers/externalversions/ (SharedInformerFactory,
+factory.go) and pkg/client/listers/ (indexer-backed lookup).  The tracker is
+in-process, so the "cache" is the store itself: listers read through, and
+informers fan tracker watch events out to registered handlers -- the add/
+update/delete handler triples the controller wires up
+(reference: controller.go:118-156).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from trainingjob_operator_tpu.client.tracker import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    NotFoundError,
+    ObjectTracker,
+    WatchEvent,
+)
+
+
+class Lister:
+    """Reference: listers/aitrainingjob/v1/aitrainingjob.go:55-93."""
+
+    def __init__(self, tracker: ObjectTracker, kind: str):
+        self._tracker = tracker
+        self._kind = kind
+
+    def get(self, namespace: str, name: str) -> Any:
+        return self._tracker.get(self._kind, namespace, name)
+
+    def try_get(self, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self._tracker.get(self._kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        return self._tracker.list(self._kind, namespace, label_selector)
+
+
+class Informer:
+    """Per-kind informer: dispatches watch events to handler triples.
+
+    Handlers run on the mutating thread (synchronously after commit), which is
+    the in-process analogue of the informer delivering from its event queue;
+    handlers must be cheap -- the controller's handlers only touch the
+    workqueue/expectations, same as the reference's.
+    """
+
+    def __init__(self, tracker: ObjectTracker, kind: str):
+        self._tracker = tracker
+        self._kind = kind
+        self._lock = threading.Lock()
+        self._handlers: List[Dict[str, Callable]] = []
+        self._last_seen: Dict[str, Any] = {}
+        self._unsub = tracker.watch(kind, self._on_event)
+        self.lister = Lister(tracker, kind)
+
+    def add_event_handler(self,
+                          on_add: Optional[Callable[[Any], None]] = None,
+                          on_update: Optional[Callable[[Any, Any], None]] = None,
+                          on_delete: Optional[Callable[[Any], None]] = None) -> None:
+        with self._lock:
+            self._handlers.append({"add": on_add, "update": on_update, "delete": on_delete})
+
+    def _on_event(self, event: WatchEvent) -> None:
+        obj = event.obj
+        key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+        with self._lock:
+            handlers = list(self._handlers)
+            old = self._last_seen.get(key)
+            if event.type == DELETED:
+                self._last_seen.pop(key, None)
+            else:
+                self._last_seen[key] = obj
+        for h in handlers:
+            if event.type == ADDED and h["add"]:
+                h["add"](obj)
+            elif event.type == MODIFIED and h["update"]:
+                h["update"](old if old is not None else obj, obj)
+            elif event.type == DELETED and h["delete"]:
+                h["delete"](obj)
+
+    def resync(self) -> None:
+        """Re-deliver every object as an update (reference: the informer
+        resync the controller relies on for its 10 s periodic reconcile,
+        options.go:36)."""
+        for obj in self._tracker.list(self._kind):
+            with self._lock:
+                handlers = list(self._handlers)
+            for h in handlers:
+                if h["update"]:
+                    h["update"](obj, obj)
+
+    def stop(self) -> None:
+        self._unsub()
+
+
+class InformerFactory:
+    """Reference: informers/externalversions/factory.go -- one shared informer
+    per kind."""
+
+    def __init__(self, tracker: ObjectTracker):
+        self._tracker = tracker
+        self._informers: Dict[str, Informer] = {}
+        self._lock = threading.Lock()
+
+    def informer(self, kind: str) -> Informer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = Informer(self._tracker, kind)
+                self._informers[kind] = inf
+            return inf
+
+    def lister(self, kind: str) -> Lister:
+        return self.informer(kind).lister
+
+    def resync_all(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.resync()
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+            self._informers.clear()
+        for inf in informers:
+            inf.stop()

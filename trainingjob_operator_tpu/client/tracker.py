@@ -1,0 +1,253 @@
+"""Thread-safe object tracker: the in-process API server.
+
+Reference: the object tracker backing the generated fake clientset
+(pkg/client/clientset/versioned/fake/clientset_generated.go:33) -- here with
+watch fan-out, optimistic concurrency and graceful-deletion semantics so it can
+back the *real* control plane, not just tests:
+
+- Monotonic resource versions; ``update`` conflicts when the caller's version
+  is stale (the optimistic-concurrency behavior the reference's 5-retry status
+  writer is built around, status.go:288-303).
+- Watch handlers receive (ADDED | MODIFIED | DELETED, obj-copy) after the
+  mutation commits, outside the store lock.
+- Graceful deletion: kinds with a registered finalizer (the runtime/"kubelet")
+  get ``deletion_timestamp`` set and a MODIFIED event; the runtime later calls
+  ``finalize_delete``.  ``grace_period=0`` deletes immediately (force delete,
+  reference: pod.go:469-481).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.core.objects import new_uid, now
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+#: Default grace period for kinds with a finalizer (k8s pod default is 30 s;
+#: the sim/localproc runtimes finalize much sooner).
+DEFAULT_GRACE_PERIOD = 30
+
+
+class NotFoundError(KeyError):
+    def __init__(self, kind: str, namespace: str, name: str):
+        super().__init__(f"{kind} {namespace}/{name} not found")
+        self.kind, self.namespace, self.name = kind, namespace, name
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ConflictError(ValueError):
+    """Stale resource version on update."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: Any
+
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def obj_key(obj: Any) -> Key:
+    return (obj.KIND, obj.metadata.namespace, obj.metadata.name)
+
+
+def split_meta_namespace_key(key: str) -> Tuple[str, str]:
+    """'namespace/name' -> (namespace, name); reference: cache.SplitMetaNamespaceKey."""
+    parts = key.split("/")
+    if len(parts) == 2:
+        return parts[0], parts[1]
+    if len(parts) == 1:
+        return "", parts[0]
+    raise ValueError(f"unexpected key format: {key!r}")
+
+
+def meta_namespace_key(obj: Any) -> str:
+    """Reference: controller.KeyFunc / DeletionHandlingMetaNamespaceKeyFunc."""
+    ns = obj.metadata.namespace
+    return f"{ns}/{obj.metadata.name}" if ns else obj.metadata.name
+
+
+def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class ObjectTracker:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[Key, Any] = {}
+        self._rv = 0
+        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
+        # kind -> finalizer callback(obj) invoked (outside the lock) when a
+        # graceful delete begins; the owner must eventually finalize_delete().
+        self._finalizers: Dict[str, Callable[[Any], None]] = {}
+        # Commit-ordered event log drained under a dedicated dispatch lock so
+        # watchers observe mutations in resource-version order even when
+        # multiple threads mutate concurrently.
+        self._pending_events: List[Tuple[str, WatchEvent]] = []
+        self._dispatch_lock = threading.RLock()
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[WatchEvent], None]) -> Callable[[], None]:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                try:
+                    self._watchers.get(kind, []).remove(handler)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def register_finalizer(self, kind: str, fn: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._finalizers[kind] = fn
+
+    def _enqueue_event(self, kind: str, event: WatchEvent) -> None:
+        """Must be called with the store lock held (commit order)."""
+        self._pending_events.append((kind, event))
+
+    def _drain_events(self) -> None:
+        """Deliver pending events in commit order, outside the store lock.
+
+        The dispatch lock serializes delivery; a handler that mutates the
+        tracker re-enters safely (RLock) and drains inline.
+        """
+        with self._dispatch_lock:
+            while True:
+                with self._lock:
+                    if not self._pending_events:
+                        return
+                    kind, event = self._pending_events.pop(0)
+                    handlers = list(self._watchers.get(kind, []))
+                for h in handlers:
+                    h(WatchEvent(event.type, copy.deepcopy(event.obj)))
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            stored = copy.deepcopy(obj)
+            meta = stored.metadata
+            if not meta.name and meta.generate_name:
+                meta.name = meta.generate_name + new_uid()[:5]
+            key = obj_key(stored)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            self._rv += 1
+            meta.resource_version = self._rv
+            if not meta.uid:
+                meta.uid = new_uid()
+            if meta.creation_timestamp is None:
+                meta.creation_timestamp = now()
+            self._objects[key] = stored
+            # ``stored`` is never mutated after commit (update() swaps in a new
+            # object), so the event can reference it; handlers get copies.
+            self._enqueue_event(stored.KIND, WatchEvent(ADDED, stored))
+            out = copy.deepcopy(stored)
+        self._drain_events()
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(kind, namespace, name)
+            return copy.deepcopy(obj)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and namespace != "" and ns != namespace:
+                    continue
+                if not match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj: Any, check_version: bool = True) -> Any:
+        with self._lock:
+            key = obj_key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(*key)
+            if (check_version and obj.metadata.resource_version
+                    and obj.metadata.resource_version != cur.metadata.resource_version):
+                raise ConflictError(
+                    f"{key}: resource version {obj.metadata.resource_version} is stale "
+                    f"(current {cur.metadata.resource_version})")
+            stored = copy.deepcopy(obj)
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            stored.metadata.uid = cur.metadata.uid
+            stored.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            self._objects[key] = stored
+            self._enqueue_event(stored.KIND, WatchEvent(MODIFIED, stored))
+            out = copy.deepcopy(stored)
+        self._drain_events()
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str,
+               grace_period: Optional[int] = None) -> None:
+        """Graceful when a finalizer is registered for ``kind`` and
+        grace_period != 0; immediate otherwise."""
+        finalizer: Optional[Callable[[Any], None]] = None
+        obj_copy: Any = None
+        with self._lock:
+            key = (kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(kind, namespace, name)
+            fin = self._finalizers.get(kind)
+            if fin is not None and grace_period != 0:
+                if cur.metadata.deletion_timestamp is not None:
+                    return  # already terminating
+                grace = DEFAULT_GRACE_PERIOD if grace_period is None else grace_period
+                marked = copy.deepcopy(cur)
+                self._rv += 1
+                marked.metadata.resource_version = self._rv
+                marked.metadata.deletion_timestamp = now() + grace
+                marked.metadata.deletion_grace_period_seconds = grace
+                self._objects[key] = marked
+                self._enqueue_event(kind, WatchEvent(MODIFIED, marked))
+                finalizer = fin
+                obj_copy = copy.deepcopy(marked)
+            else:
+                del self._objects[key]
+                self._enqueue_event(kind, WatchEvent(DELETED, cur))
+        self._drain_events()
+        if finalizer is not None:
+            finalizer(obj_copy)
+
+    def finalize_delete(self, kind: str, namespace: str, name: str) -> None:
+        """Complete a graceful delete (called by the runtime/"kubelet")."""
+        with self._lock:
+            key = (kind, namespace, name)
+            cur = self._objects.pop(key, None)
+            if cur is not None:
+                self._enqueue_event(kind, WatchEvent(DELETED, cur))
+        self._drain_events()
+
+    # -- introspection -------------------------------------------------------
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return sum(1 for (k, _, _) in self._objects if k == kind)

@@ -1,0 +1,143 @@
+"""Rate-limited delaying workqueue.
+
+Reference: k8s.io/client-go/util/workqueue as used by the controller
+(controller.go:113 ``NewNamedRateLimitingQueue(DefaultControllerRateLimiter())``,
+enqueue modes immediate/rate-limited/delayed at controller.go:406-421).
+
+Semantics preserved from client-go:
+- An item present in the queue is not added again (dedup).
+- An item being processed (between Get and Done) that is re-added is marked
+  dirty and requeued on Done -- the single-writer-per-key guarantee the
+  reconcile loop's correctness rests on (SURVEY.md §5.2).
+- ``add_rate_limited`` applies per-item exponential backoff
+  (base 5 ms, cap 1000 s -- client-go's DefaultControllerRateLimiter
+  ItemExponentialFailureRateLimiter parameters); ``forget`` resets it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(self, name: str = "queue",
+                 base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.name = name
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._cond = threading.Condition()
+        self._queue: List[Any] = []          # FIFO of ready items
+        self._queued: Set[Any] = set()        # items in _queue
+        self._processing: Set[Any] = set()
+        self._dirty: Set[Any] = set()         # re-added while processing
+        self._waiting: List[Tuple[float, int, Any]] = []  # delayed heap
+        self._waiting_seq = 0
+        self._failures: Dict[Any, int] = {}
+        self._shutdown = False
+        self._pump = threading.Thread(target=self._pump_waiting, daemon=True,
+                                      name=f"workqueue-{name}-delay")
+        self._pump.start()
+
+    # -- add variants --------------------------------------------------------
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._queued:
+                return
+            self._queue.append(item)
+            self._queued.add(item)
+            self._cond.notify_all()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._waiting_seq += 1
+            heapq.heappush(self._waiting, (time.monotonic() + delay, self._waiting_seq, item))
+            self._cond.notify_all()
+
+    def add_rate_limited(self, item: Any) -> None:
+        with self._cond:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        delay = min(self._base_delay * (2 ** failures), self._max_delay)
+        self.add_after(item, delay)
+
+    def forget(self, item: Any) -> None:
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._cond:
+            return self._failures.get(item, 0)
+
+    # -- consume -------------------------------------------------------------
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Optional[Any], bool]:
+        """Block until an item is ready.  Returns (item, shutdown)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, False
+                self._cond.wait(timeout=remaining)
+            if self._shutdown and not self._queue:
+                return None, True
+            item = self._queue.pop(0)
+            self._queued.discard(item)
+            self._processing.add(item)
+            return item, False
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._queued:
+                    self._queue.append(item)
+                    self._queued.add(item)
+                    self._cond.notify_all()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def _pump_waiting(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                while self._waiting and self._waiting[0][0] <= now:
+                    _, _, item = heapq.heappop(self._waiting)
+                    if item not in self._queued and item not in self._processing:
+                        self._queue.append(item)
+                        self._queued.add(item)
+                        self._cond.notify_all()
+                    elif item in self._processing:
+                        self._dirty.add(item)
+                # Sleep until the next delayed item is due; add_after/shut_down
+                # notify to wake us.  No waiting items -> block indefinitely.
+                wait = max(0.001, self._waiting[0][0] - now) if self._waiting else None
+                self._cond.wait(timeout=wait)
