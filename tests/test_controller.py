@@ -1,0 +1,678 @@
+"""Controller integration tests against the in-memory cluster.
+
+This is the test pyramid level (2) the reference never had (SURVEY.md §4):
+controller vs. fake clients with hand-seeded pods in every phase, node
+readiness flips, exit-code matrices, preemption annotations, time limits, and
+restart-scope waits.  Reconciles are driven synchronously via sync_handler for
+determinism.
+"""
+
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    CleanPodPolicy,
+    EndingPolicy,
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUSpec,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.controller.garbage_collection import GarbageCollector
+from trainingjob_operator_tpu.core.objects import (
+    Condition,
+    ConditionStatus,
+    Container,
+    ContainerPort,
+    ContainerState,
+    ContainerStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodTemplateSpec,
+    make_ready_node,
+)
+
+
+def make_env():
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions())
+    return cs, tc
+
+
+def make_job(name="job", replicas=2, namespace="default", **replica_kw) -> TPUTrainingJob:
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace=namespace))
+    job.spec.replica_specs["trainer"] = ReplicaSpec(
+        replicas=replicas,
+        template=PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="aitj-main", image="img",
+                      ports=[ContainerPort(name="aitj-2222", container_port=2222)])
+        ])),
+        **replica_kw,
+    )
+    return job
+
+
+def sync(tc, job, n=1):
+    for _ in range(n):
+        tc.sync_handler(f"{job.metadata.namespace}/{job.metadata.name}")
+
+
+def get_job(cs, name="job", namespace="default"):
+    return cs.trainingjobs.get(namespace, name)
+
+
+def pods_of(cs, namespace="default"):
+    return sorted(cs.pods.list(namespace), key=lambda p: p.name)
+
+
+def set_pod_running(cs, pod_name, node="node-0", namespace="default"):
+    pod = cs.pods.get(namespace, pod_name)
+    pod.spec.node_name = node
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.start_time = time.time()
+    pod.status.container_statuses = [
+        ContainerStatus(name="aitj-main",
+                        state=ContainerState(running_started_at=time.time()))]
+    cs.pods.update(pod)
+
+
+def set_pod_terminated(cs, pod_name, exit_code, node="node-0", namespace="default"):
+    pod = cs.pods.get(namespace, pod_name)
+    pod.spec.node_name = node
+    pod.status.phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+    pod.status.container_statuses = [
+        ContainerStatus(name="aitj-main",
+                        state=ContainerState(terminated_exit_code=exit_code,
+                                             terminated_reason="Completed" if exit_code == 0 else "Error"))]
+    cs.pods.update(pod)
+
+
+class TestPodCreation:
+    def test_creates_pods_and_services_with_identity(self):
+        cs, tc = make_env()
+        cs.trainingjobs.create(make_job(replicas=2))
+        sync(tc, make_job())
+        pods = pods_of(cs)
+        assert [p.name for p in pods] == ["job-trainer-0", "job-trainer-1"]
+        p0 = pods[0]
+        assert p0.metadata.labels[constants.REPLICA_NAME_LABEL] == "trainer"
+        assert p0.metadata.labels[constants.REPLICA_INDEX_LABEL] == "0"
+        assert p0.metadata.labels[constants.GROUP_NAME_LABEL] == constants.GROUP_NAME
+        assert p0.metadata.labels[constants.JOB_NAME_LABEL] == "job"
+        ref = p0.metadata.controller_of()
+        assert ref is not None and ref.kind == constants.KIND
+        svcs = sorted(cs.services.list("default"), key=lambda s: s.name)
+        assert [s.name for s in svcs] == ["job-trainer-0", "job-trainer-1"]
+        assert svcs[0].spec.cluster_ip == "None"
+        assert svcs[0].spec.ports[0].port == 2222
+        # Second sync is idempotent.
+        sync(tc, make_job())
+        assert len(pods_of(cs)) == 2
+
+    def test_rendezvous_env_injection(self):
+        # Reference contract: pod.go:548-652.
+        cs, tc = make_env()
+        cs.trainingjobs.create(make_job(replicas=2))
+        sync(tc, make_job())
+        env = {e.name: e.value for e in pods_of(cs)[1].spec.containers[0].env}
+        assert env["TRAINER_INSTANCES"] == "job-trainer-0.default,job-trainer-1.default"
+        assert env["TRAINER_INSTANCES_NUM"] == "2"
+        assert env["TRAINER_PORTS"] == "2222"
+        assert env["TRAINER_HOSTS"] == "job-trainer-0.default:2222,job-trainer-1.default:2222"
+        assert env["TRAINER_HOSTS_NUM"] == "2"
+        assert env[constants.REPLICA_NAME_ENV] == "trainer"
+        assert env[constants.REPLICA_INDEX_ENV] == "1"
+        assert env[constants.REPLICA_RESTART_COUNT_ENV] == "0"
+        assert env[constants.SERVICE_ENV] == "job-trainer-1.default"
+        assert env[constants.JOB_NAME_ENV] == "job"
+        assert env[constants.PORTS_ENV] == "2222"
+        # TPU-native bootstrap set (SURVEY.md §5.8).
+        assert env[constants.NUM_PROCESSES_ENV] == "2"
+        assert env[constants.PROCESS_ID_ENV] == "1"
+        assert env[constants.COORDINATOR_ADDRESS_ENV] == "job-trainer-0.default:2222"
+        assert env[constants.TPU_WORKER_ID_ENV] == "1"
+
+    def test_pod_restart_policy_forced_never(self):
+        # Reference: pod.go:532-535.
+        cs, tc = make_env()
+        cs.trainingjobs.create(make_job(restart_policy=RestartPolicy.ON_FAILURE))
+        sync(tc, make_job())
+        assert all(p.spec.restart_policy == "Never" for p in pods_of(cs))
+
+    def test_gap_filling(self):
+        cs, tc = make_env()
+        cs.trainingjobs.create(make_job(replicas=3))
+        sync(tc, make_job())
+        cs.pods.delete("default", "job-trainer-1")
+        sync(tc, make_job())
+        assert [p.name for p in pods_of(cs)] == [
+            "job-trainer-0", "job-trainer-1", "job-trainer-2"]
+
+
+class TestPhaseMachine:
+    def test_pending_then_running(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=2))
+        sync(tc, make_job())
+        job = get_job(cs)
+        assert job.status.phase == TrainingJobPhase.PENDING
+        assert job.status.start_time is not None
+        for p in pods_of(cs):
+            set_pod_running(cs, p.name)
+        sync(tc, make_job())
+        job = get_job(cs)
+        assert job.status.phase == TrainingJobPhase.RUNNING
+        assert job.status.start_running_time is not None
+        assert job.status.replica_statuses["trainer"].active == 2
+        conds = [c.type for c in job.status.conditions]
+        assert conds == [TrainingJobPhase.PENDING, TrainingJobPhase.RUNNING]
+        # Older condition flipped to False.
+        assert job.status.conditions[0].status == ConditionStatus.FALSE
+
+    def test_complete_policy_all(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=2))
+        sync(tc, make_job())
+        set_pod_terminated(cs, "job-trainer-0", 0)
+        sync(tc, make_job())
+        assert get_job(cs).status.phase != TrainingJobPhase.SUCCEEDED
+        set_pod_terminated(cs, "job-trainer-1", 0)
+        sync(tc, make_job())
+        job = get_job(cs)
+        # CleanPodPolicy All (default): pods deleted, phase parked in
+        # annotation until drained (status.go:176-187).
+        assert job.status.phase == TrainingJobPhase.TERMINATING
+        assert TrainingJobPhase.SUCCEEDED in job.metadata.annotations
+        sync(tc, make_job())
+        job = get_job(cs)
+        assert job.status.phase == TrainingJobPhase.SUCCEEDED
+        assert job.status.end_time is not None
+        assert pods_of(cs) == []
+
+    def test_complete_policy_any(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=2, complete_policy=EndingPolicy.ANY))
+        sync(tc, make_job())
+        set_pod_terminated(cs, "job-trainer-1", 0)
+        sync(tc, make_job(), n=2)
+        assert get_job(cs).status.phase == TrainingJobPhase.SUCCEEDED
+
+    def test_complete_policy_rank0(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=2, complete_policy=EndingPolicy.RANK0))
+        sync(tc, make_job())
+        set_pod_terminated(cs, "job-trainer-1", 0)
+        sync(tc, make_job(), n=2)
+        assert get_job(cs).status.phase != TrainingJobPhase.SUCCEEDED
+        set_pod_terminated(cs, "job-trainer-0", 0)
+        sync(tc, make_job(), n=2)
+        assert get_job(cs).status.phase == TrainingJobPhase.SUCCEEDED
+
+    def test_fail_policy_any_with_clean_none_keeps_pods(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=2)
+        job.spec.clean_pod_policy = CleanPodPolicy.NONE
+        cs.trainingjobs.create(job)
+        sync(tc, make_job())
+        set_pod_running(cs, "job-trainer-0")
+        set_pod_terminated(cs, "job-trainer-1", 1)
+        sync(tc, make_job())
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.FAILED
+        assert len(pods_of(cs)) == 2  # kept (status.go:262-270)
+
+    def test_fail_policy_all(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=2, fail_policy=EndingPolicy.ALL))
+        sync(tc, make_job())
+        set_pod_terminated(cs, "job-trainer-0", 1)
+        sync(tc, make_job())
+        assert get_job(cs).status.phase != TrainingJobPhase.FAILED
+        set_pod_terminated(cs, "job-trainer-1", 1)
+        sync(tc, make_job(), n=2)
+        assert get_job(cs).status.phase in (TrainingJobPhase.TERMINATING,
+                                            TrainingJobPhase.FAILED)
+        sync(tc, make_job())
+        assert get_job(cs).status.phase == TrainingJobPhase.FAILED
+
+
+class TestRestartMachine:
+    def _failing_job(self, cs, tc, scope=RestartScope.ALL, replicas=2,
+                     policy=RestartPolicy.ON_FAILURE, limit=None, exit_code=1,
+                     restarting_exit_code=""):
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=replicas, restart_policy=policy,
+                       restart_scope=scope, restart_limit=limit,
+                       fail_policy=EndingPolicy.RANK0)
+        job.spec.restarting_exit_code = restarting_exit_code
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for p in pods_of(cs):
+            set_pod_running(cs, p.name)
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        set_pod_terminated(cs, "job-trainer-1", exit_code)
+        return job
+
+    def test_on_failure_restart_two_phase(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, scope=RestartScope.ALL)
+        sync(tc, job)
+        got = get_job(cs)
+        # Phase 1: deletes issued, Terminating with restart marker
+        # (controller.go:362-366).
+        assert got.status.phase == TrainingJobPhase.TERMINATING
+        assert got.status.restart_replica_name == "trainer"
+        assert got.status.restart_counts["trainer"] == 1
+        assert pods_of(cs) == []  # no finalizer -> deleted immediately
+        # Phase 2: pods drained -> Restarting, marker cleared
+        # (status.go:114-143).
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.RESTARTING
+        assert got.status.restart_replica_name == ""
+        # Phase 3: pods recreated with bumped restart count.
+        sync(tc, job)
+        pods = pods_of(cs)
+        assert len(pods) == 2
+        assert pods[0].metadata.labels[constants.RESTART_COUNT_LABEL] == "1"
+        env = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        assert env[constants.REPLICA_RESTART_COUNT_ENV] == "1"
+
+    def test_restart_scope_pod_deletes_only_failed(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, scope=RestartScope.POD)
+        sync(tc, job)
+        remaining = [p.name for p in pods_of(cs)]
+        assert remaining == ["job-trainer-0"]
+
+    def test_restart_wait_blocks_reconcile_until_drained(self):
+        cs, tc = make_env()
+        # Register a finalizer so deletes are graceful (pods linger).
+        finalizing = []
+        cs.tracker.register_finalizer("Pod", lambda o: finalizing.append(o.name))
+        job = self._failing_job(cs, tc, scope=RestartScope.ALL)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.TERMINATING
+        assert len(pods_of(cs)) == 2  # still terminating
+        sync(tc, job)
+        # Still waiting: no recreation, no phase flip.
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.TERMINATING
+        assert got.status.restart_replica_name == "trainer"
+        for name in list(finalizing):
+            cs.tracker.finalize_delete("Pod", "default", name)
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RESTARTING
+        sync(tc, job)
+        assert len(pods_of(cs)) == 2
+
+    def test_restart_limit_exhausted_falls_through_to_fail(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, scope=RestartScope.ALL, limit=0,
+                                policy=RestartPolicy.ON_FAILURE)
+        # fail_policy RANK0 and rank1 failed -> not ended; but restart is
+        # blocked by limit, so pod stays Failed and the group keeps running
+        # until a policy triggers.
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.restart_counts["trainer"] == 0
+        assert len(pods_of(cs)) == 2  # nothing deleted
+
+    def test_exit_code_policy_retryable(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, policy=RestartPolicy.EXIT_CODE,
+                                exit_code=137, restarting_exit_code="137,128")
+        sync(tc, job)
+        assert get_job(cs).status.restart_counts["trainer"] == 1
+
+    def test_exit_code_policy_non_retryable_fails(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, policy=RestartPolicy.EXIT_CODE,
+                                exit_code=2, restarting_exit_code="137,128",
+                                replicas=2)
+        # fail_policy RANK0: rank 1 failing doesn't end the job; no restart.
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.restart_counts["trainer"] == 0
+        # Now fail rank 0 with a non-retryable code -> job fails.
+        set_pod_terminated(cs, "job-trainer-0", 2)
+        sync(tc, job, n=3)
+        assert get_job(cs).status.phase == TrainingJobPhase.FAILED
+
+    def test_never_policy_no_restart(self):
+        cs, tc = make_env()
+        job = self._failing_job(cs, tc, policy=RestartPolicy.NEVER,
+                                exit_code=1, replicas=2)
+        sync(tc, job)
+        assert get_job(cs).status.restart_counts["trainer"] == 0
+
+
+class TestNodeFailure:
+    def test_node_fail_restarts_with_force_delete(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.nodes.create(make_ready_node("node-1"))
+        job = make_job(replicas=2, restart_policy=RestartPolicy.ON_NODE_FAIL,
+                       restart_scope=RestartScope.POD)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-trainer-0", node="node-0")
+        set_pod_running(cs, "job-trainer-1", node="node-1")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        # Node-1 dies.  Register a finalizer to prove force-delete bypasses it.
+        cs.tracker.register_finalizer("Pod", lambda o: None)
+        node = cs.nodes.get_node("node-1")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.restart_counts["trainer"] == 1
+        # Force delete (grace 0) removed it despite the finalizer
+        # (pod.go:210-213,469).
+        assert [p.name for p in pods_of(cs)] == ["job-trainer-0"]
+
+    def test_node_fail_without_policy_fails_job(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=1, restart_policy=RestartPolicy.NEVER)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-trainer-0", node="node-0")
+        sync(tc, job)
+        node = cs.nodes.get_node("node-0")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job, n=2)
+        got = get_job(cs)
+        assert got.status.phase in (TrainingJobPhase.TERMINATING,
+                                    TrainingJobPhase.NODE_FAIL)
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.NODE_FAIL
+
+
+class TestPreemption:
+    def test_preempted_annotation_short_circuits(self):
+        # Reference: pod.go:160-165 + annotation-drain (status.go:176-187).
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=2)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        fresh = get_job(cs)
+        fresh.metadata.annotations[TrainingJobPhase.PREEMPTED] = "preempted by scheduler"
+        cs.trainingjobs.update(fresh)
+        sync(tc, job, n=3)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.PREEMPTED
+        assert pods_of(cs) == []
+
+
+class TestTimeLimit:
+    def test_timeout_terminates(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=1)
+        job.spec.time_limit = 1
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-trainer-0")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        # Backdate start_running_time past the limit.
+        fresh = get_job(cs)
+        fresh.status.start_running_time = time.time() - 10
+        cs.trainingjobs.update(fresh)
+        sync(tc, job, n=3)
+        assert get_job(cs).status.phase == TrainingJobPhase.TIMEOUT
+
+
+class TestValidationGate:
+    def test_invalid_spec_fails_job(self):
+        cs, tc = make_env()
+        job = TPUTrainingJob(metadata=ObjectMeta(name="bad", namespace="default"))
+        job.spec.replica_specs["w"] = ReplicaSpec()  # no containers
+        cs.trainingjobs.create(job)
+        tc.sync_handler("default/bad")
+        got = cs.trainingjobs.get("default", "bad")
+        assert got.status.phase == TrainingJobPhase.FAILED
+        assert any(e.reason == "ValidationFailed" for e in cs.events.list())
+
+
+class TestMultiReplicaGroups:
+    def make_ps_worker_job(self, cs):
+        job = TPUTrainingJob(metadata=ObjectMeta(name="psjob", namespace="default"))
+        for rname, n in (("pserver", 2), ("trainer", 2)):
+            job.spec.replica_specs[rname] = ReplicaSpec(
+                replicas=n,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name=f"aitj-{rname}",
+                              ports=[ContainerPort(name="aitj-5000", container_port=5000)])
+                ])),
+            )
+        # Job completes when trainers complete; pserver never exits.
+        job.spec.replica_specs["trainer"].complete_policy = EndingPolicy.ALL
+        job.spec.complete_policy = EndingPolicy.ANY
+        cs.trainingjobs.create(job)
+        return job
+
+    def test_cross_group_env_and_completion(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        job = self.make_ps_worker_job(cs)
+        tc.sync_handler("default/psjob")
+        pods = pods_of(cs)
+        assert len(pods) == 4
+        env = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        # Every group sees every other group's host lists (pod.go:553-599).
+        assert env["PSERVER_INSTANCES_NUM"] == "2"
+        assert env["TRAINER_INSTANCES_NUM"] == "2"
+        for p in pods:
+            if "trainer" in p.name:
+                set_pod_terminated(cs, p.name, 0)
+            else:
+                set_pod_running(cs, p.name)
+        tc.sync_handler("default/psjob")
+        tc.sync_handler("default/psjob")
+        got = cs.trainingjobs.get("default", "psjob")
+        assert got.status.phase in (TrainingJobPhase.TERMINATING,
+                                    TrainingJobPhase.SUCCEEDED)
+
+
+class TestTPUProvisioning:
+    def test_tpu_node_selectors_resources_and_gang_labels(self):
+        cs, tc = make_env()
+        job = make_job(replicas=4)
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(
+            accelerator="tpu-v5-lite-podslice", topology="4x4", preemptible=True)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        pods = pods_of(cs)
+        assert len(pods) == 4
+        p = pods[0]
+        sel = p.spec.node_selector
+        assert sel[constants.GKE_TPU_ACCELERATOR_SELECTOR] == "tpu-v5-lite-podslice"
+        assert sel[constants.GKE_TPU_TOPOLOGY_SELECTOR] == "4x4"
+        assert sel[constants.GKE_SPOT_SELECTOR] == "true"
+        assert p.spec.containers[0].resources["limits"][constants.TPU_RESOURCE] == 4
+        env = {e.name: e.value for e in p.spec.containers[0].env}
+        assert env[constants.TPU_TOPOLOGY_ENV] == "4x4"
+        assert env[constants.TPU_WORKER_HOSTNAMES_ENV].startswith("job-trainer-0.default")
+        # 4x4 = one slice of 4 hosts -> all pods in gang slice0.
+        assert all(pp.metadata.labels[constants.SLICE_ID_LABEL] == "0" for pp in pods)
+
+    def test_multislice_env(self):
+        cs, tc = make_env()
+        job = make_job(replicas=4)
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(
+            accelerator="tpu-v5-lite-podslice", topology="2x4", slice_count=2)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        pods = pods_of(cs)
+        env0 = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        env3 = {e.name: e.value for e in pods[3].spec.containers[0].env}
+        # 2x4 = 8 chips = 2 hosts/slice; pods 0-1 slice0, 2-3 slice1.
+        assert env0[constants.SLICE_ID_ENV] == "0"
+        assert env3[constants.SLICE_ID_ENV] == "1"
+        assert env0[constants.NUM_SLICES_ENV] == "2"
+        assert pods[3].metadata.labels[constants.SLICE_ID_LABEL] == "1"
+
+
+class TestGarbageCollection:
+    def test_orphan_pod_collected(self):
+        cs, tc = make_env()
+        pod = Pod(metadata=ObjectMeta(
+            name="orphan", namespace="default",
+            labels={constants.GROUP_NAME_LABEL: constants.GROUP_NAME},
+            owner_references=[OwnerReference(kind=constants.KIND, name="gone",
+                                             uid="dead", controller=True)]))
+        cs.pods.create(pod)
+        gc = GarbageCollector(cs, tc.trainingjob_lister)
+        gc.clean_garbage_pods()
+        assert cs.pods.list() == []
+
+    def test_owned_pod_kept(self):
+        cs, tc = make_env()
+        cs.trainingjobs.create(make_job())
+        sync(tc, make_job())
+        gc = GarbageCollector(cs, tc.trainingjob_lister)
+        gc.clean_garbage_pods()
+        assert len(pods_of(cs)) == 2
+
+    def test_unlabeled_pod_ignored(self):
+        cs, tc = make_env()
+        cs.pods.create(Pod(metadata=ObjectMeta(name="random", namespace="default")))
+        gc = GarbageCollector(cs, tc.trainingjob_lister)
+        gc.clean_garbage_pods()
+        assert len(cs.pods.list()) == 1
+
+
+class TestElasticWidth:
+    def test_effective_replicas_drives_pod_count(self):
+        cs, tc = make_env()
+        job = make_job(replicas=4, min_replicas=2, max_replicas=4)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        assert len(pods_of(cs)) == 4
+        # Controller decides to degrade to width 2 (elastic record in status).
+        fresh = get_job(cs)
+        fresh.status.elastic_replicas["trainer"] = 2
+        cs.trainingjobs.update(fresh)
+        # Pods 2,3 are removed by the elastic path before reconcile; simulate
+        # capacity loss by deleting them, then ensure no gap-filling past
+        # width 2.
+        cs.pods.delete("default", "job-trainer-2")
+        cs.pods.delete("default", "job-trainer-3")
+        sync(tc, job)
+        assert [p.name for p in pods_of(cs)] == ["job-trainer-0", "job-trainer-1"]
+        env = {e.name: e.value for e in pods_of(cs)[0].spec.containers[0].env}
+        # Env for new pods would reflect the degraded width via
+        # effective_replicas; existing pods keep their env (restart applies it).
+
+
+class TestEndToEndLoop:
+    def test_threaded_run_completes_job(self):
+        """The full loop: run() workers + informer events, no manual syncs."""
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        tc.options.resync_period = 0.05
+        tc.run(workers=2)
+        try:
+            cs.trainingjobs.create(make_job(replicas=2))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if len(cs.pods.list("default")) == 2:
+                    break
+                time.sleep(0.01)
+            assert len(cs.pods.list("default")) == 2
+            for p in pods_of(cs):
+                set_pod_terminated(cs, p.name, 0)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if get_job(cs).status.phase == TrainingJobPhase.SUCCEEDED:
+                    break
+                time.sleep(0.01)
+            assert get_job(cs).status.phase == TrainingJobPhase.SUCCEEDED
+        finally:
+            tc.stop()
+
+
+class TestReviewRegressions2:
+    """Regressions for the controller-round code-review findings."""
+
+    def test_tpu_replicas_default_to_geometry(self):
+        from trainingjob_operator_tpu.api.defaults import set_defaults
+        job = make_job(replicas=None)
+        job.spec.replica_specs["trainer"].replicas = None
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(topology="4x4", slice_count=2)
+        set_defaults(job)
+        assert job.spec.replica_specs["trainer"].replicas == 8
+
+    def test_tpu_replicas_geometry_mismatch_rejected(self):
+        from trainingjob_operator_tpu.api.validation import validate_job
+        job = make_job(replicas=3)
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(topology="4x4")
+        assert any("does not match the TPU geometry" in e for e in validate_job(job))
+
+    def test_replicas_zero_respected(self):
+        from trainingjob_operator_tpu.controller.naming import effective_replicas
+        from trainingjob_operator_tpu.api.defaults import set_defaults
+        job = make_job(replicas=0)
+        set_defaults(job)
+        assert effective_replicas(job, "trainer") == 0
+        cs, tc = make_env()
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        assert pods_of(cs) == []
+
+    def test_conflict_retry_preserves_external_annotations(self):
+        cs, tc = make_env()
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.trainingjobs.create(make_job(replicas=1))
+        sync(tc, make_job())
+        # Controller holds a stale copy while an external actor annotates.
+        stale = get_job(cs)
+        external = get_job(cs)
+        external.metadata.annotations[TrainingJobPhase.PREEMPTED] = "spot reclaim"
+        cs.trainingjobs.update(external)
+        stale.status.phase = TrainingJobPhase.RUNNING
+        tc.update_trainingjob_phase(stale)
+        got = get_job(cs)
+        assert got.metadata.annotations.get(TrainingJobPhase.PREEMPTED) == "spot reclaim"
+
+    def test_informer_replays_preexisting_objects(self):
+        cs = Clientset()
+        cs.trainingjobs.create(make_job())
+        # Controller constructed AFTER the job exists: must still reconcile it
+        # without waiting for resync.
+        tc = TrainingJobController(cs)
+        item, _ = tc.work_queue.get(timeout=1.0)
+        assert item == "default/job"
+
+    def test_event_retention_cap(self):
+        from trainingjob_operator_tpu.utils.events import EventRecorder
+        cs = Clientset()
+        rec = EventRecorder(cs, "test")
+        rec.MAX_EVENTS = 10
+        job = make_job()
+        for i in range(25):
+            rec.event(job, EventRecorder.NORMAL, "R", f"m{i}")
+        assert len(cs.events.list()) == 10

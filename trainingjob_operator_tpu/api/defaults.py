@@ -20,9 +20,21 @@ from trainingjob_operator_tpu.api.types import (
 def set_default_replica(spec: ReplicaSpec) -> None:
     """Reference: defaults.go:15-31."""
     if spec.replicas is None:
-        # An elastic spec may give only a [min, max] range; start at min
-        # (reference defaults a missing Replicas to 1, defaults.go:16-18).
-        spec.replicas = spec.min_replicas if spec.min_replicas is not None else 1
+        if spec.tpu is not None and spec.tpu.topology:
+            # TPU groups default to the slice geometry: one pod per TPU-VM
+            # host across slice_count slices.
+            from trainingjob_operator_tpu.api.tpu import total_hosts
+
+            try:
+                spec.replicas = total_hosts(spec.tpu)
+            except ValueError:
+                spec.replicas = 1
+        elif spec.min_replicas is not None:
+            # An elastic spec may give only a [min, max] range; start at min.
+            spec.replicas = spec.min_replicas
+        else:
+            # Reference defaults a missing Replicas to 1 (defaults.go:16-18).
+            spec.replicas = 1
     if not spec.restart_policy:
         spec.restart_policy = RestartPolicy.NEVER
     if not spec.restart_scope:

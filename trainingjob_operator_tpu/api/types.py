@@ -96,8 +96,8 @@ class RestartPolicy:
     EXIT_CODE = "ExitCode"
     ON_NODE_FAIL_WITH_EXIT_CODE = "OnNodeFailWithExitCode"
 
-    ALL = (ALWAYS, ON_FAILURE, ON_NODE_FAIL, NEVER, EXIT_CODE,
-           ON_NODE_FAIL_WITH_EXIT_CODE)
+    VALUES = (ALWAYS, ON_FAILURE, ON_NODE_FAIL, NEVER, EXIT_CODE,
+              ON_NODE_FAIL_WITH_EXIT_CODE)
 
 
 class RestartScope:

@@ -55,7 +55,7 @@ def validate_job(job: TPUTrainingJob, require_image: bool = False) -> List[str]:
 
     for rname, rspec in spec.replica_specs.items():
         prefix = f"spec.replicaSpecs[{rname}]"
-        if rspec.restart_policy and rspec.restart_policy not in RestartPolicy.ALL:
+        if rspec.restart_policy and rspec.restart_policy not in RestartPolicy.VALUES:
             errs.append(f"{prefix}.restartPolicy: invalid value {rspec.restart_policy!r}")
         if rspec.restart_scope and rspec.restart_scope not in RestartScope.VALUES:
             errs.append(f"{prefix}.restartScope: invalid value {rspec.restart_scope!r}")
@@ -100,6 +100,18 @@ def validate_job(job: TPUTrainingJob, require_image: bool = False) -> List[str]:
                 errs.append(f"{prefix}.tpu.sliceCount: must be >= 1")
             if tpu.chips_per_host < 1:
                 errs.append(f"{prefix}.tpu.chipsPerHost: must be >= 1")
+            if tpu.topology and _valid_topology(tpu.topology):
+                # Replicas must match the slice geometry: one pod per TPU-VM
+                # host, slice_count slices (multislice rendezvous depends on
+                # index // hosts_per_slice mapping cleanly).
+                from trainingjob_operator_tpu.api.tpu import total_hosts
+
+                want = total_hosts(tpu)
+                if rspec.replicas is not None and rspec.replicas != want:
+                    errs.append(
+                        f"{prefix}.replicas: {rspec.replicas} does not match the "
+                        f"TPU geometry (topology {tpu.topology} x "
+                        f"{tpu.slice_count} slice(s) = {want} hosts)")
     return errs
 
 

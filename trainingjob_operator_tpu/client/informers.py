@@ -60,14 +60,24 @@ class Informer:
         self._handlers: List[Dict[str, Callable]] = []
         self._last_seen: Dict[str, Any] = {}
         self._unsub = tracker.watch(kind, self._on_event)
+        with self._lock:
+            for obj in tracker.list(kind):
+                self._last_seen[f"{obj.metadata.namespace}/{obj.metadata.name}"] = obj
         self.lister = Lister(tracker, kind)
 
     def add_event_handler(self,
                           on_add: Optional[Callable[[Any], None]] = None,
                           on_update: Optional[Callable[[Any, Any], None]] = None,
                           on_delete: Optional[Callable[[Any], None]] = None) -> None:
+        """Register a handler triple.  Objects already in the store are
+        replayed to ``on_add`` (informer cache-sync semantics: at-least-once
+        delivery; handlers must be idempotent, which enqueue-style handlers
+        are)."""
         with self._lock:
             self._handlers.append({"add": on_add, "update": on_update, "delete": on_delete})
+        if on_add is not None:
+            for obj in self._tracker.list(self._kind):
+                on_add(obj)
 
     def _on_event(self, event: WatchEvent) -> None:
         obj = event.obj

@@ -1,0 +1,1 @@
+"""CLI / process bootstrap layer (reference: cmd/)."""

@@ -1,0 +1,92 @@
+"""Operator configuration.
+
+Reference: cmd/app/options/options.go:12-72 -- same knobs and defaults
+(ThreadNum=1, ResyncPeriod=10s, CreatingDurationTime=15min, leader-election
+lease 15s / renew 5s / retry 3s).  Time fields are seconds (floats).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class LeaderElectionConfig:
+    """Reference: k8s leaderelectionconfig defaults (options.go:39-53)."""
+
+    leader_elect: bool = False
+    lease_duration: float = 15.0
+    renew_deadline: float = 5.0
+    retry_period: float = 3.0
+    lock_path: str = ""  # file lock for local HA; Lease object on k8s
+
+
+@dataclass
+class OperatorOptions:
+    """Reference: TrainingJobOperatorOption (options.go:12-23)."""
+
+    master_url: str = ""
+    kubeconfig: str = ""
+    run_in_cluster: bool = False
+    thread_num: int = 1
+    creating_restart_time: float = 0.0        # --creating-restart-period
+    creating_duration_time: float = 15 * 60.0  # --creating-duration-period
+    enable_creating_failed: bool = False
+    namespace: str = ""                        # "" = all namespaces
+    resync_period: float = 10.0
+    gc_interval: float = 600.0                 # reference: controller.go:204
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    backend: str = "sim"                       # sim | localproc | kube
+
+    @classmethod
+    def add_flags(cls, parser: argparse.ArgumentParser) -> None:
+        """Reference: AddFlags (options.go:61-72)."""
+        parser.add_argument("--master", dest="master_url", default="",
+                            help="Address of the cluster API server (kube backend).")
+        parser.add_argument("--kubeconfig", default="",
+                            help="Path to a kubeconfig (kube backend, out-of-cluster).")
+        parser.add_argument("--run-in-cluster", action="store_true",
+                            help="Operator runs inside the cluster.")
+        parser.add_argument("--thread-num", type=int, default=1,
+                            help="Number of reconcile worker threads.")
+        parser.add_argument("--namespace", default="",
+                            help="Namespace to watch (default: all).")
+        parser.add_argument("--resync-period", type=float, default=10.0,
+                            help="Informer resync interval, seconds.")
+        parser.add_argument("--creating-restart-period", type=float, default=0.0,
+                            dest="creating_restart_time",
+                            help="Window during which container-create errors retry, seconds.")
+        parser.add_argument("--creating-duration-period", type=float, default=15 * 60.0,
+                            dest="creating_duration_time",
+                            help="Grace before a stuck-creating pod restarts, seconds.")
+        parser.add_argument("--enable-creating-failed", action="store_true",
+                            help="Fail the job when container creation exceeds the retry window.")
+        parser.add_argument("--gc-interval", type=float, default=600.0,
+                            help="Orphan-pod GC sweep interval, seconds.")
+        parser.add_argument("--leader-elect", action="store_true",
+                            help="Enable leader election before running.")
+        parser.add_argument("--leader-elect-lock", default="", dest="leader_lock",
+                            help="Path of the leader-election lock file.")
+        parser.add_argument("--backend", choices=("sim", "localproc", "kube"),
+                            default="sim", help="Cluster runtime backend.")
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "OperatorOptions":
+        opt = cls(
+            master_url=args.master_url,
+            kubeconfig=args.kubeconfig,
+            run_in_cluster=args.run_in_cluster,
+            thread_num=args.thread_num,
+            namespace=args.namespace,
+            resync_period=args.resync_period,
+            creating_restart_time=args.creating_restart_time,
+            creating_duration_time=args.creating_duration_time,
+            enable_creating_failed=args.enable_creating_failed,
+            gc_interval=args.gc_interval,
+            backend=args.backend,
+        )
+        opt.leader_election.leader_elect = args.leader_elect
+        opt.leader_election.lock_path = args.leader_lock
+        return opt

@@ -1,0 +1,6 @@
+"""The reconcile engine (reference: pkg/controller/)."""
+
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.controller.garbage_collection import GarbageCollector
+
+__all__ = ["TrainingJobController", "GarbageCollector"]

@@ -1,0 +1,79 @@
+"""Pod/Service control: create/delete with owner references + events.
+
+Reference: kubeflow-common ``RealPodControl`` / ``RealServiceControl``
+(controller.go:94-102) -- the layer that stamps controller owner refs on
+created objects and records events for every create/delete.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.core.objects import OwnerReference, Pod, Service
+from trainingjob_operator_tpu.utils.events import EventRecorder
+
+log = logging.getLogger("trainingjob.control")
+
+
+def gen_owner_reference(job: Any) -> OwnerReference:
+    """Reference: GenOwnerReference (controller.go:161-173)."""
+    return OwnerReference(
+        api_version=constants.API_VERSION,
+        kind=constants.KIND,
+        name=job.metadata.name,
+        uid=job.metadata.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+
+
+def is_controlled_by(obj: Any, job: Any) -> bool:
+    ref = obj.metadata.controller_of()
+    return ref is not None and ref.uid == job.metadata.uid
+
+
+class PodControl:
+    def __init__(self, clientset: Any, recorder: EventRecorder):
+        self._cs = clientset
+        self._recorder = recorder
+
+    def create_pod(self, namespace: str, pod: Pod, job: Any) -> Pod:
+        pod.metadata.namespace = namespace
+        pod.metadata.owner_references = [gen_owner_reference(job)]
+        created = self._cs.pods.create(pod)
+        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulCreatePod",
+                             f"Created pod: {created.name}")
+        return created
+
+    def delete_pod(self, namespace: str, name: str, job: Any,
+                   grace_period: Optional[int] = None) -> None:
+        try:
+            self._cs.pods.delete(namespace, name, grace_period=grace_period)
+        except KeyError:
+            return
+        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulDeletePod",
+                             f"Deleted pod: {name}")
+
+
+class ServiceControl:
+    def __init__(self, clientset: Any, recorder: EventRecorder):
+        self._cs = clientset
+        self._recorder = recorder
+
+    def create_service(self, namespace: str, service: Service, job: Any) -> Service:
+        service.metadata.namespace = namespace
+        service.metadata.owner_references = [gen_owner_reference(job)]
+        created = self._cs.services.create(service)
+        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulCreateService",
+                             f"Created service: {created.name}")
+        return created
+
+    def delete_service(self, namespace: str, name: str, job: Any) -> None:
+        try:
+            self._cs.services.delete(namespace, name)
+        except KeyError:
+            return
+        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulDeleteService",
+                             f"Deleted service: {name}")

@@ -1,0 +1,83 @@
+"""Orphan/zombie pod garbage collector.
+
+Reference: pkg/controller/garbage_collection.go -- periodic sweep deleting
+(a) group-labeled pods whose deletion timestamp has expired (stuck
+terminating), and (b) orphans whose owning job no longer exists, with a
+node-health check so pods on temporarily-unready nodes are not nuked while
+their kubelet is unreachable (garbage_collection.go:36-106).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.client.tracker import NotFoundError
+from trainingjob_operator_tpu.core.objects import Pod
+
+log = logging.getLogger("trainingjob.gc")
+
+
+class GarbageCollector:
+    def __init__(self, clientset: Any, trainingjob_lister: Any):
+        self._cs = clientset
+        self._job_lister = trainingjob_lister
+        self._stop = threading.Event()
+
+    def run(self, interval: float) -> None:
+        """Reference: CleanOrphans (garbage_collection.go:28-34); interval is
+        10 min in the reference (controller.go:204)."""
+        while not self._stop.wait(interval):
+            self.clean_garbage_pods()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def clean_garbage_pods(self) -> None:
+        """Reference: CleanGarbagePods (garbage_collection.go:36-76)."""
+        for pod in self._cs.pods.list():
+            if pod.metadata.labels.get(constants.GROUP_NAME_LABEL) != constants.GROUP_NAME:
+                continue
+
+            dt = pod.metadata.deletion_timestamp
+            if dt is not None and dt < time.time():
+                log.warning("garbage pod %s: terminated expired", pod.name)
+                self._delete_pod(pod.namespace, pod.name)
+                continue
+
+            ref = pod.metadata.controller_of()
+            if ref is None or ref.kind != constants.KIND:
+                continue
+            if self._job_lister.try_get(pod.metadata.namespace, ref.name) is not None:
+                continue
+            # Owner is gone.  If the pod is terminating within its grace and
+            # its node is healthy, let the kubelet finish; otherwise collect.
+            if dt is not None and dt > time.time() and self._check_node(pod):
+                continue
+            log.info("orphan pod %s (owner %s gone)", pod.name, ref.name)
+            self._delete_pod(pod.namespace, pod.name)
+
+    def _delete_pod(self, namespace: str, name: str) -> None:
+        """Force delete, grace 0 (garbage_collection.go:78-89)."""
+        try:
+            self._cs.pods.delete(namespace, name, grace_period=0)
+        except NotFoundError:
+            pass
+        except Exception:
+            log.exception("delete pod %s/%s failed", namespace, name)
+
+    def _check_node(self, pod: Pod) -> bool:
+        """True when the pod's node is Ready or unknown
+        (garbage_collection.go:91-106)."""
+        if not pod.spec.node_name:
+            return True
+        try:
+            node = self._cs.nodes.get_node(pod.spec.node_name)
+        except NotFoundError:
+            return False
+        except Exception:
+            return True
+        return node.is_ready()

@@ -1,0 +1,79 @@
+"""Naming and selection helpers shared across the controller.
+
+Reference: GenGeneralName (trainingjob.go:12-15), GenLabels
+(controller.go:175-180), FilterPodsForReplicaType / GetPodSlices
+(pod.go:654-696), exit-code matching (controller.go:442-462).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from trainingjob_operator_tpu.api import constants
+
+
+def gen_general_name(job_name: str, rtype: str, index: str) -> str:
+    """'job-rtype-index' (reference: trainingjob.go:12-15)."""
+    return f"{job_name}-{rtype}-{index}".replace("/", "-")
+
+
+def gen_labels(job_name: str) -> Dict[str, str]:
+    """Reference: controller.go:175-180."""
+    return {
+        constants.GROUP_NAME_LABEL: constants.GROUP_NAME,
+        constants.JOB_NAME_LABEL: job_name.replace("/", "-"),
+    }
+
+
+def job_selector(job_name: str) -> Dict[str, str]:
+    """Reference: reconcileTrainingJobs selector (controller.go:318-323)."""
+    return gen_labels(job_name)
+
+
+def filter_for_replica_type(objects: Sequence[Any], replica_type: str) -> List[Any]:
+    """Reference: FilterPodsForReplicaType (pod.go:654-674)."""
+    return [o for o in objects
+            if o.metadata.labels.get(constants.REPLICA_NAME_LABEL) == replica_type]
+
+
+def get_slices(objects: Sequence[Any], replicas: int) -> List[List[Any]]:
+    """Bucket objects by their index label into ``replicas`` slots; out-of-range
+    indices are dropped (reference: GetPodSlices, pod.go:676-696)."""
+    slices: List[List[Any]] = [[] for _ in range(replicas)]
+    for obj in objects:
+        raw = obj.metadata.labels.get(constants.REPLICA_INDEX_LABEL)
+        if raw is None:
+            continue
+        try:
+            index = int(raw)
+        except ValueError:
+            continue
+        if 0 <= index < replicas:
+            slices[index].append(obj)
+    return slices
+
+
+def is_retryable_exit_code(exit_codes: Sequence[int], restarting_exit_code: str) -> bool:
+    """True iff every observed non-zero exit code is in the configured retry
+    set (reference: isRetryableExitCode, controller.go:442-452 -- AND over
+    codes, False when no codes observed)."""
+    if not exit_codes:
+        return False
+    allowed = {tok.strip() for tok in restarting_exit_code.split(",") if tok.strip()}
+    return all(str(code) in allowed for code in exit_codes)
+
+
+def effective_replicas(job: Any, rtype: str) -> int:
+    """Elastic width: the number of replicas currently provisioned.
+
+    Defaults to ``spec.replicas``; while elastically degraded the controller
+    records a narrower width in ``status.elastic_replicas`` (clamped to
+    [min_replicas, max_replicas]).  New semantics -- the reference never
+    resizes (SURVEY.md §2.6).
+    """
+    spec = job.spec.replica_specs[rtype]
+    desired = spec.replicas if spec.replicas is not None else 1
+    width = job.status.elastic_replicas.get(rtype, desired)
+    lo = spec.min_replicas if spec.min_replicas is not None else desired
+    hi = spec.max_replicas if spec.max_replicas is not None else desired
+    return max(min(width, hi), min(lo, hi), 0)

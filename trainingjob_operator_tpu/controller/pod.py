@@ -1,0 +1,490 @@
+"""Pod reconciler: per-replica-group reconcile, restart decisions, container
+inspection, rendezvous/TPU env injection.
+
+Reference: pkg/controller/pod.go (all of it).  The decision flow of
+``reconcile_pods``/``reconcile_containers`` mirrors pod.go:152-437; the env
+contract mirrors setEnv (pod.go:548-652) and adds the TPU/JAX bootstrap set
+(SURVEY.md §3.5 "TPU mapping").
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
+from trainingjob_operator_tpu.api.types import (
+    RestartPolicy,
+    RestartScope,
+    EndingPolicy,
+    TrainingJobPhase,
+    TPUTrainingJob,
+)
+from trainingjob_operator_tpu.client.expectations import pods_key
+from trainingjob_operator_tpu.client.tracker import meta_namespace_key
+from trainingjob_operator_tpu.controller.naming import (
+    effective_replicas,
+    filter_for_replica_type,
+    gen_general_name,
+    gen_labels,
+    get_slices,
+    is_retryable_exit_code,
+)
+from trainingjob_operator_tpu.controller.service import get_ports_from_container, get_ports_from_job
+from trainingjob_operator_tpu.core.objects import (
+    Condition,
+    ConditionStatus,
+    EnvVar,
+    Pod,
+    PodConditionType,
+    PodPhase,
+)
+from trainingjob_operator_tpu.utils.events import EventRecorder
+
+log = logging.getLogger("trainingjob.pod")
+
+
+class PodReconciler:
+    """Mixin for TrainingJobController (reference: pod.go methods)."""
+
+    # -- informer handlers (reference: pod.go:23-123) ------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        if pod.metadata.deletion_timestamp is not None:
+            return
+        job = self._resolve_controller_ref(pod.metadata.namespace,
+                                           pod.metadata.controller_of())
+        if job is None:
+            return
+        rt = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+        if rt is None:
+            return
+        self.expectations.creation_observed(pods_key(meta_namespace_key(job), rt))
+        self.work_queue.add(meta_namespace_key(job))
+
+    def update_pod(self, old: Pod, cur: Pod) -> None:
+        if old.metadata.resource_version == cur.metadata.resource_version:
+            return
+        job = self._resolve_controller_ref(cur.metadata.namespace,
+                                           cur.metadata.controller_of())
+        if job is None:
+            return
+        self.enqueue_job(job)
+
+    def delete_pod(self, pod: Pod) -> None:
+        job = self._resolve_controller_ref(pod.metadata.namespace,
+                                           pod.metadata.controller_of())
+        if job is None:
+            return
+        rt = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+        if rt is None:
+            return
+        self.expectations.deletion_observed(pods_key(meta_namespace_key(job), rt))
+        self.work_queue.add(meta_namespace_key(job))
+
+    # -- claiming (reference: pod.go:125-150) --------------------------------
+
+    def get_pods_by_job(self, job: TPUTrainingJob, selector: Dict[str, str]) -> List[Pod]:
+        all_pods = self.pod_lister.list(job.namespace, selector)
+        return self._claim_pods(job, all_pods)
+
+    def _claim_pods(self, job: TPUTrainingJob, pods: List[Pod]) -> List[Pod]:
+        """Keep pods controlled by this job; adopt matching orphans (the
+        ControllerRefManager's essential behavior, pod.go:134-150)."""
+        claimed = []
+        for pod in pods:
+            ref = pod.metadata.controller_of()
+            if ref is not None:
+                if ref.uid == job.metadata.uid:
+                    claimed.append(pod)
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            # Orphan with matching selector: adopt.
+            from trainingjob_operator_tpu.controller.control import gen_owner_reference
+            pod.metadata.owner_references.append(gen_owner_reference(job))
+            try:
+                claimed.append(self.clientset.pods.update(pod))
+            except Exception:
+                log.warning("failed to adopt pod %s", pod.name, exc_info=True)
+        return claimed
+
+    # -- the per-replica-group reconcile (reference: pod.go:152-326) ---------
+
+    def reconcile_pods(self, job: TPUTrainingJob, pods: List[Pod],
+                       rtype: str) -> Tuple[str, str]:
+        """Returns (ending_phase, message); ending_phase "" means live."""
+        if job.status.phase == TrainingJobPhase.TERMINATING:
+            return TrainingJobPhase.TERMINATING, ""
+        # Preemption API: external actor annotates the CR (pod.go:160-165).
+        msg = job.metadata.annotations.get(TrainingJobPhase.PREEMPTED)
+        if msg is not None:
+            return TrainingJobPhase.PREEMPTED, msg
+        msg = job.metadata.annotations.get(TrainingJobPhase.FAILED)
+        if msg is not None:
+            return TrainingJobPhase.FAILED, msg
+
+        rt = rtype.lower()
+        spec = job.spec.replica_specs[rtype]
+        replica_pods = filter_for_replica_type(pods, rt)
+        replicas = effective_replicas(job, rtype)
+        self._initialize_replica_status(job, rtype)
+        self._initialize_restart_counts(job, rtype)
+
+        pod_slices = get_slices(replica_pods, replicas)
+        node_ready = self.get_node_status()
+        message = ""
+        failed_reasons: List[str] = []
+        failed_phase = TrainingJobPhase.FAILED
+        creating_msgs: Dict[str, List[str]] = {}
+
+        for index, pod_slice in enumerate(pod_slices):
+            if not pod_slice:
+                log.info("creating pod %s/%s %s-%d", job.namespace, job.name, rt, index)
+                self.create_new_pod(job, rt, str(index),
+                                    str(job.status.restart_counts.get(rtype, 0)), spec)
+                continue
+
+            pod = pod_slice[0]
+            sched_msg = self.get_pod_scheduling_message(pod)
+            if sched_msg:
+                message = f"{rt}: {sched_msg} "
+            phase, is_restart, cmsg = self.reconcile_containers(job, pod, rtype, node_ready)
+            if cmsg:
+                failed_reasons.append(cmsg)
+
+            if is_restart:
+                limit = spec.restart_limit
+                if limit is None or job.status.restart_counts.get(rtype, 0) < limit:
+                    ending = self._restart_pods(job, rtype, rt, pod, pods, pod_slices,
+                                                phase, cmsg)
+                    if ending:
+                        self._recount_replica_status(job, rtype, replica_pods)
+                        return ending
+
+            if phase == TrainingJobPhase.CREATING:
+                creating_msgs.setdefault(cmsg, []).append(pod.name)
+
+            # Per-pod ending policies (pod.go:260-287).
+            if (phase == TrainingJobPhase.SUCCEEDED
+                    and pod.status.phase == PodPhase.SUCCEEDED
+                    and spec.complete_policy == EndingPolicy.ANY):
+                return TrainingJobPhase.SUCCEEDED, f"pod {pod.name} have completed"
+            if (phase in (TrainingJobPhase.FAILED, TrainingJobPhase.NODE_FAIL)
+                    and spec.fail_policy == EndingPolicy.ANY):
+                return phase, f"pod {pod.name} is failed, {cmsg}"
+            if index == 0:
+                if (phase == TrainingJobPhase.SUCCEEDED
+                        and pod.status.phase == PodPhase.SUCCEEDED
+                        and spec.complete_policy == EndingPolicy.RANK0):
+                    return TrainingJobPhase.SUCCEEDED, f"rank0 pod {pod.name} have completed"
+                if (phase in (TrainingJobPhase.FAILED, TrainingJobPhase.NODE_FAIL)
+                        and spec.fail_policy == EndingPolicy.RANK0):
+                    return phase, f"rank0 pod {pod.name} is failed, {cmsg}"
+
+            if phase == TrainingJobPhase.NODE_FAIL:
+                failed_phase = TrainingJobPhase.NODE_FAIL
+
+        self._recount_replica_status(job, rtype, replica_pods)
+        rs = job.status.replica_statuses[rtype]
+
+        # Whole-group ending policies (pod.go:298-315).
+        if spec.complete_policy == EndingPolicy.ALL and rs.succeeded == replicas:
+            return TrainingJobPhase.SUCCEEDED, f"All {rtype} pods have completed"
+        if spec.fail_policy == EndingPolicy.ALL and rs.failed == replicas:
+            if failed_reasons:
+                message = ", ".join(failed_reasons)
+            return failed_phase, f"All {rtype} pods are failed, {message}"
+
+        if creating_msgs:
+            msgs = [f"pods {pods_} {m}" for m, pods_ in creating_msgs.items()]
+            return TrainingJobPhase.NONE, ", ".join(msgs)
+        return TrainingJobPhase.NONE, message
+
+    def _restart_pods(self, job: TPUTrainingJob, rtype: str, rt: str, pod: Pod,
+                      all_pods: List[Pod], pod_slices: List[List[Pod]],
+                      phase: str, msg: str) -> Optional[Tuple[str, str]]:
+        """Delete pods per RestartScope; NodeFail forces grace=0
+        (reference: pod.go:208-250)."""
+        force = phase == TrainingJobPhase.NODE_FAIL
+        grace = 0 if force else None
+        self._update_restart_count(job, rtype)
+        msg = f"restart times is {job.status.restart_counts.get(rtype, 0)}, {msg} "
+        spec = job.spec.replica_specs[rtype]
+        scope = spec.restart_scope
+        self.recorder.event(job, EventRecorder.WARNING, constants.RESTARTING_REASON,
+                            f"restarting scope={scope} trigger={pod.name}: {msg}")
+        if scope == RestartScope.POD:
+            self.pod_control.delete_pod(pod.namespace, pod.name, job, grace_period=grace)
+            return TrainingJobPhase.RESTARTING, msg
+        if scope == RestartScope.REPLICA:
+            for pslice in pod_slices:
+                for p in pslice:
+                    self.pod_control.delete_pod(p.namespace, p.name, job, grace_period=grace)
+            return TrainingJobPhase.RESTARTING, msg
+        # RestartScope.ALL
+        for p in all_pods:
+            self.pod_control.delete_pod(p.namespace, p.name, job, grace_period=grace)
+        return TrainingJobPhase.RESTARTING, msg
+
+    # -- container inspection (reference: pod.go:328-437) --------------------
+
+    def reconcile_containers(self, job: TPUTrainingJob, pod: Pod, rtype: str,
+                             node_ready: Dict[str, bool]) -> Tuple[str, bool, str]:
+        """Returns (phase, is_restart, message); phase "" means running/live."""
+        spec = job.spec.replica_specs[rtype]
+        exit_codes: List[int] = []
+        failed_reasons: List[str] = []
+        is_restart = False
+        is_succeeded = True
+        is_creating = False
+
+        for status in pod.status.container_statuses:
+            state = status.state
+            if status.name.startswith(constants.CONTAINER_PREFIX):
+                is_succeeded = is_succeeded and state.terminated
+                if state.terminated:
+                    code = state.terminated_exit_code or 0
+                    is_succeeded = is_succeeded and code == 0
+                    exit_codes.append(code)
+                    if code != 0:
+                        failed_reasons.append(
+                            f"container {status.name} on node {pod.spec.node_name} "
+                            f"exited with reason {state.terminated_reason} exitcode {code}")
+            if state.waiting:
+                is_creating = True
+                if state.waiting_reason in constants.ERROR_CONTAINER_STATUS:
+                    # Creation-failure backoff (pod.go:355-378).
+                    ending = self._check_creating_failure(job, pod, state.waiting_reason)
+                    if ending == "restart":
+                        is_restart = True
+                    elif ending == "fail":
+                        return (TrainingJobPhase.FAILED, is_restart,
+                                f"pod {pod.name} create container failed"
+                                f"[{state.waiting_reason}] and has been retrying for "
+                                f"{self.options.creating_restart_time} seconds")
+                    failed_reasons.append(state.waiting_reason)
+
+        restarting_exit_code = job.spec.restarting_exit_code
+
+        if pod.status.phase == PodPhase.FAILED:
+            # Restart policy evaluation on pod failure (pod.go:385-405).
+            if (spec.restart_policy in (RestartPolicy.EXIT_CODE,
+                                        RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE)
+                    and is_retryable_exit_code(exit_codes, restarting_exit_code)):
+                is_restart = True
+            elif spec.restart_policy in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS):
+                is_restart = True
+            if failed_reasons:
+                message = "; ".join(failed_reasons)
+            elif pod.status.reason:
+                message = pod.status.reason
+                if pod.status.message:
+                    message = f"{pod.status.reason}, {pod.status.message}"
+            else:
+                message = ""
+            return TrainingJobPhase.FAILED, is_restart, message
+
+        if pod.spec.node_name and pod.spec.node_name not in node_ready:
+            # Node-failure detection (pod.go:407-419).
+            if spec.restart_policy in (RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE,
+                                       RestartPolicy.ON_NODE_FAIL,
+                                       RestartPolicy.ALWAYS):
+                is_restart = True
+            return (TrainingJobPhase.NODE_FAIL, is_restart,
+                    f"Node {pod.spec.node_name} is failed and offline")
+
+        if is_creating:
+            if failed_reasons:
+                return TrainingJobPhase.CREATING, is_restart, "; ".join(failed_reasons)
+            return TrainingJobPhase.CREATING, is_restart, "creating containers"
+        if is_succeeded:
+            return TrainingJobPhase.SUCCEEDED, is_restart, ""
+        return TrainingJobPhase.NONE, is_restart, ""
+
+    def _check_creating_failure(self, job: TPUTrainingJob, pod: Pod,
+                                reason: str) -> str:
+        """'', 'restart' or 'fail' (reference: pod.go:355-378)."""
+        creating = self._get_condition(job.status, TrainingJobPhase.CREATING)
+        if creating is None or creating.status != ConditionStatus.TRUE:
+            return ""
+        now = time.time()
+        since_creating = now - (creating.last_transition_time or now)
+        if since_creating < self.options.creating_restart_time:
+            started = pod.status.start_time or now
+            if now - started > self.options.creating_duration_time:
+                log.warning("pod %s create container failed: %s", pod.name, reason)
+                return "restart"
+        elif self.options.enable_creating_failed:
+            return "fail"
+        return ""
+
+    # -- node health (reference: pod.go:439-455, via informer per SURVEY §8) -
+
+    def get_node_status(self) -> Dict[str, bool]:
+        return {node.name: True for node in self.node_lister.list()
+                if node.is_ready()}
+
+    def get_pod_scheduling_message(self, pod: Pod) -> str:
+        """Reference: pod.go:457-467."""
+        if pod.status.phase == PodPhase.PENDING and not pod.spec.node_name:
+            for cond in pod.status.conditions:
+                if (cond.type == PodConditionType.SCHEDULED
+                        and cond.status == ConditionStatus.FALSE):
+                    return cond.message
+        return ""
+
+    # -- pod creation (reference: pod.go:483-546) ----------------------------
+
+    def create_new_pod(self, job: TPUTrainingJob, rt: str, index: str,
+                       restart_count: str, spec: Any) -> None:
+        job_key = meta_namespace_key(job)
+        self.expectations.expect_creations(pods_key(job_key, rt), 1)
+
+        labels = gen_labels(job.name)
+        labels["JobName"] = job.name
+        labels[constants.POD_ROLE_LABEL] = rt
+        labels[constants.RESTART_COUNT_LABEL] = restart_count
+        labels[constants.REPLICA_NAME_LABEL] = rt
+        labels[constants.REPLICA_INDEX_LABEL] = index
+        if job.spec.priority:
+            labels[constants.PRIORITY_LABEL] = job.spec.priority
+
+        template = copy.deepcopy(spec.template)
+        pod = Pod(metadata=template.metadata, spec=template.spec)
+        pod.metadata.name = gen_general_name(job.name, rt, index)
+        pod.metadata.generate_name = gen_general_name(job.name, rt, "")
+        pod.metadata.namespace = job.namespace
+        for k, v in labels.items():
+            pod.metadata.labels[k] = v
+        for k, v in job.metadata.labels.items():
+            pod.metadata.labels.setdefault(k, v)
+
+        if job.spec.scheduler_name:
+            pod.spec.scheduler_name = job.spec.scheduler_name
+
+        self.set_env(pod, job, spec, rt, index, restart_count)
+        self.set_tpu_provisioning(pod, job, spec, rt, index)
+
+        if spec.restart_policy:
+            # The job-level restart machinery owns restarts; the kubelet must
+            # not restart containers underneath it (pod.go:532-535).
+            pod.spec.restart_policy = "Never"
+
+        self.pod_control.create_pod(job.namespace, pod, job)
+
+    def force_delete_pod(self, namespace: str, name: str) -> None:
+        """Reference: pod.go:469-481 (grace 0)."""
+        try:
+            self.clientset.pods.delete(namespace, name, grace_period=0)
+        except KeyError:
+            pass
+
+    # -- env injection (reference: pod.go:548-652 + TPU mapping §3.5) --------
+
+    def set_env(self, pod: Pod, job: TPUTrainingJob, spec: Any, rtype: str,
+                index: str, restart_count: str) -> None:
+        hosts_env: List[EnvVar] = []
+        for rt_name in sorted(job.spec.replica_specs):
+            rt = rt_name.lower()
+            ports = get_ports_from_job(job, rt_name)
+            n = effective_replicas(job, rt_name)
+            instances = [f"{gen_general_name(job.name, rt, str(i))}.{job.namespace}"
+                         for i in range(n)]
+            hosts = [f"{name}:{port}" for name in instances for port in ports]
+            upper = rt.upper()
+            hosts_env += [
+                EnvVar(f"{upper}_INSTANCES", ",".join(instances)),
+                EnvVar(f"{upper}_INSTANCES_NUM", str(len(instances))),
+                EnvVar(f"{upper}_PORTS", ",".join(str(p) for p in ports)),
+                EnvVar(f"{upper}_PORTS_NUM", str(len(ports))),
+                EnvVar(f"{upper}_HOSTS", ",".join(hosts)),
+                EnvVar(f"{upper}_HOSTS_NUM", str(len(hosts))),
+            ]
+        hosts_env += [
+            EnvVar(constants.REPLICA_NAME_ENV, rtype),
+            EnvVar(constants.REPLICA_INDEX_ENV, index),
+            EnvVar(constants.REPLICA_RESTART_COUNT_ENV, restart_count),
+            EnvVar(constants.SERVICE_ENV,
+                   f"{gen_general_name(job.name, rtype, index)}.{job.namespace}"),
+            EnvVar(constants.JOB_NAME_ENV, job.name),
+            EnvVar(constants.JOB_NAMESPACE_ENV, job.namespace),
+        ]
+        hosts_env += self._jax_bootstrap_env(job, rtype, index)
+
+        for container in pod.spec.init_containers:
+            container.env.extend(copy.deepcopy(hosts_env))
+        for container in pod.spec.containers:
+            container.env.extend(copy.deepcopy(hosts_env))
+            container.env.append(
+                EnvVar(constants.PORTS_ENV,
+                       ",".join(get_ports_from_container(container))))
+
+    def _jax_bootstrap_env(self, job: TPUTrainingJob, rtype: str,
+                           index: str) -> List[EnvVar]:
+        """TPU-native rendezvous: worker identity + coordinator address for
+        ``jax.distributed.initialize`` (SURVEY.md §5.8)."""
+        rt_key = self._match_replica_key(job, rtype)
+        if rt_key is None:
+            return []
+        spec = job.spec.replica_specs[rt_key]
+        n = effective_replicas(job, rt_key)
+        ports = get_ports_from_job(job, rt_key)
+        coord_port = ports[0] if ports else constants.DEFAULT_COORDINATOR_PORT
+        instances = [f"{gen_general_name(job.name, rtype, str(i))}.{job.namespace}"
+                     for i in range(n)]
+        env = [
+            EnvVar(constants.NUM_PROCESSES_ENV, str(n)),
+            EnvVar(constants.PROCESS_ID_ENV, index),
+            EnvVar(constants.COORDINATOR_ADDRESS_ENV, f"{instances[0]}:{coord_port}"),
+            EnvVar(constants.TPU_WORKER_ID_ENV, index),
+            EnvVar(constants.TPU_WORKER_HOSTNAMES_ENV, ",".join(instances)),
+            EnvVar(constants.ELASTIC_REPLICAS_ENV, str(n)),
+            EnvVar(constants.CHECKPOINT_DIR_ENV,
+                   job.metadata.annotations.get(
+                       "checkpoint-dir", f"/tmp/tpu-trainingjob/{job.namespace}/{job.name}")),
+        ]
+        if spec.tpu is not None:
+            shape = resolve_slice_shape(spec.tpu)
+            env += [
+                EnvVar(constants.TPU_ACCELERATOR_ENV, shape.accelerator),
+                EnvVar(constants.TPU_TOPOLOGY_ENV, shape.topology),
+            ]
+            if spec.tpu.slice_count > 1:
+                # Multislice: DCN data-parallel across slices (megascale env).
+                slice_id = int(index) // shape.hosts
+                env += [
+                    EnvVar(constants.SLICE_ID_ENV, str(slice_id)),
+                    EnvVar(constants.NUM_SLICES_ENV, str(spec.tpu.slice_count)),
+                    EnvVar(constants.MEGASCALE_COORDINATOR_ENV,
+                           f"{instances[0]}:{constants.DEFAULT_COORDINATOR_PORT + 1}"),
+                ]
+        return env
+
+    def set_tpu_provisioning(self, pod: Pod, job: TPUTrainingJob, spec: Any,
+                             rt: str, index: str) -> None:
+        """GKE TPU nodeSelectors + google.com/tpu resources + gang labels."""
+        if spec.tpu is None:
+            return
+        shape = resolve_slice_shape(spec.tpu)
+        pod.spec.node_selector.update(shape.node_selectors(spec.tpu.preemptible))
+        for container in pod.spec.containers:
+            limits = container.resources.setdefault("limits", {})
+            requests = container.resources.setdefault("requests", {})
+            for k, v in shape.tpu_resources().items():
+                limits.setdefault(k, v)
+                requests.setdefault(k, v)
+        slice_id = int(index) // shape.hosts
+        pod.metadata.labels[constants.SLICE_ID_LABEL] = str(slice_id)
+        pod.metadata.labels[constants.GANG_LABEL] = gen_general_name(
+            job.name, rt, f"slice{slice_id}")
+
+    @staticmethod
+    def _match_replica_key(job: TPUTrainingJob, rt_lower: str) -> Optional[str]:
+        for key in job.spec.replica_specs:
+            if key.lower() == rt_lower:
+                return key
+        return None

@@ -1,0 +1,124 @@
+"""Service reconciler: per-index headless Services for stable DNS.
+
+Reference: pkg/controller/service.go -- port extraction from ``aitj-``-prefixed
+containers/ports (service.go:19-52), claim/adopt (service.go:90-115),
+create-if-missing per index (service.go:117-196).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.client.expectations import services_key
+from trainingjob_operator_tpu.client.tracker import meta_namespace_key
+from trainingjob_operator_tpu.controller.naming import (
+    effective_replicas,
+    filter_for_replica_type,
+    gen_general_name,
+    gen_labels,
+    get_slices,
+)
+from trainingjob_operator_tpu.core.objects import Container, Service, ServicePort, ServiceSpec
+
+log = logging.getLogger("trainingjob.service")
+
+
+def get_ports_from_job(job: TPUTrainingJob, rtype: str) -> List[int]:
+    """Ports of ``aitj-``-prefixed ports in ``aitj-``-prefixed containers
+    (reference: service.go:19-31)."""
+    ports: List[int] = []
+    for container in job.spec.replica_specs[rtype].template.spec.containers:
+        if container.name.startswith(constants.CONTAINER_PREFIX):
+            for port in container.ports:
+                if port.name.startswith(constants.PORT_PREFIX):
+                    ports.append(port.container_port)
+    return ports
+
+
+def get_ports_from_container(container: Container) -> List[str]:
+    """Reference: service.go:33-43."""
+    if not container.name.startswith(constants.CONTAINER_PREFIX):
+        return []
+    return [str(p.container_port) for p in container.ports
+            if p.name.startswith(constants.PORT_PREFIX)]
+
+
+def has_container_port(job: TPUTrainingJob, rtype: str) -> bool:
+    """Reference: service.go:45-52."""
+    return any(c.name.startswith(constants.CONTAINER_PREFIX)
+               for c in job.spec.replica_specs[rtype].template.spec.containers)
+
+
+class ServiceReconciler:
+    """Mixin for TrainingJobController (reference: service.go methods)."""
+
+    def add_service(self, service: Service) -> None:
+        """Reference: service.go:54-81."""
+        if service.metadata.deletion_timestamp is not None:
+            return
+        job = self._resolve_controller_ref(service.metadata.namespace,
+                                           service.metadata.controller_of())
+        if job is None:
+            return
+        rt = service.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+        if rt is None:
+            return
+        self.expectations.creation_observed(
+            services_key(meta_namespace_key(job), rt))
+        self.work_queue.add(meta_namespace_key(job))
+
+    # updateService/deleteService are empty stubs in the reference
+    # (service.go:83-88); a deleted service is recreated on the next sync via
+    # resync, so we enqueue on delete to converge faster.
+    def on_service_deleted(self, service: Service) -> None:
+        job = self._resolve_controller_ref(service.metadata.namespace,
+                                           service.metadata.controller_of())
+        if job is not None:
+            self.work_queue.add(meta_namespace_key(job))
+
+    def get_services_by_job(self, job: TPUTrainingJob,
+                            selector: Dict[str, str]) -> List[Service]:
+        all_services = self.service_lister.list(job.namespace, selector)
+        claimed = []
+        for svc in all_services:
+            ref = svc.metadata.controller_of()
+            if ref is not None and ref.uid == job.metadata.uid:
+                claimed.append(svc)
+        return claimed
+
+    def reconcile_services(self, job: TPUTrainingJob, services: List[Service],
+                           rtype: str) -> None:
+        """Reference: service.go:117-146."""
+        ports = get_ports_from_job(job, rtype)
+        rt = rtype.lower()
+        replicas = effective_replicas(job, rtype)
+        rt_services = filter_for_replica_type(services, rt)
+        service_slices = get_slices(rt_services, replicas)
+        for index, service_slice in enumerate(service_slices):
+            if not service_slice and has_container_port(job, rtype):
+                self.create_new_service(job, rtype, str(index), ports)
+
+    def create_new_service(self, job: TPUTrainingJob, rtype: str, index: str,
+                           ports: List[int]) -> None:
+        """Headless service selecting the one pod at (rtype, index)
+        (reference: service.go:148-196)."""
+        rt = rtype.lower()
+        self.expectations.expect_creations(
+            services_key(meta_namespace_key(job), rt), 1)
+        labels = gen_labels(job.name)
+        labels[constants.REPLICA_NAME_LABEL] = rt
+        labels[constants.REPLICA_INDEX_LABEL] = index
+        service = Service(
+            spec=ServiceSpec(
+                cluster_ip="None",
+                selector=dict(labels),
+                ports=[ServicePort(name=f"{constants.PORT_PREFIX}{p}", port=p)
+                       for p in ports],
+            ),
+        )
+        service.metadata.name = gen_general_name(job.name, rt, index)
+        service.metadata.labels = labels
+        self.service_control.create_service(job.namespace, service, job)

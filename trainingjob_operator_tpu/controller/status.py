@@ -1,0 +1,334 @@
+"""Status/phase machine: condition CRUD, job-level phase aggregation,
+restart-wait, ending arbitration, time limits, termination, write-back.
+
+Reference: pkg/controller/status.go (all of it).  Fixed vs. the reference
+(SURVEY.md §8): restart-count initialization covers every replica type
+(status.go:315-320 only zeroed the first when the map was nil), and the
+write-back goes through the status client method rather than whole-object
+Update.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ENDING_PHASES,
+    EndingPolicy,
+    PHASE_REASON,
+    ReplicaStatus,
+    RestartScope,
+    TrainingJobPhase,
+    TPUTrainingJob,
+    is_failed_phase,
+)
+from trainingjob_operator_tpu.client.tracker import ConflictError
+from trainingjob_operator_tpu.controller.naming import (
+    effective_replicas,
+    filter_for_replica_type,
+)
+from trainingjob_operator_tpu.core.objects import (
+    Condition,
+    ConditionStatus,
+    Pod,
+    PodPhase,
+    Service,
+)
+from trainingjob_operator_tpu.utils.events import EventRecorder
+
+log = logging.getLogger("trainingjob.status")
+
+
+def new_condition(ctype: str, reason: str, message: str) -> Condition:
+    """Reference: newTrainingJobCondition (status.go:13-22)."""
+    now = time.time()
+    return Condition(type=ctype, status=ConditionStatus.TRUE, reason=reason,
+                     message=message, last_probe_time=now, last_transition_time=now)
+
+
+def get_condition(status, ctype: str) -> Optional[Condition]:
+    """Reference: getTrainingJobCondition (status.go:24-31)."""
+    for cond in status.conditions:
+        if cond.type == ctype:
+            return cond
+    return None
+
+
+def is_job_completed(status) -> bool:
+    """Reference: isJobCompleted (status.go:33-58)."""
+    for ctype in (TrainingJobPhase.SUCCEEDED, TrainingJobPhase.FAILED,
+                  TrainingJobPhase.PREEMPTED, TrainingJobPhase.TIMEOUT):
+        cond = get_condition(status, ctype)
+        if cond is not None and cond.status == ConditionStatus.TRUE:
+            return True
+    return False
+
+
+def set_condition(status, new_cond: Condition) -> None:
+    """Append-or-refresh; the latest condition is authoritative and older ones
+    flip to False (reference: setTrainingJobCondition, status.go:60-75)."""
+    if status.conditions:
+        curr = status.conditions[-1]
+        if (curr.type == new_cond.type and curr.status == new_cond.status
+                and curr.reason == new_cond.reason):
+            curr.message = new_cond.message
+            curr.last_probe_time = new_cond.last_probe_time
+            return
+        curr.status = ConditionStatus.FALSE
+    status.conditions.append(new_cond)
+
+
+def update_job_conditions(job: TPUTrainingJob, ctype: str, reason: str,
+                          message: str) -> None:
+    """Reference: updateTrainingJobConditions (status.go:77-87)."""
+    if is_job_completed(job.status):
+        return
+    set_condition(job.status, new_condition(ctype, reason, message))
+    job.status.phase = ctype
+
+
+class StatusManager:
+    """Mixin for TrainingJobController (reference: status.go methods)."""
+
+    # -- small helpers shared with the pod reconciler ------------------------
+
+    @staticmethod
+    def _get_condition(status, ctype: str) -> Optional[Condition]:
+        return get_condition(status, ctype)
+
+    @staticmethod
+    def _initialize_replica_status(job: TPUTrainingJob, rtype: str) -> None:
+        """Reference: initializeTrainingJobReplicaStatuses (status.go:307-313)."""
+        job.status.replica_statuses[rtype] = ReplicaStatus()
+
+    @staticmethod
+    def _initialize_restart_counts(job: TPUTrainingJob, rtype: str) -> None:
+        """Fixed version of initializeTrainingJobRestartCountes
+        (status.go:315-320): always ensure the key exists."""
+        job.status.restart_counts.setdefault(rtype, 0)
+
+    @staticmethod
+    def _update_restart_count(job: TPUTrainingJob, rtype: str) -> None:
+        """Reference: updateRestartCount (status.go:322-330)."""
+        if job.spec.replica_specs[rtype].restart_scope == RestartScope.ALL:
+            for rt in job.spec.replica_specs:
+                job.status.restart_counts[rt] = job.status.restart_counts.get(rt, 0) + 1
+        else:
+            job.status.restart_counts[rtype] = job.status.restart_counts.get(rtype, 0) + 1
+
+    @staticmethod
+    def _recount_replica_status(job: TPUTrainingJob, rtype: str,
+                                pods: List[Pod]) -> None:
+        """Reset-and-recount from live pods (reference:
+        updateTrainingJobReplicaStatuses, status.go:332-359)."""
+        rs = job.status.replica_statuses.setdefault(rtype, ReplicaStatus())
+        rs.reset()
+        restarted = job.status.restart_counts.get(rtype, 0) > 0
+        for pod in pods:
+            phase = pod.status.phase
+            if phase == PodPhase.PENDING:
+                if restarted:
+                    rs.restarting += 1
+                elif pod.spec.node_name:
+                    rs.scheduled += 1
+                else:
+                    rs.pending += 1
+            elif phase == PodPhase.RUNNING:
+                rs.active += 1
+            elif phase == PodPhase.SUCCEEDED:
+                rs.succeeded += 1
+            else:  # Failed / Unknown
+                rs.failed += 1
+
+    # -- the job-level aggregation (reference: updateStatus, status.go:101) --
+
+    def update_status(self, job: TPUTrainingJob, pods: List[Pod],
+                      services: List[Service],
+                      ending_phases: Dict[str, str], message: str) -> None:
+        for rtype in job.spec.replica_specs:
+            self._initialize_replica_status(job, rtype)
+            rt_pods = filter_for_replica_type(pods, rtype.lower())
+            self._recount_replica_status(job, rtype, rt_pods)
+
+        # Two-phase restart: wait for the scope's pods to drain, then flip to
+        # Restarting and clear the marker (status.go:114-143).
+        if job.status.restart_replica_name:
+            rname = job.status.restart_replica_name
+            spec = job.spec.replica_specs.get(rname)
+            if spec is None:
+                job.status.restart_replica_name = ""
+                return
+            scope = spec.restart_scope
+            rt_pods = filter_for_replica_type(pods, rname.lower())
+            replicas = effective_replicas(job, rname)
+            if scope == RestartScope.ALL and len(pods) == 0:
+                update_job_conditions(job, TrainingJobPhase.RESTARTING,
+                                      PHASE_REASON[TrainingJobPhase.RESTARTING],
+                                      "All pods are restarting now")
+                job.status.restart_replica_name = ""
+            elif scope == RestartScope.REPLICA and len(rt_pods) == 0:
+                update_job_conditions(job, TrainingJobPhase.RESTARTING,
+                                      PHASE_REASON[TrainingJobPhase.RESTARTING],
+                                      f"{rname.lower()} pods are restarting now")
+                job.status.restart_replica_name = ""
+            elif scope == RestartScope.POD and len(rt_pods) < replicas:
+                update_job_conditions(job, TrainingJobPhase.RESTARTING,
+                                      PHASE_REASON[TrainingJobPhase.RESTARTING],
+                                      "pod is restarting now")
+                job.status.restart_replica_name = ""
+            return
+
+        now = time.time()
+        spec = job.spec
+        completed = sum(1 for p in ending_phases.values()
+                        if p == TrainingJobPhase.SUCCEEDED)
+        failed = 0
+        ending_phase = TrainingJobPhase.NONE
+        for p in ending_phases.values():
+            if is_failed_phase(p):
+                failed += 1
+                ending_phase = p
+        replica_count = len(spec.replica_specs)
+
+        # CompletePolicy beats FailPolicy (status.go:159-174).
+        if spec.complete_policy == EndingPolicy.ANY and completed > 0:
+            self.terminate_trainingjob(job, pods, services,
+                                       TrainingJobPhase.SUCCEEDED,
+                                       f"job {job.name} completed")
+            return
+        if spec.complete_policy == EndingPolicy.ALL and completed == replica_count:
+            self.terminate_trainingjob(job, pods, services,
+                                       TrainingJobPhase.SUCCEEDED,
+                                       f"job {job.name} completed")
+            return
+        if spec.fail_policy == EndingPolicy.ANY and failed > 0:
+            self.terminate_trainingjob(job, pods, services, ending_phase, message)
+            return
+        if spec.fail_policy == EndingPolicy.ALL and failed == replica_count:
+            self.terminate_trainingjob(job, pods, services, ending_phase, message)
+            return
+
+        # Deferred ending: phase stashed in an annotation until pods drain
+        # (status.go:176-187).
+        for phase in ENDING_PHASES:
+            msg = job.metadata.annotations.get(phase)
+            if msg is not None:
+                if len(pods) == 0:
+                    job.status.end_time = now
+                    update_job_conditions(job, phase, PHASE_REASON[phase],
+                                          f"{msg}; deleted pods")
+                else:
+                    self.enqueue_job(job, rate_limited=True)
+                return
+
+        # Time limit (status.go:189-198).
+        if (spec.time_limit is not None and job.status.start_running_time is not None
+                and now - job.status.start_running_time >= spec.time_limit):
+            self.terminate_trainingjob(
+                job, pods, services, TrainingJobPhase.TIMEOUT,
+                f"started at {job.status.start_running_time}, current time is "
+                f"{now}, timeLimit is {spec.time_limit} second")
+            return
+
+        # Live phase classification from counters (status.go:200-244).
+        is_scheduled = True
+        is_creating = False
+        is_running = True
+        is_restarting = False
+        for rtype in spec.replica_specs:
+            replicas = effective_replicas(job, rtype)
+            rs = job.status.replica_statuses[rtype]
+            is_scheduled = is_scheduled and (
+                rs.scheduled + rs.active + rs.succeeded + rs.failed
+                + rs.restarting == replicas)
+            is_creating = is_creating or rs.scheduled > 0
+            is_restarting = is_restarting or rs.restarting > 0
+            is_running = is_running and replicas == rs.active
+
+        if job.status.phase != TrainingJobPhase.RUNNING and is_running:
+            if job.status.start_running_time is None:
+                job.status.start_running_time = now
+            update_job_conditions(job, TrainingJobPhase.RUNNING,
+                                  constants.RUNNING_REASON, "all pods are running")
+
+        if (is_creating and is_scheduled
+                and job.status.phase != TrainingJobPhase.RESTARTING):
+            update_job_conditions(job, TrainingJobPhase.CREATING,
+                                  constants.CREATING_REASON, message)
+
+        if is_restarting and job.status.phase != TrainingJobPhase.RESTARTING:
+            update_job_conditions(job, TrainingJobPhase.RESTARTING,
+                                  constants.RESTARTING_REASON, message)
+
+        if (not is_scheduled and not is_restarting
+                and job.status.phase != TrainingJobPhase.RESTARTING):
+            if job.status.start_time is None:
+                job.status.start_time = now
+            update_job_conditions(job, TrainingJobPhase.PENDING,
+                                  constants.PENDING_REASON,
+                                  "all pods are waiting for scheduling")
+
+        # Arm a delayed re-sync at the time-limit expiry (status.go:246-252).
+        if spec.time_limit is not None and job.status.start_running_time is not None:
+            remaining = spec.time_limit - (now - job.status.start_running_time)
+            self.enqueue_job(job, delay=max(remaining, 0.0))
+
+    # -- termination (reference: terminateTrainingJob, status.go:256-283) ----
+
+    def terminate_trainingjob(self, job: TPUTrainingJob, pods: List[Pod],
+                              services: List[Service], ending_phase: str,
+                              message: str) -> None:
+        clean = job.spec.clean_pod_policy
+        if ((clean is None or clean == CleanPodPolicy.NONE)
+                and ending_phase in (TrainingJobPhase.SUCCEEDED,
+                                     TrainingJobPhase.FAILED)):
+            update_job_conditions(job, ending_phase, PHASE_REASON[ending_phase],
+                                  f"{message}; kept pods")
+            if job.status.end_time is None:
+                job.status.end_time = time.time()
+            return
+        job.metadata.annotations[ending_phase] = message
+        self.delete_pods_and_services(job, pods, services)
+        update_job_conditions(job, TrainingJobPhase.TERMINATING,
+                              PHASE_REASON[TrainingJobPhase.TERMINATING],
+                              f"{message}; deleting pods")
+
+    def delete_pods_and_services(self, job: TPUTrainingJob, pods: List[Pod],
+                                 services: List[Service]) -> None:
+        """Reference: deletePodsAndServices (trainingjob.go:53-73)."""
+        if not pods:
+            return
+        for pod in pods:
+            self.pod_control.delete_pod(pod.namespace, pod.name, job)
+        for svc in services:
+            self.service_control.delete_service(svc.namespace, svc.name, job)
+
+    # -- write-back (reference: updateTrainingJobPhase, status.go:285-305) ---
+
+    def update_trainingjob_phase(self, job: TPUTrainingJob) -> None:
+        last_err: Optional[Exception] = None
+        for attempt in range(5):
+            try:
+                self.clientset.trainingjobs.update_status(job)
+                return
+            except ConflictError as e:
+                last_err = e
+                fresh = self.trainingjob_lister.try_get(job.namespace, job.name)
+                if fresh is None:
+                    continue
+                fresh.status = job.status
+                # Merge, fresh-wins: keep annotations the controller stashed
+                # (ending-phase markers) without erasing concurrently-written
+                # external ones like the Preempted request (pod.go:160-165) --
+                # the reference overwrote wholesale here (status.go:300-302).
+                fresh.metadata.annotations = {**job.metadata.annotations,
+                                              **fresh.metadata.annotations}
+                job = fresh
+            except KeyError:
+                return  # job deleted under us
+        log.error("update job phase %s failed after retries: %s",
+                  job.status.phase, last_err)

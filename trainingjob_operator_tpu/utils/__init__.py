@@ -1,0 +1,5 @@
+"""Process utilities: signals, events, leader election, logging.
+
+Reference: pkg/signals/ plus the client-go record/leaderelection machinery the
+cmd layer wires up (cmd/app/server.go:85-106,153-157).
+"""

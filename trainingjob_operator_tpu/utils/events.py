@@ -1,0 +1,72 @@
+"""Event recorder: durable, queryable action trail.
+
+Reference: the client-go event broadcaster/recorder wired in
+NewTrainingJobController (controller.go:88-102) so create/delete actions
+surface in ``kubectl describe`` (README.md:17).  Events are stored as first-
+class objects through the clientset, so tests and the CLI can assert on them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from collections import deque
+from typing import Any
+
+from trainingjob_operator_tpu.core.objects import Event, ObjectMeta, now
+
+log = logging.getLogger("trainingjob.events")
+
+_seq = itertools.count()
+
+
+class EventRecorder:
+    NORMAL = "Normal"
+    WARNING = "Warning"
+
+    #: Retention cap: oldest events are pruned past this (k8s expires events
+    #: after ~1 h; a crash-looping job must not grow the store unboundedly).
+    MAX_EVENTS = 2000
+
+    def __init__(self, clientset: Any, component: str):
+        self._cs = clientset
+        self._component = component
+        self._created: "deque[tuple[str, str]]" = deque()
+
+    def event(self, obj: Any, etype: str, reason: str, message: str) -> None:
+        meta = obj.metadata
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{meta.name}.{next(_seq):06d}",
+                namespace=meta.namespace or "default",
+            ),
+            involved_kind=obj.KIND,
+            involved_name=meta.name,
+            involved_namespace=meta.namespace,
+            type=etype,
+            reason=reason,
+            message=message,
+            source=self._component,
+            timestamp=now(),
+        )
+        log.log(logging.WARNING if etype == self.WARNING else logging.INFO,
+                "%s %s %s/%s: %s", etype, reason, meta.namespace, meta.name, message)
+        try:
+            self._cs.events.create(ev)
+            self._created.append((ev.namespace, ev.name))
+            while len(self._created) > self.MAX_EVENTS:
+                old_ns, old_name = self._created.popleft()
+                try:
+                    self._cs.events.delete(old_ns, old_name)
+                except KeyError:
+                    pass
+        except Exception:  # events are best-effort, never fail the caller
+            log.exception("failed to record event")
+
+
+class NullRecorder(EventRecorder):
+    def __init__(self):
+        pass
+
+    def event(self, obj: Any, etype: str, reason: str, message: str) -> None:
+        pass
