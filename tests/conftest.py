@@ -15,3 +15,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import time as _time
+
+
+def wait_for(pred, timeout=15.0, interval=0.02):
+    """Poll until pred() is truthy; shared by the e2e suites."""
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        if pred():
+            return True
+        _time.sleep(interval)
+    return False

@@ -1,0 +1,156 @@
+"""End-to-end tests: controller + local-process runtime (real subprocesses)."""
+
+import sys
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
+
+
+from conftest import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    rt = LocalProcRuntime(cs, nodes=2, log_dir=str(tmp_path),
+                          termination_grace=0.5)
+    rt.start()
+    tc.run(workers=2)
+    yield cs, tc, rt
+    tc.stop()
+    rt.stop()
+
+
+def proc_job(name, code, replicas=1, port=7701, **replica_kw) -> TPUTrainingJob:
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.spec.replica_specs["worker"] = ReplicaSpec(
+        replicas=replicas,
+        template=PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="aitj-w",
+                      command=[sys.executable, "-u", "-c", code],
+                      ports=[ContainerPort(name=f"aitj-{port}", container_port=port)])])),
+        **replica_kw)
+    return job
+
+
+def phase(cs, name):
+    return cs.trainingjobs.get("default", name).status.phase
+
+
+class TestLocalProc:
+    def test_subprocess_job_completes(self, cluster):
+        cs, tc, rt = cluster
+        cs.trainingjobs.create(proc_job("ok", "import time; time.sleep(0.2)"))
+        assert wait_for(lambda: phase(cs, "ok") == TrainingJobPhase.SUCCEEDED), \
+            phase(cs, "ok")
+
+    def test_subprocess_failure_fails_job(self, cluster):
+        cs, tc, rt = cluster
+        cs.trainingjobs.create(proc_job("bad", "raise SystemExit(3)"))
+        assert wait_for(lambda: phase(cs, "bad") == TrainingJobPhase.FAILED), \
+            phase(cs, "bad")
+
+    def test_env_identity_reaches_process(self, cluster, tmp_path):
+        cs, tc, rt = cluster
+        out = tmp_path / "env.txt"
+        code = (
+            "import os\n"
+            f"open({str(out)!r}, 'w').write('|'.join([\n"
+            "  os.environ['TRAININGJOB_REPLICA_NAME'],\n"
+            "  os.environ['TRAININGJOB_REPLICA_INDEX'],\n"
+            "  os.environ['WORKER_INSTANCES_NUM'],\n"
+            "  os.environ['TRAININGJOB_COORDINATOR_ADDRESS'],\n"
+            "]))\n")
+        cs.trainingjobs.create(proc_job("envjob", code))
+        assert wait_for(lambda: phase(cs, "envjob") == TrainingJobPhase.SUCCEEDED)
+        rname, rindex, num, coord = out.read_text().split("|")
+        assert (rname, rindex, num) == ("worker", "0", "1")
+        # Cluster DNS rewritten to a concrete local address.
+        assert coord.startswith("127.0.0.1:")
+
+    def test_rendezvous_over_mapped_ports(self, cluster):
+        """Rank 0 binds its mapped port; rank 1 connects through the same
+        mapping -- the local analogue of headless-service DNS."""
+        cs, tc, rt = cluster
+        code = (
+            "import os, socket, time\n"
+            "addr = os.environ['TRAININGJOB_COORDINATOR_ADDRESS']\n"
+            "host, port = addr.split(':'); port = int(port)\n"
+            "rank = int(os.environ['TRAININGJOB_REPLICA_INDEX'])\n"
+            "if rank == 0:\n"
+            "    s = socket.socket(); s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+            "    s.bind(('127.0.0.1', port)); s.listen(1)\n"
+            "    conn, _ = s.accept()\n"
+            "    assert conn.recv(5) == b'hello'\n"
+            "else:\n"
+            "    for _ in range(100):\n"
+            "        try:\n"
+            "            c = socket.create_connection((host, port), timeout=0.2); break\n"
+            "        except OSError: time.sleep(0.1)\n"
+            "    else: raise SystemExit(9)\n"
+            "    c.sendall(b'hello')\n")
+        cs.trainingjobs.create(proc_job("rdv", code, replicas=2))
+        assert wait_for(lambda: phase(cs, "rdv") == TrainingJobPhase.SUCCEEDED, 20), \
+            phase(cs, "rdv")
+
+    def test_preemption_restart_recovers(self, cluster):
+        cs, tc, rt = cluster
+        job = proc_job("longrun", "import time; time.sleep(60)", replicas=2,
+                       restart_policy=RestartPolicy.EXIT_CODE,
+                       restart_scope=RestartScope.ALL)
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+        assert wait_for(lambda: phase(cs, "longrun") == TrainingJobPhase.RUNNING)
+        rt.preempt_pod("default", "longrun-worker-1")  # SIGKILL -> 137
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", "longrun").status.restart_counts.get("worker", 0) == 1,
+            10)
+        assert wait_for(lambda: phase(cs, "longrun") == TrainingJobPhase.RUNNING, 15), \
+            phase(cs, "longrun")
+        assert all(p.metadata.labels[constants.RESTART_COUNT_LABEL] == "1"
+                   for p in cs.pods.list("default"))
+
+    def test_node_fail_after_preempt_relaunches_same_name_pods(self, cluster):
+        """Regression: a force-deleted pod recreated with the same name must
+        get a fresh process (runtime state is per-UID, not per-name)."""
+        cs, tc, rt = cluster
+        job = proc_job("nf", "import time; time.sleep(60)", replicas=2,
+                       restart_policy=RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE,
+                       restart_scope=RestartScope.ALL)
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+        assert wait_for(lambda: phase(cs, "nf") == TrainingJobPhase.RUNNING)
+        rt.preempt_pod("default", "nf-worker-0")
+        assert wait_for(
+            lambda: phase(cs, "nf") == TrainingJobPhase.RUNNING
+            and all(p.metadata.labels[constants.RESTART_COUNT_LABEL] == "1"
+                    for p in cs.pods.list("default")), 15)
+        victim = sorted({p.spec.node_name for p in cs.pods.list("default")})[0]
+        rt.fail_node(victim)
+        assert wait_for(
+            lambda: phase(cs, "nf") == TrainingJobPhase.RUNNING
+            and len(cs.pods.list("default")) == 2
+            and all(p.metadata.labels[constants.RESTART_COUNT_LABEL] == "2"
+                    and p.spec.node_name != victim
+                    for p in cs.pods.list("default")), 20), phase(cs, "nf")

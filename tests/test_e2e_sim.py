@@ -1,0 +1,159 @@
+"""End-to-end tests: controller + sim runtime (scheduler/kubelet), all
+asynchronous -- nothing drives sync_handler by hand.
+
+This is test-pyramid level (3) (SURVEY.md §4): job lifecycles against a fake
+"TPU slice" cluster with fault injection (preemption, node failure).
+"""
+
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    EndingPolicy,
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUSpec,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from trainingjob_operator_tpu.runtime.sim import (
+    EXIT_CODE_ANNOTATION,
+    RUN_SECONDS_ANNOTATION,
+    SimRuntime,
+)
+
+
+from conftest import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def cluster():
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.start()
+    tc.run(workers=2)
+    yield cs, tc, sim
+    tc.stop()
+    sim.stop()
+
+
+def sim_job(name="job", replicas=2, run_seconds="0.2", exit_code="0",
+            **replica_kw) -> TPUTrainingJob:
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace="default"))
+    template = PodTemplateSpec(
+        metadata=ObjectMeta(annotations={RUN_SECONDS_ANNOTATION: run_seconds,
+                                         EXIT_CODE_ANNOTATION: exit_code}),
+        spec=PodSpec(containers=[
+            Container(name="aitj-main",
+                      ports=[ContainerPort(name="aitj-7777", container_port=7777)])]))
+    job.spec.replica_specs["trainer"] = ReplicaSpec(
+        replicas=replicas, template=template, **replica_kw)
+    return job
+
+
+def phase(cs, name="job"):
+    return cs.trainingjobs.get("default", name).status.phase
+
+
+class TestLifecycle:
+    def test_job_runs_to_completion(self, cluster):
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        cs.trainingjobs.create(sim_job(run_seconds="0.15"))
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.RUNNING, 5), phase(cs)
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.SUCCEEDED, 5), phase(cs)
+        # CleanPodPolicy All: pods drained.
+        assert wait_for(lambda: cs.pods.list("default") == [], 2)
+
+    def test_failing_job_fails(self, cluster):
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        cs.trainingjobs.create(sim_job(run_seconds="0.1", exit_code="1"))
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.FAILED, 5), phase(cs)
+
+    def test_unschedulable_stays_pending(self, cluster):
+        cs, tc, sim = cluster  # no nodes
+        cs.trainingjobs.create(sim_job())
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.PENDING, 5), phase(cs)
+        time.sleep(0.2)
+        assert phase(cs) == TrainingJobPhase.PENDING
+
+
+class TestFaultTolerance:
+    def test_preemption_recovery_via_exit_code(self, cluster):
+        """Spot-reclaim path: pod killed with 137, policy retries it."""
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        job = sim_job(run_seconds="30", restart_policy=RestartPolicy.EXIT_CODE,
+                      restart_scope=RestartScope.ALL)
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.RUNNING, 5), phase(cs)
+        sim.preempt_pod("default", "job-trainer-1", exit_code=137)
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", "job").status.restart_counts.get("trainer", 0) == 1,
+            5)
+        # Job recovers to Running with fresh pods.
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.RUNNING, 10), phase(cs)
+        pods = cs.pods.list("default")
+        assert len(pods) == 2
+        assert all(p.metadata.labels[constants.RESTART_COUNT_LABEL] == "1"
+                   for p in pods)
+
+    def test_node_failure_recovery(self, cluster):
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        sim.add_node("n1")
+        job = sim_job(run_seconds="30",
+                      restart_policy=RestartPolicy.ON_NODE_FAIL,
+                      restart_scope=RestartScope.ALL)
+        cs.trainingjobs.create(job)
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.RUNNING, 5), phase(cs)
+        victim = cs.pods.get("default", "job-trainer-0").spec.node_name
+        sim.fail_node(victim)
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", "job").status.restart_counts.get("trainer", 0) >= 1,
+            5)
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.RUNNING, 10), phase(cs)
+        # All pods now on the surviving node.
+        for p in cs.pods.list("default"):
+            assert p.spec.node_name != victim
+
+
+class TestTPUGang:
+    def test_gang_all_or_nothing(self, cluster):
+        cs, tc, sim = cluster
+        # One TPU node with 4 chips: a 2-host slice (2 pods x 4 chips) cannot
+        # fit -- neither pod may be placed.
+        sim.add_node("tpu-0", labels={
+            constants.GKE_TPU_ACCELERATOR_SELECTOR: "tpu-v5-lite-podslice",
+            constants.GKE_TPU_TOPOLOGY_SELECTOR: "2x4",
+        }, tpu_chips=4)
+        job = sim_job(replicas=2, run_seconds="0.3")
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(
+            accelerator="tpu-v5-lite-podslice", topology="2x4")
+        cs.trainingjobs.create(job)
+        assert wait_for(lambda: len(cs.pods.list("default")) == 2, 5)
+        time.sleep(0.3)
+        assert all(not p.spec.node_name for p in cs.pods.list("default"))
+        assert phase(cs) == TrainingJobPhase.PENDING
+        # Second TPU host arrives: now the whole gang places and completes.
+        sim.add_node("tpu-1", labels={
+            constants.GKE_TPU_ACCELERATOR_SELECTOR: "tpu-v5-lite-podslice",
+            constants.GKE_TPU_TOPOLOGY_SELECTOR: "2x4",
+        }, tpu_chips=4)
+        assert wait_for(lambda: phase(cs) == TrainingJobPhase.SUCCEEDED, 10), phase(cs)

@@ -604,6 +604,18 @@ class Node:
         )
 
 
+def set_node_readiness(clientset: Any, name: str, ready: bool) -> None:
+    """Flip a node's Ready condition through its client (shared by the
+    runtimes' fault-injection paths)."""
+    node = clientset.nodes.get_node(name)
+    node.status.conditions = [Condition(
+        type=NodeConditionType.READY,
+        status=ConditionStatus.TRUE if ready else ConditionStatus.FALSE,
+        last_transition_time=now(),
+    )]
+    clientset.nodes.update(node)
+
+
 def make_ready_node(name: str, ready: bool = True, labels: Optional[Dict[str, str]] = None,
                     capacity: Optional[Dict[str, Any]] = None) -> Node:
     """Convenience constructor used by the sim runtime and tests."""

@@ -1,0 +1,13 @@
+"""Cluster runtime backends.
+
+The control plane talks to a ``Clientset``; a runtime is what makes the
+objects *behave*: schedule pods onto nodes, run their containers, report
+status, honor graceful deletion.
+
+- ``sim``       -- in-process simulated kubelet+scheduler (tests, bench,
+                   fault injection).
+- ``localproc`` -- pods are real subprocesses on this machine (end-to-end
+                   JAX workloads without a cluster).
+- ``kube``      -- adapter to a real Kubernetes cluster (gated on the
+                   ``kubernetes`` package being installed).
+"""

@@ -1,0 +1,83 @@
+"""Real-Kubernetes backend (gated on the ``kubernetes`` package).
+
+Reference: the reference operator talks to a real apiserver through generated
+clients (pkg/client/) and self-creates its CRD (controller.go:210-234).  This
+module provides:
+
+- ``crd_manifest()`` -- a structural-schema CRD manifest (the modern form of
+  the reference's schema-less v1beta1 self-creation, SURVEY.md §8), always
+  available for ``kubectl apply``.
+- ``KubeClientset`` -- an adapter with the same surface as
+  ``client.Clientset``, backed by the kubernetes Python client.  Importing it
+  without the package installed raises a clear error; the rest of the
+  framework never imports this module unless ``--backend kube`` is requested.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from trainingjob_operator_tpu.api import constants
+
+
+def kubernetes_available() -> bool:
+    try:
+        import kubernetes  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def crd_manifest() -> Dict[str, Any]:
+    """Structural CRD for TPUTrainingJob (apply with kubectl or via
+    KubeClientset.ensure_crd)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{constants.KIND_PLURAL}.{constants.GROUP_NAME}"},
+        "spec": {
+            "group": constants.GROUP_NAME,
+            "scope": "Namespaced",
+            "names": {
+                "kind": constants.KIND,
+                "plural": constants.KIND_PLURAL,
+                "singular": constants.KIND.lower(),
+                "shortNames": [constants.SHORT_NAME],
+            },
+            "versions": [{
+                "name": constants.GROUP_VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "x-kubernetes-preserve-unknown-fields": True},
+                        "status": {"type": "object",
+                                   "x-kubernetes-preserve-unknown-fields": True},
+                    },
+                }},
+            }],
+        },
+    }
+
+
+class KubeClientset:
+    """Clientset-compatible adapter over the kubernetes Python client.
+
+    Objects cross the boundary as dicts via the dataclasses' to_dict/from_dict,
+    so the controller code is identical against sim and real clusters.
+    """
+
+    def __init__(self, kubeconfig: Optional[str] = None, master_url: str = "",
+                 in_cluster: bool = False):
+        if not kubernetes_available():
+            raise ImportError(
+                "the 'kubernetes' package is not installed; the kube backend "
+                "is unavailable in this environment (use --backend sim or "
+                "localproc, or export manifests via runtime.kube.crd_manifest)")
+        raise NotImplementedError(
+            "KubeClientset CRUD adapters land with the kube backend milestone; "
+            "this build targets the sim and localproc backends")

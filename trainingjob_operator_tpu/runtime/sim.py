@@ -1,0 +1,311 @@
+"""Simulated kubelet + scheduler: makes the in-memory cluster behave.
+
+The reference operator assumes a real cluster underneath (kube-scheduler
+assigns nodes, kubelets run containers and report status).  This module is
+that substrate for the in-memory backend: a background loop that
+
+- schedules Pending pods onto Ready nodes honoring ``node_selector`` and
+  ``google.com/tpu`` chip capacity (gang-aware: a TPU gang label is placed
+  all-or-nothing, the atomicity requirement of SURVEY.md §7 "hard parts" (a)),
+- walks pods through Pending -> Running -> Succeeded/Failed using the
+  ``sim.tpu.trainingjob.dev/*`` annotations as the "program",
+- honors graceful deletion (finalizer -> SIGTERM analogue -> finalize), and
+- exposes fault injection: fail/recover nodes, preempt pods, flip capacity --
+  the knobs SURVEY.md §4 says the reference exercises operationally
+  (delete pods / mark nodes NotReady / set the Preempted annotation).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.tracker import NotFoundError
+from trainingjob_operator_tpu.core.objects import (
+    Condition,
+    ConditionStatus,
+    ContainerState,
+    ContainerStatus,
+    Pod,
+    PodConditionType,
+    PodPhase,
+    make_ready_node,
+    set_node_readiness,
+)
+
+log = logging.getLogger("trainingjob.sim")
+
+#: Pod annotations that script the simulated workload.
+RUN_SECONDS_ANNOTATION = "sim.tpu.trainingjob.dev/run-seconds"
+EXIT_CODE_ANNOTATION = "sim.tpu.trainingjob.dev/exit-code"
+START_DELAY_ANNOTATION = "sim.tpu.trainingjob.dev/start-delay"
+
+
+@dataclass
+class _PodRuntime:
+    uid: str = ""
+    scheduled_at: float = 0.0
+    started_at: float = 0.0
+    will_exit_at: Optional[float] = None
+    exit_code: int = 0
+    terminating_since: Optional[float] = None
+    frozen_on: str = ""  # node whose failure froze this pod's reports
+
+
+class SimRuntime:
+    """Drives pod/node behavior against a Clientset-backed tracker."""
+
+    def __init__(self, clientset: Clientset,
+                 start_delay: float = 0.0,
+                 tick: float = 0.005,
+                 termination_grace: float = 0.05,
+                 pods_per_node: int = 64):
+        self._cs = clientset
+        self._tick = tick
+        self._start_delay = start_delay
+        self._termination_grace = termination_grace
+        self._pods_per_node = pods_per_node
+        self._state: Dict[str, _PodRuntime] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        clientset.tracker.register_finalizer(Pod.KIND, self._on_terminating)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="sim-kubelet")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- cluster setup / fault injection -------------------------------------
+
+    def add_node(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 tpu_chips: int = 0) -> None:
+        capacity = {constants.TPU_RESOURCE: tpu_chips} if tpu_chips else {}
+        self._cs.nodes.create(make_ready_node(name, labels=labels, capacity=capacity))
+
+    def set_node_ready(self, name: str, ready: bool) -> None:
+        set_node_readiness(self._cs, name, ready)
+
+    def fail_node(self, name: str, kill_pods: bool = True) -> None:
+        """Node goes NotReady; its pods stop reporting (like a dead TPU-VM
+        host).  Pod objects linger -- exactly the state the controller's
+        NodeFail detector must handle (pod.go:407-419)."""
+        self.set_node_ready(name, False)
+        if kill_pods:
+            with self._lock:
+                for key, rt in self._state.items():
+                    ns, pod_name = key.split("/", 1)
+                    try:
+                        pod = self._cs.pods.get(ns, pod_name)
+                    except NotFoundError:
+                        continue
+                    if pod.spec.node_name == name:
+                        rt.will_exit_at = None  # frozen: no further reports
+                        rt.frozen_on = name
+
+    def recover_node(self, name: str) -> None:
+        """Node comes back Ready.  Pods whose processes were frozen by
+        fail_node are reported dead (exit 137), like a recovering kubelet
+        reporting its containers gone."""
+        self.set_node_ready(name, True)
+        with self._lock:
+            for rt in self._state.values():
+                if rt.frozen_on == name:
+                    rt.will_exit_at = time.time()
+                    rt.exit_code = 137
+                    rt.frozen_on = ""
+
+    def preempt_pod(self, namespace: str, name: str, exit_code: int = 137) -> None:
+        """SIGKILL analogue: container dies with the given code now."""
+        with self._lock:
+            rt = self._state.get(f"{namespace}/{name}")
+            if rt is not None:
+                rt.will_exit_at = time.time()
+                rt.exit_code = exit_code
+
+    # -- internals -----------------------------------------------------------
+
+    def _on_terminating(self, pod: Pod) -> None:
+        with self._lock:
+            rt = self._state.setdefault(f"{pod.namespace}/{pod.name}",
+                                        _PodRuntime(uid=pod.metadata.uid))
+            if not rt.uid:
+                rt.uid = pod.metadata.uid
+            rt.terminating_since = time.time()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._tick):
+            try:
+                self._reconcile_once()
+            except Exception:
+                log.exception("sim loop error")
+
+    def _reconcile_once(self) -> None:
+        now = time.time()
+        nodes = {n.name: n for n in self._cs.nodes.list()}
+        pods = self._cs.pods.list()
+
+        # node -> usage
+        pod_count: Dict[str, int] = {}
+        tpu_used: Dict[str, int] = {}
+        for pod in pods:
+            if pod.spec.node_name:
+                pod_count[pod.spec.node_name] = pod_count.get(pod.spec.node_name, 0) + 1
+                tpu_used[pod.spec.node_name] = (tpu_used.get(pod.spec.node_name, 0)
+                                                + self._pod_tpu_request(pod))
+
+        # Gang-aware scheduling: group pending pods by gang label; a gang is
+        # placed only if every member fits simultaneously.
+        pending = [p for p in pods
+                   if p.status.phase == PodPhase.PENDING and not p.spec.node_name
+                   and p.metadata.deletion_timestamp is None]
+        gangs: Dict[str, list] = {}
+        for pod in pending:
+            gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
+            gangs.setdefault(gang, []).append(pod)
+        for gang_pods in gangs.values():
+            self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
+
+        # Walk running/scheduled pods through their lifecycle.
+        # Reap state for vanished pods (force delete bypasses the finalizer).
+        existing = {f"{p.namespace}/{p.name}" for p in pods}
+        with self._lock:
+            for k in [k for k in self._state if k not in existing]:
+                self._state.pop(k, None)
+
+        for pod in pods:
+            key = f"{pod.namespace}/{pod.name}"
+            with self._lock:
+                rt = self._state.setdefault(key, _PodRuntime(uid=pod.metadata.uid))
+                if rt.uid != pod.metadata.uid:
+                    # Same name, new incarnation: reset runtime state.
+                    rt = _PodRuntime(uid=pod.metadata.uid)
+                    self._state[key] = rt
+
+            if pod.metadata.deletion_timestamp is not None:
+                if (rt.terminating_since is not None
+                        and now - rt.terminating_since >= self._termination_grace):
+                    self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
+                    with self._lock:
+                        self._state.pop(key, None)
+                continue
+
+            node = nodes.get(pod.spec.node_name) if pod.spec.node_name else None
+            if node is None or not node.is_ready():
+                continue  # unscheduled or dead node: no kubelet reports
+
+            changed = False
+            if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
+                if rt.scheduled_at == 0.0:
+                    rt.scheduled_at = now
+                delay = float(pod.metadata.annotations.get(
+                    START_DELAY_ANNOTATION, self._start_delay))
+                if now - rt.scheduled_at >= delay:
+                    pod.status.phase = PodPhase.RUNNING
+                    pod.status.start_time = now
+                    pod.status.container_statuses = [
+                        ContainerStatus(name=c.name,
+                                        state=ContainerState(running_started_at=now))
+                        for c in pod.spec.containers]
+                    rt.started_at = now
+                    run_s = pod.metadata.annotations.get(RUN_SECONDS_ANNOTATION)
+                    if run_s is not None and rt.will_exit_at is None:
+                        rt.will_exit_at = now + float(run_s)
+                        rt.exit_code = int(pod.metadata.annotations.get(
+                            EXIT_CODE_ANNOTATION, "0"))
+                    changed = True
+
+            elif (pod.status.phase == PodPhase.RUNNING
+                  and rt.will_exit_at is not None and now >= rt.will_exit_at):
+                code = rt.exit_code
+                pod.status.phase = (PodPhase.SUCCEEDED if code == 0
+                                    else PodPhase.FAILED)
+                pod.status.container_statuses = [
+                    ContainerStatus(name=c.name,
+                                    state=ContainerState(
+                                        terminated_exit_code=code,
+                                        terminated_reason="Completed" if code == 0 else "Error"))
+                    for c in pod.spec.containers]
+                rt.will_exit_at = None
+                changed = True
+
+            if changed:
+                self._try_update_pod(pod)
+
+    def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
+        placements = []
+        for pod in gang_pods:
+            placed = False
+            for node in nodes.values():
+                if not node.is_ready():
+                    continue
+                if not self._selector_matches(pod, node):
+                    continue
+                if pod_count.get(node.name, 0) >= self._pods_per_node:
+                    continue
+                req = self._pod_tpu_request(pod)
+                cap = int(node.status.capacity.get(constants.TPU_RESOURCE, 0))
+                if req > 0 and tpu_used.get(node.name, 0) + req > cap:
+                    continue
+                placements.append((pod, node.name, req))
+                pod_count[node.name] = pod_count.get(node.name, 0) + 1
+                tpu_used[node.name] = tpu_used.get(node.name, 0) + req
+                placed = True
+                break
+            if not placed:
+                # Whole gang stays pending (all-or-nothing); roll back.
+                for p, n, req in placements:
+                    pod_count[n] -= 1
+                    tpu_used[n] -= req
+                for p in gang_pods:
+                    self._mark_unschedulable(p)
+                return
+        for pod, node_name, _ in placements:
+            pod.spec.node_name = node_name
+            pod.status.conditions = [Condition(
+                type=PodConditionType.SCHEDULED, status=ConditionStatus.TRUE,
+                last_transition_time=time.time())]
+            self._try_update_pod(pod)
+
+    def _mark_unschedulable(self, pod: Pod) -> None:
+        msg = "0/? nodes available: insufficient capacity"
+        for cond in pod.status.conditions:
+            if cond.type == PodConditionType.SCHEDULED:
+                if cond.status == ConditionStatus.FALSE and cond.message == msg:
+                    return
+        pod.status.conditions = [Condition(
+            type=PodConditionType.SCHEDULED, status=ConditionStatus.FALSE,
+            reason="Unschedulable", message=msg,
+            last_transition_time=time.time())]
+        self._try_update_pod(pod)
+
+    @staticmethod
+    def _selector_matches(pod: Pod, node) -> bool:
+        return all(node.metadata.labels.get(k) == v
+                   for k, v in pod.spec.node_selector.items())
+
+    @staticmethod
+    def _pod_tpu_request(pod: Pod) -> int:
+        total = 0
+        for c in pod.spec.containers:
+            total += int((c.resources.get("requests") or {}).get(
+                constants.TPU_RESOURCE, 0))
+        return total
+
+    def _try_update_pod(self, pod: Pod) -> None:
+        try:
+            self._cs.pods.update(pod)
+        except Exception:
+            pass  # conflict: re-observed next tick
