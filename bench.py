@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: elastic recovery p50 (preempt -> Running).
+
+The north-star metric (BASELINE.json): after a worker is preempted
+(SIGKILLed, spot-reclaim analogue), how long until the job is fully Running
+again -- restart machinery fired, replacement pods created, scheduled and
+running.  Target: < 90 s.  The reference publishes no numbers (BASELINE.md);
+vs_baseline is the 90 s target divided by our p50 (>1 = beating the target).
+
+Runs the REAL control plane end-to-end: threaded controller + local-process
+runtime with actual worker subprocesses, repeated preemption trials.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
+
+TRIALS = 9
+WORKERS = 4
+
+
+def wait_for(pred, timeout=60.0, interval=0.005):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def fully_running(cs, name, expect_restarts):
+    job = cs.trainingjobs.get("default", name)
+    if job.status.phase != TrainingJobPhase.RUNNING:
+        return False
+    pods = cs.pods.list("default")
+    if len(pods) != WORKERS:
+        return False
+    return all(
+        p.metadata.labels.get(constants.RESTART_COUNT_LABEL) == str(expect_restarts)
+        and p.status.phase == "Running"
+        for p in pods)
+
+
+def main() -> int:
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    rt = LocalProcRuntime(cs, nodes=2, termination_grace=1.0,
+                          log_dir="/tmp/tpu-trainingjob-bench-logs")
+    rt.start()
+    tc.run(workers=2)
+
+    job = TPUTrainingJob(metadata=ObjectMeta(name="bench", namespace="default"))
+    job.spec.replica_specs["worker"] = ReplicaSpec(
+        replicas=WORKERS,
+        restart_policy=RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE,
+        restart_scope=RestartScope.ALL,
+        template=PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="aitj-worker",
+                      command=[sys.executable, "-c", "import time; time.sleep(600)"],
+                      ports=[ContainerPort(name="aitj-7900", container_port=7900)])])))
+    job.spec.restarting_exit_code = "137,143"
+    cs.trainingjobs.create(job)
+
+    samples = []
+    ok = wait_for(lambda: fully_running(cs, "bench", 0), timeout=60)
+    if not ok:
+        print(json.dumps({"metric": "elastic_recovery_p50", "value": None,
+                          "unit": "s", "vs_baseline": None,
+                          "error": "job never reached Running"}))
+        return 1
+
+    for trial in range(TRIALS):
+        victim = f"bench-worker-{trial % WORKERS}"
+        t0 = time.time()
+        rt.preempt_pod("default", victim)
+        if not wait_for(lambda: fully_running(cs, "bench", trial + 1), timeout=60):
+            continue
+        samples.append(time.time() - t0)
+
+    tc.stop()
+    rt.stop()
+
+    if not samples:
+        print(json.dumps({"metric": "elastic_recovery_p50", "value": None,
+                          "unit": "s", "vs_baseline": None,
+                          "error": "no successful recovery trials"}))
+        return 1
+
+    p50 = statistics.median(samples)
+    print(json.dumps({
+        "metric": "elastic_recovery_p50",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(90.0 / p50, 1),
+        "samples": [round(s, 4) for s in samples],
+        "trials": TRIALS,
+        "workers": WORKERS,
+        "note": "preempt (SIGKILL) -> job fully Running again; real controller"
+                " + subprocess workers; reference target <90s (BASELINE.md)",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

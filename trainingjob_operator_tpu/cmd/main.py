@@ -1,0 +1,126 @@
+"""Operator entrypoint.
+
+Reference: cmd/main.go:11-23 + cmd/app/server.go:26-109 -- parse flags, build
+clients/informers/controller, optionally leader-elect, run until signaled.
+
+Usage:
+    python -m trainingjob_operator_tpu.cmd.main --backend localproc \\
+        --apply examples/mnist-cpu.yaml --watch
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from typing import Optional
+
+from trainingjob_operator_tpu.api.types import ENDING_PHASES, TPUTrainingJob
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import TrainingJobController
+from trainingjob_operator_tpu.utils.leader import LeaderElector
+from trainingjob_operator_tpu.utils.signals import setup_signal_handler
+
+log = logging.getLogger("trainingjob.main")
+
+
+def build_runtime(opt: OperatorOptions, clientset: Clientset, args):
+    if opt.backend == "sim":
+        from trainingjob_operator_tpu.runtime.sim import SimRuntime
+
+        rt = SimRuntime(clientset)
+        for i in range(args.nodes):
+            rt.add_node(f"sim-{i}")
+        return rt
+    if opt.backend == "localproc":
+        from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
+
+        return LocalProcRuntime(clientset, nodes=args.nodes)
+    if opt.backend == "kube":
+        from trainingjob_operator_tpu.runtime.kube import KubeClientset  # noqa: F401
+
+        raise SystemExit("kube backend: install the kubernetes package and "
+                         "apply runtime.kube.crd_manifest(); CRUD adapter "
+                         "lands in a later milestone")
+    raise SystemExit(f"unknown backend {opt.backend!r}")
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser("tpu-trainingjob-operator")
+    OperatorOptions.add_flags(parser)
+    parser.add_argument("--apply", action="append", default=[],
+                        help="YAML manifest(s) to create after startup.")
+    parser.add_argument("--watch", action="store_true",
+                        help="Print job phase transitions; exit when applied "
+                             "jobs reach an ending phase.")
+    parser.add_argument("--nodes", type=int, default=2,
+                        help="Virtual node count for sim/localproc backends.")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+    opt = OperatorOptions.from_args(args)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose >= 2 else
+        logging.INFO if args.verbose == 1 else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    stop = setup_signal_handler()
+    clientset = Clientset()
+    runtime = build_runtime(opt, clientset, args)
+    controller = TrainingJobController(clientset, options=opt)
+
+    def run_operator():
+        runtime.start()
+        controller.run()
+        applied = []
+        for path in args.apply:
+            with open(path) as f:
+                job = TPUTrainingJob.from_yaml(f.read())
+            clientset.trainingjobs.create(job)
+            applied.append((job.namespace, job.name))
+            print(f"created {job.namespace}/{job.name}")
+        try:
+            if args.watch and applied:
+                _watch(clientset, applied, stop)
+            else:
+                stop.wait()
+        finally:
+            controller.stop()
+            runtime.stop()
+
+    if opt.leader_election.leader_elect:
+        LeaderElector(opt.leader_election).run(run_operator, stop=stop)
+    else:
+        run_operator()
+    return 0
+
+
+def _watch(clientset: Clientset, applied, stop) -> None:
+    last = {}
+    while not stop.is_set():
+        done = 0
+        for ns, name in applied:
+            try:
+                job = clientset.trainingjobs.get(ns, name)
+            except KeyError:
+                continue
+            phase = job.status.phase
+            if last.get((ns, name)) != phase:
+                last[(ns, name)] = phase
+                counts = {r: s.to_dict() for r, s in job.status.replica_statuses.items()}
+                print(f"[{time.strftime('%H:%M:%S')}] {ns}/{name}: "
+                      f"{phase or '(none)'} {counts}")
+            if phase in ENDING_PHASES:
+                done += 1
+        if done == len(applied):
+            for ns, name in applied:
+                print(f"final: {ns}/{name} -> "
+                      f"{clientset.trainingjobs.get(ns, name).status.phase}")
+            return
+        stop.wait(0.1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Shared kubelet-loop machinery for pod-running backends.
+
+Both runtimes (sim, localproc) need the same skeleton: a background tick loop,
+a per-pod state map that survives ticks but not pod incarnations (keyed by
+namespace/name, reset when the UID changes -- a force-deleted pod recreated
+under the same name is a NEW pod), reaping of state for vanished pods, the
+graceful-deletion finalizer hookup, and conflict-tolerant status writes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.tracker import ConflictError, NotFoundError
+from trainingjob_operator_tpu.core.objects import Pod
+
+log = logging.getLogger("trainingjob.runtime")
+
+
+class PodStateRuntime:
+    """Base for runtimes that track per-pod state across ticks.
+
+    Subclasses provide ``_new_state(uid)`` and ``_reconcile_once()`` and may
+    override ``_on_state_discarded(state)`` to release resources (e.g. kill a
+    process) when a pod vanishes or is replaced by a new incarnation.
+    """
+
+    thread_name = "runtime"
+
+    def __init__(self, clientset: Clientset, tick: float):
+        self._cs = clientset
+        self._tick = tick
+        self._state: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        clientset.tracker.register_finalizer(Pod.KIND, self._on_terminating)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.thread_name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._tick):
+            try:
+                self._reconcile_once()
+            except Exception:
+                log.exception("%s loop error", self.thread_name)
+
+    # -- per-pod state map ----------------------------------------------------
+
+    def _new_state(self, uid: str) -> Any:
+        raise NotImplementedError
+
+    def _reconcile_once(self) -> None:
+        raise NotImplementedError
+
+    def _on_state_discarded(self, state: Any) -> None:
+        """Release resources held by a discarded state entry."""
+
+    def _on_terminating(self, pod: Pod) -> None:
+        """Graceful-delete finalizer: record when termination began."""
+        with self._lock:
+            state = self._state.setdefault(f"{pod.namespace}/{pod.name}",
+                                           self._new_state(pod.metadata.uid))
+            if not state.uid:
+                state.uid = pod.metadata.uid
+            state.terminating_since = time.time()
+        self._signal_terminating(state)
+
+    def _signal_terminating(self, state: Any) -> None:
+        """Hook: deliver the SIGTERM analogue."""
+
+    def _pod_states(self, pods: List[Pod]) -> Iterable[Tuple[Pod, Any]]:
+        """Pair each pod with its state entry; reap vanished pods' state and
+        reset entries whose pod was replaced by a new incarnation."""
+        existing = {f"{p.namespace}/{p.name}" for p in pods}
+        with self._lock:
+            stale = [k for k in self._state if k not in existing]
+            discarded = [self._state.pop(k) for k in stale]
+        for state in discarded:
+            self._on_state_discarded(state)
+
+        for pod in pods:
+            key = f"{pod.namespace}/{pod.name}"
+            with self._lock:
+                state = self._state.setdefault(key, self._new_state(pod.metadata.uid))
+                if state.uid != pod.metadata.uid:
+                    old = state
+                    state = self._new_state(pod.metadata.uid)
+                    self._state[key] = state
+                else:
+                    old = None
+            if old is not None:
+                self._on_state_discarded(old)
+            yield pod, state
+
+    def _drop_state(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._state.pop(f"{namespace}/{name}", None)
+
+    # -- status writes --------------------------------------------------------
+
+    def _try_update_pod(self, pod: Pod) -> bool:
+        """Write pod status; False on conflict/not-found (caller retries next
+        tick against a fresh snapshot)."""
+        try:
+            self._cs.pods.update(pod)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+        except Exception:
+            log.exception("pod status update failed for %s", pod.name)
+            return False

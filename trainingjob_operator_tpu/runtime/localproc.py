@@ -23,16 +23,13 @@ import re
 import signal
 import socket
 import subprocess
-import sys
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
-from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.client.clientset import Clientset
-from trainingjob_operator_tpu.client.tracker import NotFoundError
 from trainingjob_operator_tpu.core.objects import (
     Condition,
     ConditionStatus,
@@ -44,9 +41,9 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
 log = logging.getLogger("trainingjob.localproc")
-
 
 _port_cursor = [23000 + (os.getpid() % 200) * 50]
 _port_lock = threading.Lock()
@@ -85,24 +82,34 @@ class _Proc:
     sigkill_sent: bool = False
 
 
-class LocalProcRuntime:
+class LocalProcRuntime(PodStateRuntime):
     """Subprocess-backed kubelet for a Clientset-backed tracker."""
+
+    thread_name = "localproc-kubelet"
 
     def __init__(self, clientset: Clientset, nodes: int = 1,
                  log_dir: Optional[str] = None, tick: float = 0.02,
                  termination_grace: float = 2.0):
-        self._cs = clientset
-        self._tick = tick
+        super().__init__(clientset, tick)
         self._grace = termination_grace
         self._log_dir = Path(log_dir or "/tmp/tpu-trainingjob-logs")
         self._log_dir.mkdir(parents=True, exist_ok=True)
-        self._procs: Dict[str, _Proc] = {}
         self._port_map: Dict[Tuple[str, str], int] = {}
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._node_names = [f"local-{i}" for i in range(nodes)]
-        clientset.tracker.register_finalizer(Pod.KIND, self._on_terminating)
+
+    def _new_state(self, uid: str) -> _Proc:
+        return _Proc(uid=uid)
+
+    def _on_state_discarded(self, proc: _Proc) -> None:
+        if proc.popen is not None and proc.popen.poll() is None:
+            proc.popen.kill()
+
+    def _signal_terminating(self, proc: _Proc) -> None:
+        if proc.popen is not None and proc.popen.poll() is None:
+            try:
+                proc.popen.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -112,16 +119,12 @@ class LocalProcRuntime:
                 self._cs.nodes.create(make_ready_node(name))
             except Exception:
                 pass
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="localproc-kubelet")
-        self._thread.start()
+        super().start()
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=3)
+        super().stop()
         with self._lock:
-            procs = list(self._procs.values())
+            procs = list(self._state.values())
         for proc in procs:
             if proc.popen is not None and proc.popen.poll() is None:
                 proc.popen.kill()
@@ -131,15 +134,15 @@ class LocalProcRuntime:
     def preempt_pod(self, namespace: str, name: str) -> None:
         """SIGKILL the pod's process (spot reclaim analogue)."""
         with self._lock:
-            proc = self._procs.get(f"{namespace}/{name}")
+            proc = self._state.get(f"{namespace}/{name}")
         if proc is not None and proc.popen is not None and proc.popen.poll() is None:
             proc.popen.kill()
 
     def fail_node(self, node: str) -> None:
         """Kill every pod process on the node and mark it NotReady."""
         with self._lock:
-            victims = [(k, p) for k, p in self._procs.items() if p.node == node]
-        for _, proc in victims:
+            victims = [p for p in self._state.values() if p.node == node]
+        for proc in victims:
             if proc.popen is not None and proc.popen.poll() is None:
                 proc.popen.kill()
         set_node_readiness(self._cs, node, False)
@@ -152,19 +155,6 @@ class LocalProcRuntime:
         return f"127.0.0.1:{self._mapped_port(f'{service_name}.{namespace}', str(port))}"
 
     # -- internals -----------------------------------------------------------
-
-    def _on_terminating(self, pod: Pod) -> None:
-        with self._lock:
-            proc = self._procs.setdefault(f"{pod.namespace}/{pod.name}",
-                                          _Proc(uid=pod.metadata.uid))
-            if not proc.uid:
-                proc.uid = pod.metadata.uid
-            proc.terminating_since = time.time()
-        if proc.popen is not None and proc.popen.poll() is None:
-            try:
-                proc.popen.send_signal(signal.SIGTERM)
-            except ProcessLookupError:
-                pass
 
     def _mapped_port(self, host: str, port: str) -> int:
         with self._lock:
@@ -183,41 +173,12 @@ class LocalProcRuntime:
 
         return pattern.sub(sub, value)
 
-    def _loop(self) -> None:
-        while not self._stop.wait(self._tick):
-            try:
-                self._reconcile_once()
-            except Exception:
-                log.exception("localproc loop error")
-
     def _reconcile_once(self) -> None:
         now = time.time()
         ready_nodes = [n.name for n in self._cs.nodes.list() if n.is_ready()]
         pods = self._cs.pods.list()
 
-        # Reap state for pods that vanished (force delete bypasses the
-        # finalizer), killing any process left behind -- otherwise a restarted
-        # pod with the same name would never relaunch.
-        existing = {f"{p.namespace}/{p.name}" for p in pods}
-        with self._lock:
-            stale = [k for k in self._procs if k not in existing]
-            reaped = [self._procs.pop(k) for k in stale]
-        for proc in reaped:
-            if proc.popen is not None and proc.popen.poll() is None:
-                proc.popen.kill()
-
-        for pod in pods:
-            key = f"{pod.namespace}/{pod.name}"
-            with self._lock:
-                proc = self._procs.setdefault(key, _Proc(uid=pod.metadata.uid))
-                if proc.uid != pod.metadata.uid:
-                    # Same name, new incarnation (restart recreated the pod
-                    # before we reaped the old entry): reset runtime state.
-                    if proc.popen is not None and proc.popen.poll() is None:
-                        proc.popen.kill()
-                    proc = _Proc(uid=pod.metadata.uid)
-                    self._procs[key] = proc
-
+        for pod, proc in self._pod_states(pods):
             if pod.metadata.deletion_timestamp is not None:
                 self._handle_terminating(pod, proc, now)
                 continue
@@ -232,12 +193,12 @@ class LocalProcRuntime:
             if proc.popen is not None:
                 code = proc.popen.poll()
                 if code is not None and pod.status.phase in (PodPhase.PENDING,
-                                                            PodPhase.RUNNING):
+                                                             PodPhase.RUNNING):
                     self._report_exit(pod, code, node=proc.node)
                 elif code is None and pod.status.phase == PodPhase.PENDING:
-                    # A earlier Running status write hit a conflict; the list()
-                    # snapshot is fresh now, so re-apply it (otherwise the pod
-                    # would be stranded Pending forever).
+                    # An earlier Running status write hit a conflict; the
+                    # list() snapshot is fresh now, so re-apply it (otherwise
+                    # the pod would be stranded Pending forever).
                     self._mark_running(pod, proc)
 
     def _handle_terminating(self, pod: Pod, proc: _Proc, now: float) -> None:
@@ -249,8 +210,7 @@ class LocalProcRuntime:
             return
         if not alive:
             self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
-            with self._lock:
-                self._procs.pop(f"{pod.namespace}/{pod.name}", None)
+            self._drop_state(pod.namespace, pod.name)
 
     def _launch(self, pod: Pod, proc: _Proc, node: str) -> None:
         if not pod.spec.containers:
@@ -317,11 +277,3 @@ class LocalProcRuntime:
                                 terminated_reason=reason or (
                                     "Completed" if code == 0 else "Error")))]
         self._try_update_pod(pod)
-
-    def _try_update_pod(self, pod: Pod) -> None:
-        try:
-            self._cs.pods.update(pod)
-        except NotFoundError:
-            pass
-        except Exception:
-            pass  # conflict: reconciled next tick

@@ -10,17 +10,16 @@ that substrate for the in-memory backend: a background loop that
 - walks pods through Pending -> Running -> Succeeded/Failed using the
   ``sim.tpu.trainingjob.dev/*`` annotations as the "program",
 - honors graceful deletion (finalizer -> SIGTERM analogue -> finalize), and
-- exposes fault injection: fail/recover nodes, preempt pods, flip capacity --
-  the knobs SURVEY.md §4 says the reference exercises operationally
-  (delete pods / mark nodes NotReady / set the Preempted annotation).
+- exposes fault injection: fail/recover nodes, preempt pods -- the knobs
+  SURVEY.md §4 says the reference exercises operationally (delete pods /
+  mark nodes NotReady / set the Preempted annotation).
 """
 
 from __future__ import annotations
 
 import logging
-import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 from trainingjob_operator_tpu.api import constants
@@ -37,6 +36,7 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
 log = logging.getLogger("trainingjob.sim")
 
@@ -57,36 +57,23 @@ class _PodRuntime:
     frozen_on: str = ""  # node whose failure froze this pod's reports
 
 
-class SimRuntime:
+class SimRuntime(PodStateRuntime):
     """Drives pod/node behavior against a Clientset-backed tracker."""
+
+    thread_name = "sim-kubelet"
 
     def __init__(self, clientset: Clientset,
                  start_delay: float = 0.0,
                  tick: float = 0.005,
                  termination_grace: float = 0.05,
                  pods_per_node: int = 64):
-        self._cs = clientset
-        self._tick = tick
+        super().__init__(clientset, tick)
         self._start_delay = start_delay
         self._termination_grace = termination_grace
         self._pods_per_node = pods_per_node
-        self._state: Dict[str, _PodRuntime] = {}
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        clientset.tracker.register_finalizer(Pod.KIND, self._on_terminating)
 
-    # -- lifecycle -----------------------------------------------------------
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="sim-kubelet")
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+    def _new_state(self, uid: str) -> _PodRuntime:
+        return _PodRuntime(uid=uid)
 
     # -- cluster setup / fault injection -------------------------------------
 
@@ -135,22 +122,7 @@ class SimRuntime:
                 rt.will_exit_at = time.time()
                 rt.exit_code = exit_code
 
-    # -- internals -----------------------------------------------------------
-
-    def _on_terminating(self, pod: Pod) -> None:
-        with self._lock:
-            rt = self._state.setdefault(f"{pod.namespace}/{pod.name}",
-                                        _PodRuntime(uid=pod.metadata.uid))
-            if not rt.uid:
-                rt.uid = pod.metadata.uid
-            rt.terminating_since = time.time()
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self._tick):
-            try:
-                self._reconcile_once()
-            except Exception:
-                log.exception("sim loop error")
+    # -- the kubelet/scheduler tick ------------------------------------------
 
     def _reconcile_once(self) -> None:
         now = time.time()
@@ -166,47 +138,31 @@ class SimRuntime:
                 tpu_used[pod.spec.node_name] = (tpu_used.get(pod.spec.node_name, 0)
                                                 + self._pod_tpu_request(pod))
 
-        # Gang-aware scheduling: group pending pods by gang label; a gang is
-        # placed only if every member fits simultaneously.
+        # Gang-aware scheduling: group pending pods by (namespace, gang); a
+        # gang is placed only if every member fits simultaneously.
         pending = [p for p in pods
                    if p.status.phase == PodPhase.PENDING and not p.spec.node_name
                    and p.metadata.deletion_timestamp is None]
-        gangs: Dict[str, list] = {}
+        gangs: Dict[tuple, list] = {}
         for pod in pending:
             gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
-            gangs.setdefault(gang, []).append(pod)
+            gangs.setdefault((pod.namespace, gang), []).append(pod)
         for gang_pods in gangs.values():
             self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
 
         # Walk running/scheduled pods through their lifecycle.
-        # Reap state for vanished pods (force delete bypasses the finalizer).
-        existing = {f"{p.namespace}/{p.name}" for p in pods}
-        with self._lock:
-            for k in [k for k in self._state if k not in existing]:
-                self._state.pop(k, None)
-
-        for pod in pods:
-            key = f"{pod.namespace}/{pod.name}"
-            with self._lock:
-                rt = self._state.setdefault(key, _PodRuntime(uid=pod.metadata.uid))
-                if rt.uid != pod.metadata.uid:
-                    # Same name, new incarnation: reset runtime state.
-                    rt = _PodRuntime(uid=pod.metadata.uid)
-                    self._state[key] = rt
-
+        for pod, rt in self._pod_states(pods):
             if pod.metadata.deletion_timestamp is not None:
                 if (rt.terminating_since is not None
                         and now - rt.terminating_since >= self._termination_grace):
                     self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
-                    with self._lock:
-                        self._state.pop(key, None)
+                    self._drop_state(pod.namespace, pod.name)
                 continue
 
             node = nodes.get(pod.spec.node_name) if pod.spec.node_name else None
             if node is None or not node.is_ready():
                 continue  # unscheduled or dead node: no kubelet reports
 
-            changed = False
             if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
                 if rt.scheduled_at == 0.0:
                     rt.scheduled_at = now
@@ -219,13 +175,13 @@ class SimRuntime:
                         ContainerStatus(name=c.name,
                                         state=ContainerState(running_started_at=now))
                         for c in pod.spec.containers]
-                    rt.started_at = now
                     run_s = pod.metadata.annotations.get(RUN_SECONDS_ANNOTATION)
-                    if run_s is not None and rt.will_exit_at is None:
-                        rt.will_exit_at = now + float(run_s)
-                        rt.exit_code = int(pod.metadata.annotations.get(
-                            EXIT_CODE_ANNOTATION, "0"))
-                    changed = True
+                    if self._try_update_pod(pod):
+                        rt.started_at = now
+                        if run_s is not None and rt.will_exit_at is None:
+                            rt.will_exit_at = now + float(run_s)
+                            rt.exit_code = int(pod.metadata.annotations.get(
+                                EXIT_CODE_ANNOTATION, "0"))
 
             elif (pod.status.phase == PodPhase.RUNNING
                   and rt.will_exit_at is not None and now >= rt.will_exit_at):
@@ -238,11 +194,10 @@ class SimRuntime:
                                         terminated_exit_code=code,
                                         terminated_reason="Completed" if code == 0 else "Error"))
                     for c in pod.spec.containers]
-                rt.will_exit_at = None
-                changed = True
-
-            if changed:
-                self._try_update_pod(pod)
+                if self._try_update_pod(pod):
+                    # Only clear after a successful write -- a conflict retries
+                    # against a fresh snapshot next tick.
+                    rt.will_exit_at = None
 
     def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
         placements = []
@@ -303,9 +258,3 @@ class SimRuntime:
             total += int((c.resources.get("requests") or {}).get(
                 constants.TPU_RESOURCE, 0))
         return total
-
-    def _try_update_pod(self, pod: Pod) -> None:
-        try:
-            self._cs.pods.update(pod)
-        except Exception:
-            pass  # conflict: re-observed next tick

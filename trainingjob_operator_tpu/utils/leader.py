@@ -1,0 +1,77 @@
+"""Leader election over a lock file.
+
+Reference: the operator leader-elects before running so only one instance
+reconciles (cmd/app/server.go:85-106, endpoints lock, lease 15 s / renew 5 s /
+retry 3 s).  Locally the resource is an ``fcntl`` file lock: the OS releases
+it when the holder dies, giving crash-failover without a heartbeat protocol;
+the lease/renew knobs shape the retry cadence.  On a real cluster the kube
+backend would use a Lease object instead.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from trainingjob_operator_tpu.cmd.options import LeaderElectionConfig
+
+log = logging.getLogger("trainingjob.leader")
+
+
+class LeaderElector:
+    def __init__(self, config: LeaderElectionConfig, identity: str = ""):
+        self._config = config
+        self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
+        self._lock_path = config.lock_path or "/tmp/tpu-trainingjob-leader.lock"
+        self._fd: Optional[int] = None
+        self._stop = threading.Event()
+
+    def run(self, on_started_leading: Callable[[], None],
+            stop: Optional[threading.Event] = None) -> None:
+        """Block until leadership is acquired, then invoke the callback
+        (reference: leaderelection.RunOrDie -> OnStartedLeading)."""
+        retry = max(self._config.retry_period, 0.1)
+        while not self._stop.is_set() and (stop is None or not stop.is_set()):
+            if self._try_acquire():
+                log.info("%s became leader (%s)", self.identity, self._lock_path)
+                self._write_identity()
+                try:
+                    on_started_leading()
+                finally:
+                    self.release()
+                return
+            time.sleep(retry)
+
+    def _try_acquire(self) -> bool:
+        fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd
+        return True
+
+    def _write_identity(self) -> None:
+        if self._fd is not None:
+            os.ftruncate(self._fd, 0)
+            os.write(self._fd, f"{self.identity} {time.time()}\n".encode())
+
+    def is_leader(self) -> bool:
+        return self._fd is not None
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,27 @@
+"""Signal handling: first SIGINT/SIGTERM triggers a clean stop, the second
+hard-exits.
+
+Reference: pkg/signals/signal.go:29-43 (close stop channel, os.Exit(1) on the
+second signal).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+
+def setup_signal_handler() -> threading.Event:
+    stop = threading.Event()
+    state = {"hits": 0}
+
+    def handler(signum, frame):
+        state["hits"] += 1
+        if state["hits"] >= 2:
+            os._exit(1)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    return stop

@@ -1,0 +1,12 @@
+"""JAX/XLA training workloads exercised by the operator end-to-end.
+
+The reference runs *other people's* frameworks inside its pods (Paddle, TF --
+README.md:2); this package is the equivalent in-repo workload layer for the
+BASELINE.json configs: MNIST MLP (CPU), PS/worker, ResNet-50 DP, BERT
+multi-host, elastic Llama-2 pretrain.  Every entrypoint bootstraps from the
+operator's injected env (workloads.rendezvous) and runs under
+``python -m trainingjob_operator_tpu.workloads.<name>``.
+
+JAX is imported lazily inside the workload modules so the operator control
+plane never pays the import cost.
+"""

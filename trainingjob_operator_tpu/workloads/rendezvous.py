@@ -1,0 +1,97 @@
+"""Workload-side rendezvous: read the operator's injected env and assemble the
+distributed topology.
+
+This is the consumer of the env contract from controller/pod.py set_env
+(reference: pod.go:548-652 + the TPU mapping of SURVEY.md §3.5): identity vars
+(TRAININGJOB_*), per-group host lists ({RT}_INSTANCES/_PORTS/_HOSTS), and the
+JAX bootstrap set (coordinator address, process count/id, TPU topology).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from trainingjob_operator_tpu.api import constants
+
+
+@dataclass
+class Rendezvous:
+    """Everything a worker needs to find its peers and its place."""
+
+    job_name: str = ""
+    namespace: str = ""
+    replica_name: str = ""
+    replica_index: int = 0
+    restart_count: int = 0
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator_address: str = ""
+    service: str = ""
+    checkpoint_dir: str = ""
+    elastic_replicas: int = 1
+    tpu_accelerator: str = ""
+    tpu_topology: str = ""
+    slice_id: int = 0
+    num_slices: int = 1
+    group_instances: Dict[str, List[str]] = field(default_factory=dict)
+    group_hosts: Dict[str, List[str]] = field(default_factory=dict)
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    def hosts(self, group: str) -> List[str]:
+        """host:port list of a replica group (after any localproc rewrite)."""
+        return self.group_hosts.get(group.upper(), [])
+
+
+def from_env(env: Optional[Dict[str, str]] = None) -> Rendezvous:
+    e = dict(os.environ if env is None else env)
+    rdv = Rendezvous(
+        job_name=e.get(constants.JOB_NAME_ENV, ""),
+        namespace=e.get(constants.JOB_NAMESPACE_ENV, "default"),
+        replica_name=e.get(constants.REPLICA_NAME_ENV, ""),
+        replica_index=int(e.get(constants.REPLICA_INDEX_ENV, "0") or 0),
+        restart_count=int(e.get(constants.REPLICA_RESTART_COUNT_ENV, "0") or 0),
+        num_processes=int(e.get(constants.NUM_PROCESSES_ENV, "1") or 1),
+        process_id=int(e.get(constants.PROCESS_ID_ENV, "0") or 0),
+        coordinator_address=e.get(constants.COORDINATOR_ADDRESS_ENV, ""),
+        service=e.get(constants.SERVICE_ENV, ""),
+        checkpoint_dir=e.get(constants.CHECKPOINT_DIR_ENV, ""),
+        elastic_replicas=int(e.get(constants.ELASTIC_REPLICAS_ENV, "1") or 1),
+        tpu_accelerator=e.get(constants.TPU_ACCELERATOR_ENV, ""),
+        tpu_topology=e.get(constants.TPU_TOPOLOGY_ENV, ""),
+        slice_id=int(e.get(constants.SLICE_ID_ENV, "0") or 0),
+        num_slices=int(e.get(constants.NUM_SLICES_ENV, "1") or 1),
+    )
+    for key, value in e.items():
+        if key.endswith("_INSTANCES") and not key.endswith("_NUM"):
+            rdv.group_instances[key[:-len("_INSTANCES")]] = (
+                value.split(",") if value else [])
+        elif key.endswith("_HOSTS") and not key.endswith("_NUM"):
+            rdv.group_hosts[key[:-len("_HOSTS")]] = (
+                value.split(",") if value else [])
+    return rdv
+
+
+def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
+    """Call jax.distributed.initialize from the injected env when the job is
+    multi-process; no-op for single-process jobs.
+
+    This is the TPU-native replacement for the reference's "framework inside
+    the pod self-assembles from env" contract (SURVEY.md §2.7): intra-slice
+    collectives ride ICI compiled by XLA; this call only wires the control
+    plane (coordinator + process ids).
+    """
+    rdv = rdv or from_env()
+    if rdv.num_processes > 1 and rdv.coordinator_address:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=rdv.coordinator_address,
+            num_processes=rdv.num_processes,
+            process_id=rdv.process_id,
+        )
+    return rdv

@@ -1,0 +1,53 @@
+"""Shared training utilities: checkpoint/resume keyed on restart count.
+
+The reference delegates checkpointing entirely to the workload, contributing
+only the restart-count env and stable identity (SURVEY.md §5.4).  This module
+is the workload half of that contract: orbax-backed save/restore under the
+injected checkpoint dir, resumed whenever the operator restarts the pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
+
+
+class CheckpointState:
+    """Tiny orbax wrapper: one pytree, latest-step retention."""
+
+    def __init__(self, directory: str, value: Dict[str, Any], manager: Any):
+        self.value = value
+        self._dir = directory
+        self._mngr = manager
+
+    @classmethod
+    def restore_or_init(cls, rdv: Rendezvous,
+                        init_value: Dict[str, Any]) -> "CheckpointState":
+        directory = rdv.checkpoint_dir
+        if not directory:
+            return cls("", init_value, None)
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(os.path.abspath(directory),
+                            rdv.replica_name or "worker", str(rdv.replica_index))
+        os.makedirs(path, exist_ok=True)
+        manager = ocp.CheckpointManager(
+            path, options=ocp.CheckpointManagerOptions(max_to_keep=2))
+        latest = manager.latest_step()
+        if latest is not None:
+            restored = manager.restore(
+                latest, args=ocp.args.StandardRestore(init_value))
+            return cls(path, restored, manager)
+        return cls(path, init_value, manager)
+
+    def save(self, value: Dict[str, Any]) -> None:
+        self.value = value
+        if self._mngr is None:
+            return
+        import orbax.checkpoint as ocp
+
+        step = int(value.get("step", 0))
+        self._mngr.save(step, args=ocp.args.StandardSave(value))
+        self._mngr.wait_until_finished()
