@@ -6,18 +6,27 @@ Controller/client tests are pure-Python.  Workload tests need JAX on a virtual
 
 import os
 import sys
+import time as _time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force CPU with 8 virtual devices: the environment pins JAX to the real TPU
+# (axon sitecustomize overrides JAX_PLATFORMS at interpreter start), but tests
+# validate multi-chip sharding on a virtual mesh (SURVEY.md §7) and must not
+# grab the chip.  The config update after import wins over the plugin pin.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+try:
+    from trainingjob_operator_tpu.workloads.rendezvous import (
+        apply_platform_override as _apo)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-import time as _time
+    _apo(var="JAX_PLATFORMS")
+except ImportError:
+    pass
 
 
 def wait_for(pred, timeout=15.0, interval=0.02):

@@ -1,0 +1,124 @@
+"""Parallel layer tests on the virtual 8-device CPU mesh.
+
+conftest sets XLA_FLAGS=--xla_force_host_platform_device_count=8 and
+JAX_PLATFORMS=cpu (SURVEY.md §7: multi-chip designs validated on a virtual
+mesh; the driver separately dry-runs the multichip path).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trainingjob_operator_tpu.parallel.mesh import MeshSpec, make_mesh
+from trainingjob_operator_tpu.parallel.ringattention import (
+    reference_attention,
+    ring_attention_sharded,
+)
+from trainingjob_operator_tpu.parallel.sharding import (
+    batch_spec,
+    shard_pytree,
+    sharding_pytree,
+    spec_for_path,
+)
+
+
+def test_device_count():
+    assert jax.device_count() == 8
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self):
+        mesh = make_mesh(MeshSpec.of(dp=2, fsdp=2, tp=2))
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 2, 2)
+
+    def test_bad_size_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec.of(dp=3, tp=2))
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec.of(dp=2, banana=4)
+
+    def test_axis_canonical_order(self):
+        spec = MeshSpec.of(tp=2, dp=4)  # declared out of order
+        assert spec.names == ("dp", "tp")
+
+
+class TestShardingRules:
+    RULES = [
+        (r"embed", ("tp", None)),
+        (r"attn/w[qkv]", (None, "tp")),
+        (r"mlp/w_in", (None, "tp")),
+        (r"mlp/w_out", ("tp", None)),
+    ]
+
+    def test_first_match_wins_and_default(self):
+        assert spec_for_path("tok_embed/w", self.RULES) == P("tp", None)
+        assert spec_for_path("layers/0/attn/wq", self.RULES) == P(None, "tp")
+        assert spec_for_path("layers/0/norm/scale", self.RULES) == P()
+
+    def test_shard_pytree_places_leaves(self):
+        mesh = make_mesh(MeshSpec.of(dp=2, tp=4))
+        tree = {"tok_embed": {"w": jnp.zeros((8, 16))},
+                "layers": [{"attn": {"wq": jnp.zeros((16, 16))},
+                            "norm": {"scale": jnp.zeros((16,))}}]}
+        sharded = shard_pytree(tree, self.RULES, mesh)
+        emb = sharded["tok_embed"]["w"]
+        assert emb.sharding.spec == P("tp", None)
+        # tp=4 shards dim0 8 -> 2 per device.
+        assert emb.addressable_shards[0].data.shape == (2, 16)
+        assert sharded["layers"][0]["norm"]["scale"].sharding.spec == P()
+
+    def test_sharding_pytree_matches(self):
+        mesh = make_mesh(MeshSpec.of(dp=2, tp=4))
+        tree = {"tok_embed": {"w": jnp.zeros((8, 16))}}
+        sh = sharding_pytree(tree, self.RULES, mesh)
+        assert sh["tok_embed"]["w"].spec == P("tp", None)
+
+    def test_batch_spec(self):
+        mesh = make_mesh(MeshSpec.of(dp=2, fsdp=2, sp=2))
+        assert batch_spec(mesh) == P(("dp", "fsdp"))
+        assert batch_spec(mesh, sequence_axis=True) == P(("dp", "fsdp"), "sp")
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("dp,sp", [(2, 4), (1, 8), (4, 2)])
+    def test_matches_reference(self, causal, dp, sp):
+        mesh = make_mesh(MeshSpec.of(dp=dp, sp=sp))
+        B, T, H, D = 2 * dp, 16 * sp, 2, 8
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, T, H, D), jnp.float32)
+        k = jax.random.normal(kk, (B, T, H, D), jnp.float32)
+        v = jax.random.normal(kv, (B, T, H, D), jnp.float32)
+
+        expected = reference_attention(q, k, v, causal=causal)
+
+        spec = P("dp" if dp > 1 else None, "sp", None, None)
+        qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                      for x in (q, k, v))
+        got = ring_attention_sharded(qs, ks, vs, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_jit_compiles_once_and_grads_flow(self):
+        mesh = make_mesh(MeshSpec.of(sp=8))
+        B, T, H, D = 2, 64, 2, 8
+        key = jax.random.PRNGKey(1)
+        q = jax.random.normal(key, (B, T, H, D))
+        spec = P(None, "sp", None, None)
+        qs = jax.device_put(q, NamedSharding(mesh, spec))
+
+        @jax.jit
+        def loss(q):
+            out = ring_attention_sharded(q, q, q, mesh, causal=True)
+            return (out ** 2).sum()
+
+        g = jax.grad(loss)(qs)
+        assert g.shape == q.shape
+        assert bool(jnp.isfinite(g).all())

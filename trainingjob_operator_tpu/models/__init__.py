@@ -1,0 +1,13 @@
+"""Model families for the BASELINE configs, TPU-first.
+
+- ``resnet``: ResNet-50 (config 3, v5e-8 data-parallel) -- conv/matmul work
+  lands on the MXU; batch-norm folded into XLA fusions.
+- ``bert``: BERT-base encoder (config 4, v5e-16 multi-host).
+- ``llama``: Llama-2 decoder family (config 5, elastic pretrain) with
+  dp/fsdp/tp/sp sharding rules and ring attention for long context.
+
+All models are plain-JAX pytrees (init_fn/apply_fn pairs): explicit param
+trees keep sharding rules trivially addressable by path
+(parallel/sharding.py), and everything under jit is static-shape,
+scan-friendly XLA.
+"""

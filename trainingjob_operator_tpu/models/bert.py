@@ -1,0 +1,172 @@
+"""BERT-base encoder -- BASELINE config 4 (multi-host v5e-16, MLM pretrain).
+
+Plain-JAX pytree encoder: learned position embeddings, post-LN transformer
+blocks via ``lax.scan`` over stacked layer params, MLM head tied to the token
+embedding.  Sharding: dp/fsdp data+param sharding like Llama (rules below);
+attention is dense (bidirectional) -- sequence lengths here don't need ring
+attention, the sp axis stays size 1 for this model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=512, dim=64, n_layers=2, n_heads=4, ffn_dim=128,
+                   max_seq_len=64)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+SHARDING_RULES = [
+    (r"tok_embed|pos_embed", ("tp", "fsdp")),
+    (r"attn/w[qkv]$", ("fsdp", "tp")),
+    (r"attn/wo$", ("tp", "fsdp")),
+    (r"mlp/w_in$", ("fsdp", "tp")),
+    (r"mlp/w_out$", ("tp", "fsdp")),
+    (r".*", ()),
+]
+
+
+def init_params(config: BertConfig, key) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, scale=0.02):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    def stacked(k, shape):
+        return dense(k, (c.n_layers,) + shape)
+
+    def stacked_zeros(shape):
+        return jnp.zeros((c.n_layers,) + shape, jnp.float32)
+
+    def stacked_ones(shape):
+        return jnp.ones((c.n_layers,) + shape, jnp.float32)
+
+    return {
+        "tok_embed": dense(keys[0], (c.vocab_size, c.dim)),
+        "pos_embed": dense(keys[1], (c.max_seq_len, c.dim)),
+        "embed_norm": {"scale": jnp.ones((c.dim,)), "bias": jnp.zeros((c.dim,))},
+        "layers": {
+            "attn": {
+                "wq": stacked(keys[2], (c.dim, c.dim)),
+                "wk": stacked(keys[3], (c.dim, c.dim)),
+                "wv": stacked(keys[4], (c.dim, c.dim)),
+                "wo": stacked(keys[5], (c.dim, c.dim)),
+                "bq": stacked_zeros((c.dim,)),
+                "bk": stacked_zeros((c.dim,)),
+                "bv": stacked_zeros((c.dim,)),
+                "bo": stacked_zeros((c.dim,)),
+            },
+            "mlp": {
+                "w_in": stacked(keys[6], (c.dim, c.ffn_dim)),
+                "b_in": stacked_zeros((c.ffn_dim,)),
+                "w_out": stacked(keys[7], (c.ffn_dim, c.dim)),
+                "b_out": stacked_zeros((c.dim,)),
+            },
+            "attn_norm": {"scale": stacked_ones((c.dim,)),
+                          "bias": stacked_zeros((c.dim,))},
+            "mlp_norm": {"scale": stacked_ones((c.dim,)),
+                         "bias": stacked_zeros((c.dim,))},
+        },
+        "mlm_bias": jnp.zeros((c.vocab_size,), jnp.float32),
+    }
+
+
+def _layernorm(x, scale, bias, eps):
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return (((xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps)))
+            * scale + bias).astype(x.dtype)
+
+
+def forward(params, tokens, config: BertConfig, attention_mask=None):
+    """tokens [B, T] -> hidden [B, T, dim]."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B, T = tokens.shape
+    h = (params["tok_embed"].astype(compute)[tokens]
+         + params["pos_embed"].astype(compute)[None, :T])
+    h = _layernorm(h, params["embed_norm"]["scale"],
+                   params["embed_norm"]["bias"], c.norm_eps)
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, T), bool)
+    bias = jnp.where(attention_mask[:, None, None, :], 0.0, -1e30)
+
+    def block(h, layer):
+        a = layer["attn"]
+        q = (h @ a["wq"].astype(compute) + a["bq"].astype(compute))
+        k = (h @ a["wk"].astype(compute) + a["bk"].astype(compute))
+        v = (h @ a["wv"].astype(compute) + a["bv"].astype(compute))
+        q = q.reshape(B, T, c.n_heads, c.head_dim)
+        k = k.reshape(B, T, c.n_heads, c.head_dim)
+        v = v.reshape(B, T, c.n_heads, c.head_dim)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (c.head_dim ** -0.5)
+        s = s + bias.astype(s.dtype)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(compute)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, c.dim)
+        o = o @ a["wo"].astype(compute) + a["bo"].astype(compute)
+        h = _layernorm(h + o, layer["attn_norm"]["scale"],
+                       layer["attn_norm"]["bias"], c.norm_eps)
+        m = layer["mlp"]
+        f = jax.nn.gelu(h @ m["w_in"].astype(compute) + m["b_in"].astype(compute))
+        f = f @ m["w_out"].astype(compute) + m["b_out"].astype(compute)
+        h = _layernorm(h + f, layer["mlp_norm"]["scale"],
+                       layer["mlp_norm"]["bias"], c.norm_eps)
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    return h
+
+
+def mlm_logits(params, hidden, config: BertConfig):
+    """Tied-embedding MLM head."""
+    import jax.numpy as jnp
+
+    compute = jnp.dtype(config.dtype)
+    logits = hidden @ params["tok_embed"].astype(compute).T
+    return logits.astype(jnp.float32) + params["mlm_bias"]
+
+
+def loss_fn(params, batch, config: BertConfig):
+    """Masked-LM loss; batch: tokens [B,T], targets [B,T], mask [B,T]
+    (mask==1 where a token was masked out and must be predicted)."""
+    import jax.numpy as jnp
+    import optax
+
+    hidden = forward(params, batch["tokens"], config)
+    logits = mlm_logits(params, hidden, config)
+    raw = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["targets"])
+    mask = batch["mask"].astype(jnp.float32)
+    return (raw * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,212 @@
+"""Llama-2 family: decoder-only transformer, TPU-first.
+
+Plain-JAX pytree model (no framework Module graph): params are a nested dict
+whose paths drive the sharding rules; the forward is jit/scan-friendly
+(static shapes, ``lax.scan`` over layers via stacked params).
+
+TPU mapping:
+- matmuls in bf16 on the MXU; params kept f32 (master) unless configured.
+- GQA attention; ring attention over the ``sp`` axis for long context
+  (parallel/ringattention.py), plain attention otherwise.
+- sharding rules (SHARDING_RULES): embeddings and lm_head tp-sharded on
+  vocab, attention/MLP projections tp-sharded on heads/ffn, everything
+  fsdp-sharded on the leading dim -- gradients reduce-scatter on ICI,
+  params all-gather per layer (XLA inserts both).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"  # compute dtype; params stay float32
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256, dim: int = 64, n_layers: int = 2,
+             n_heads: int = 4, n_kv_heads: int = 2, ffn_dim: int = 128,
+             max_seq_len: int = 128) -> "LlamaConfig":
+        """Test/dryrun-sized config with the same code path."""
+        return cls(vocab_size=vocab_size, dim=dim, n_layers=n_layers,
+                   n_heads=n_heads, n_kv_heads=n_kv_heads, ffn_dim=ffn_dim,
+                   max_seq_len=max_seq_len)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+#: path-pattern -> PartitionSpec args (parallel/sharding.py Rules).
+#: fsdp shards the big dim; tp shards heads/ffn/vocab.
+SHARDING_RULES = [
+    (r"tok_embed", ("tp", "fsdp")),
+    (r"lm_head", ("fsdp", "tp")),
+    (r"attn/w[qkv]$", ("fsdp", "tp")),
+    (r"attn/wo$", ("tp", "fsdp")),
+    (r"mlp/w_(gate|up)$", ("fsdp", "tp")),
+    (r"mlp/w_down$", ("tp", "fsdp")),
+    (r"norm", (None,)),
+]
+
+
+def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    kv_dim = c.n_kv_heads * c.head_dim
+    keys = jax.random.split(k_layers, 7)
+
+    # Stacked layer params: leading axis = layer, consumed by lax.scan.
+    def stacked(k, shape, scale=None):
+        return dense(k, (c.n_layers,) + shape, scale)
+
+    params = {
+        "tok_embed": dense(k_emb, (c.vocab_size, c.dim), 0.02),
+        "layers": {
+            "attn": {
+                "wq": stacked(keys[0], (c.dim, c.dim)),
+                "wk": stacked(keys[1], (c.dim, kv_dim)),
+                "wv": stacked(keys[2], (c.dim, kv_dim)),
+                "wo": stacked(keys[3], (c.dim, c.dim)),
+            },
+            "mlp": {
+                "w_gate": stacked(keys[4], (c.dim, c.ffn_dim)),
+                "w_up": stacked(keys[5], (c.dim, c.ffn_dim)),
+                "w_down": stacked(keys[6], (c.ffn_dim, c.dim)),
+            },
+            "attn_norm": jnp.ones((c.n_layers, c.dim), jnp.float32),
+            "mlp_norm": jnp.ones((c.n_layers, c.dim), jnp.float32),
+        },
+        "final_norm": jnp.ones((c.dim,), jnp.float32),
+        "lm_head": dense(k_head, (c.dim, c.vocab_size), 0.02),
+    }
+    return params
+
+
+def _rmsnorm(x, scale, eps):
+    import jax.numpy as jnp
+
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jnp.reciprocal(jnp.sqrt(var + eps)).astype(x.dtype)
+            * scale.astype(x.dtype))
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding; x: [B, T, H, D]."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    freqs = jnp.exp(-jnp.log(theta) * jnp.arange(0, d, 2, jnp.float32) / d)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
+            mesh=None, sequence_parallel: bool = False):
+    """Logits for tokens [B, T] -> [B, T, vocab].
+
+    With ``sequence_parallel`` (and a mesh with an ``sp`` axis), attention runs
+    as ring attention over the sequence shards; positions account for the
+    global offset of each shard.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B, T = tokens.shape
+    h = params["tok_embed"].astype(compute)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+    group = c.n_heads // c.n_kv_heads
+
+    def attn(h, layer):
+        q = (h @ layer["attn"]["wq"].astype(compute))
+        k = (h @ layer["attn"]["wk"].astype(compute))
+        v = (h @ layer["attn"]["wv"].astype(compute))
+        q = q.reshape(B, T, c.n_heads, c.head_dim)
+        k = k.reshape(B, T, c.n_kv_heads, c.head_dim)
+        v = v.reshape(B, T, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        if sequence_parallel and mesh is not None and "sp" in mesh.axis_names:
+            # Ring attention is GQA-aware: the narrow kv blocks travel the
+            # ring un-repeated (ICI bytes scale with n_kv_heads).
+            from trainingjob_operator_tpu.parallel.ringattention import (
+                ring_attention_sharded)
+
+            o = ring_attention_sharded(q, k, v, mesh, causal=True)
+        else:
+            from trainingjob_operator_tpu.parallel.ringattention import (
+                reference_attention)
+
+            if group > 1:  # GQA: expand kv heads for the dense path
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            o = reference_attention(q, k, v, causal=True)
+        o = o.reshape(B, T, c.dim)
+        return o @ layer["attn"]["wo"].astype(compute)
+
+    def mlp(h, layer):
+        gate = jax.nn.silu(h @ layer["mlp"]["w_gate"].astype(compute))
+        up = h @ layer["mlp"]["w_up"].astype(compute)
+        return (gate * up) @ layer["mlp"]["w_down"].astype(compute)
+
+    def block(h, layer):
+        h = h + attn(_rmsnorm(h, layer["attn_norm"], c.norm_eps), layer)
+        h = h + mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer)
+        return h, None
+
+    # Scan over stacked layers: one compiled block, L iterations -- compile
+    # time O(1) in depth, XLA-friendly (no Python loop unrolling).
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    h = _rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = h @ params["lm_head"].astype(compute)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, config: LlamaConfig, *, mesh=None,
+            sequence_parallel: bool = False):
+    """Next-token cross-entropy; batch: {"tokens": [B, T+1]}."""
+    import jax.numpy as jnp
+    import optax
+
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], config, mesh=mesh,
+                     sequence_parallel=sequence_parallel)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, tokens[:, 1:]).mean()
+
+
+def num_params(config: LlamaConfig) -> int:
+    c = config
+    kv_dim = c.n_kv_heads * c.head_dim
+    per_layer = (c.dim * c.dim * 2 + c.dim * kv_dim * 2
+                 + c.dim * c.ffn_dim * 3 + 2 * c.dim)
+    return (c.vocab_size * c.dim * 2 + c.n_layers * per_layer + c.dim)

@@ -1,0 +1,177 @@
+"""ResNet-50 -- BASELINE config 3 (JAX/Flax-class ResNet, data-parallel
+v5e-8).
+
+Plain-JAX pytree implementation: convs via ``lax.conv_general_dilated`` in
+NHWC (TPU-native layout; the MXU consumes convs as implicit GEMMs), batch norm
+with running stats carried in a separate state tree, bottleneck blocks
+[3, 4, 6, 3].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    width: int = 64
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def resnet50(cls) -> "ResNetConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ResNetConfig":
+        return cls(num_classes=10, stage_sizes=(1, 1), width=8)
+
+
+#: DP sharding: params replicated (pure data parallel); batch sharded.
+SHARDING_RULES = [(r".*", ())]
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    import jax
+    import jax.numpy as jnp
+
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * (
+        (2.0 / fan_in) ** 0.5)
+
+
+def _bn_init(c):
+    import jax.numpy as jnp
+
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state(c):
+    import jax.numpy as jnp
+
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(config: ResNetConfig, key) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    import jax
+
+    c = config
+    keys = iter(jax.random.split(key, 200))
+    params: Dict[str, Any] = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, c.width),
+                                       "bn": _bn_init(c.width)}}
+    stats: Dict[str, Any] = {"stem": _bn_state(c.width)}
+
+    cin = c.width
+    for s, blocks in enumerate(c.stage_sizes):
+        cout = c.width * (2 ** s)
+        stage_p, stage_s = [], []
+        for b in range(blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            p = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cout),
+                "bn1": _bn_init(cout),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout),
+                "bn2": _bn_init(cout),
+                "conv3": _conv_init(next(keys), 1, 1, cout, cout * 4),
+                "bn3": _bn_init(cout * 4),
+            }
+            st = {"bn1": _bn_state(cout), "bn2": _bn_state(cout),
+                  "bn3": _bn_state(cout * 4)}
+            if b == 0:
+                p["proj"] = _conv_init(next(keys), 1, 1, cin, cout * 4)
+                p["proj_bn"] = _bn_init(cout * 4)
+                st["proj_bn"] = _bn_state(cout * 4)
+            stage_p.append(p)
+            stage_s.append(st)
+            cin = cout * 4
+        params[f"stage{s}"] = stage_p
+        stats[f"stage{s}"] = stage_s
+
+    import jax.numpy as jnp
+
+    params["head"] = {"w": jax.random.normal(next(keys), (cin, c.num_classes),
+                                             jnp.float32) * 0.01,
+                      "b": jnp.zeros((c.num_classes,), jnp.float32)}
+    return params, stats
+
+
+def _conv(x, w, stride=1):
+    import jax
+
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    """Returns (y, new_state)."""
+    import jax.numpy as jnp
+
+    if train:
+        mean = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+        var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jnp.reciprocal(jnp.sqrt(var + eps))
+    y = (x.astype(jnp.float32) - mean) * inv * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def forward(params, stats, images, config: ResNetConfig, train: bool = True):
+    """images [B, H, W, 3] -> (logits [B, classes], new_stats)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = images.astype(jnp.dtype(config.dtype))
+    new_stats: Dict[str, Any] = {}
+
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, new_stats["stem"] = _bn(x, params["stem"]["bn"], stats["stem"], train)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+
+    for s in range(len(config.stage_sizes)):
+        stage_stats = []
+        for b, p in enumerate(params[f"stage{s}"]):
+            st = stats[f"stage{s}"][b]
+            stride = 2 if (s > 0 and b == 0) else 1
+            residual = x
+            y = _conv(x, p["conv1"])
+            y, st1 = _bn(y, p["bn1"], st["bn1"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, p["conv2"], stride=stride)
+            y, st2 = _bn(y, p["bn2"], st["bn2"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, p["conv3"])
+            y, st3 = _bn(y, p["bn3"], st["bn3"], train)
+            new_st = {"bn1": st1, "bn2": st2, "bn3": st3}
+            if "proj" in p:
+                residual = _conv(x, p["proj"], stride=stride)
+                residual, stp = _bn(residual, p["proj_bn"], st["proj_bn"], train)
+                new_st["proj_bn"] = stp
+            x = jax.nn.relu(y + residual)
+            stage_stats.append(new_st)
+        new_stats[f"stage{s}"] = stage_stats
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, batch, config: ResNetConfig):
+    import optax
+
+    logits, new_stats = forward(params, stats, batch["images"], config,
+                                train=True)
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]).mean()
+    return loss, new_stats

@@ -1,0 +1,29 @@
+"""Mesh/sharding/collective layer: how workloads scale on TPU.
+
+The reference has no in-repo parallelism (SURVEY.md §2.7) -- its replicas
+self-assemble via env and bring their own collectives.  TPU-native, the
+equivalent layer is explicit: a ``jax.sharding.Mesh`` over the slice topology
+the operator provisioned, parameter/batch shardings expressed as
+``PartitionSpec`` rules, XLA-inserted collectives over ICI/DCN, and
+sequence-parallel ring attention for long context.
+"""
+
+from trainingjob_operator_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    mesh_from_rendezvous,
+)
+from trainingjob_operator_tpu.parallel.sharding import (
+    batch_spec,
+    shard_pytree,
+    spec_for_path,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "mesh_from_rendezvous",
+    "batch_spec",
+    "shard_pytree",
+    "spec_for_path",
+]

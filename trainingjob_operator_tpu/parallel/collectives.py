@@ -1,0 +1,49 @@
+"""Named-axis collective helpers used inside shard_map'd code.
+
+XLA compiles these onto ICI (intra-slice) or DCN (across the dp axis when it
+spans slices); there is no NCCL-style backend to manage (SURVEY.md §5.8) --
+topology correctness is the operator's job, collective choice is ours.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def pmean(x: Any, axis: str):
+    import jax
+
+    return jax.lax.pmean(x, axis)
+
+
+def psum(x: Any, axis: str):
+    import jax
+
+    return jax.lax.psum(x, axis)
+
+
+def all_gather(x: Any, axis: str, *, tiled: bool = True):
+    import jax
+
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x: Any, axis: str, *, scatter_dimension: int = 0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ppermute_next(x: Any, axis: str, axis_size: int):
+    """Rotate a block one step around the ring (i -> i+1)."""
+    import jax
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    import jax
+
+    return jax.lax.axis_index(axis)

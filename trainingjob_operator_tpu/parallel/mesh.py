@@ -1,0 +1,111 @@
+"""Device-mesh construction for the operator-provisioned topology.
+
+Axis convention (scaling-book recipe: pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+- ``dp``   -- data parallel; gradients all-reduce.  Across slices this axis
+              rides DCN (multislice), within a slice ICI.
+- ``fsdp`` -- fully-sharded data parallel; params/opt-state sharded, gathered
+              per layer (XLA all-gather / reduce-scatter on ICI).
+- ``tp``   -- tensor parallel; activations collective on ICI every layer.
+- ``sp``   -- sequence/context parallel; ring attention ppermutes KV blocks.
+
+The operator tells each worker its slice topology via env
+(TRAININGJOB_TPU_TOPOLOGY, MEGASCALE_NUM_SLICES); ``mesh_from_rendezvous``
+turns that into a concrete mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
+
+AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Axis sizes, in DCN-outermost order."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def of(cls, **sizes: int) -> "MeshSpec":
+        axes = tuple((name, int(sizes[name])) for name in AXIS_ORDER
+                     if name in sizes and sizes[name] > 0)
+        extra = set(sizes) - set(AXIS_ORDER)
+        if extra:
+            raise ValueError(f"unknown mesh axes {sorted(extra)}; "
+                             f"known: {AXIS_ORDER}")
+        return cls(axes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    def size(self) -> int:
+        return math.prod(self.shape) if self.axes else 1
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a ``jax.sharding.Mesh``; axis product must equal device count.
+
+    DCN-aware: when more than one slice is present (multislice), the leading
+    axis should be the DCN axis (dp) so inter-slice traffic is only gradient
+    all-reduce -- use ``jax.experimental.mesh_utils`` device ordering when
+    running on real multislice hardware.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    want = spec.size()
+    if want != len(devs):
+        raise ValueError(
+            f"mesh {dict(spec.axes)} needs {want} devices, have {len(devs)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(spec.shape, devices=devs)
+    except Exception:
+        arr = np.array(devs).reshape(spec.shape)
+    return Mesh(arr, spec.names)
+
+
+def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
+                         sequence_parallel: int = 1,
+                         fsdp: bool = True):
+    """Derive the standard mesh for this worker's provisioned topology.
+
+    Local devices x num_processes = global devices; DCN (slices) maps to the
+    leading dp axis, ICI carries fsdp/tp/sp.
+    """
+    import jax
+
+    n = jax.device_count()
+    inner = model_parallel * sequence_parallel
+    if n % inner != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp={inner}")
+    data = n // inner
+    dp = max(rdv.num_slices, 1)
+    if data % dp != 0:
+        # Never silently let fsdp span slices: per-layer all-gathers would
+        # ride DCN instead of ICI, the exact layout this module forbids.
+        raise ValueError(
+            f"data axis {data} not divisible by num_slices={dp}; choose "
+            f"tp/sp so each slice holds an equal data shard")
+    fsdp_size = data // dp
+    if fsdp:
+        spec = MeshSpec.of(dp=dp, fsdp=fsdp_size, tp=model_parallel,
+                           sp=sequence_parallel)
+    else:
+        spec = MeshSpec.of(dp=data, tp=model_parallel, sp=sequence_parallel)
+    return make_mesh(spec)

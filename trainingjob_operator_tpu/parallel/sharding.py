@@ -1,0 +1,81 @@
+"""Parameter/batch sharding rules: path-pattern -> PartitionSpec.
+
+The scaling-book recipe: annotate a few load-bearing shardings (params in,
+batch in, outputs) and let XLA propagate + insert collectives.  Rules map
+regex patterns over flattened param paths (``"layers/3/attn/wq"``) to
+``PartitionSpec``s; first match wins, default replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+Rules = Sequence[Tuple[str, Tuple[Optional[object], ...]]]
+
+
+def path_of(key_path) -> str:
+    """jax.tree_util key path -> 'a/b/3/c' string."""
+    import jax
+
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: Rules):
+    """First-match PartitionSpec for a param path; replicated by default."""
+    from jax.sharding import PartitionSpec as P
+
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return P(*spec)
+    return P()
+
+
+def shard_pytree(tree: Any, rules: Rules, mesh) -> Any:
+    """Device-put every leaf with its rule's NamedSharding."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def place(key_path, leaf):
+        spec = spec_for_path(path_of(key_path), rules)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, tree)
+
+
+def sharding_pytree(tree: Any, rules: Rules, mesh) -> Any:
+    """The NamedSharding pytree for jit in_shardings/out_shardings."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, _: NamedSharding(mesh, spec_for_path(path_of(kp), rules)),
+        tree)
+
+
+def batch_spec(mesh, sequence_axis: bool = False):
+    """Batch PartitionSpec: batch dim over (dp, fsdp), optionally sequence dim
+    over sp."""
+    from jax.sharding import PartitionSpec as P
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    batch_axes = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
+    if sequence_axis and "sp" in mesh.axis_names:
+        return P(batch_axes, "sp")
+    return P(batch_axes)
+
+
+def constrain(x, mesh, *spec):
+    """with_sharding_constraint under a concrete mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

@@ -86,6 +86,7 @@ def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
     plane (coordinator + process ids).
     """
     rdv = rdv or from_env()
+    apply_platform_override()
     if rdv.num_processes > 1 and rdv.coordinator_address:
         import jax
 
@@ -95,3 +96,20 @@ def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
             process_id=rdv.process_id,
         )
     return rdv
+
+
+def apply_platform_override(var: str = "TRAININGJOB_JAX_PLATFORM") -> None:
+    """Honor a platform request from env (e.g. "cpu" for CPU replica groups).
+
+    A config update after import wins even where a site hook pins the
+    platform at interpreter start (needed so multi-worker CPU jobs on one
+    machine don't all claim the single TPU, and so the driver's
+    JAX_PLATFORMS=cpu virtual-mesh dry run actually gets CPU devices).
+    The single implementation for every caller: workloads use the manifest
+    env var, tests and the graft entry pass ``var="JAX_PLATFORMS"``.
+    """
+    plat = os.environ.get(var)
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
