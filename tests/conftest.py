@@ -20,13 +20,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-try:
-    from trainingjob_operator_tpu.workloads.rendezvous import (
-        apply_platform_override as _apo)
 
-    _apo(var="JAX_PLATFORMS")
-except ImportError:
-    pass
+
+def apply_jax_platform_override():
+    """Pin jax to the virtual CPU mesh, beating the axon site hook.  Called
+    from the jax-dependent test modules so the pure-Python controller suites
+    never pay the jax import at collection time."""
+    from trainingjob_operator_tpu.workloads.rendezvous import (
+        apply_platform_override)
+
+    apply_platform_override(var="JAX_PLATFORMS")
 
 
 def wait_for(pred, timeout=15.0, interval=0.02):

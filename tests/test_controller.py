@@ -676,3 +676,323 @@ class TestReviewRegressions2:
         for i in range(25):
             rec.event(job, EventRecorder.NORMAL, "R", f"m{i}")
         assert len(cs.events.list()) == 10
+
+
+class TestElastic:
+    """Elastic resize (EdlPolicy Auto): the north-star capability the
+    reference declares but never implements (SURVEY.md §2.6, §5.3)."""
+
+    def _running_elastic_job(self, cs, tc, replicas=3, min_replicas=1,
+                             **extra):
+        for i in range(replicas):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=replicas, min_replicas=min_replicas,
+                       max_replicas=replicas,
+                       edl_policy="Auto",
+                       restart_policy=RestartPolicy.ON_NODE_FAIL,
+                       restart_scope=RestartScope.REPLICA, **extra)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for i in range(replicas):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        return job
+
+    def test_node_fail_shrinks_to_survivors(self):
+        cs, tc = make_env()
+        job = self._running_elastic_job(cs, tc)
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)
+        got = get_job(cs)
+        # Shrink, not restart: width recorded, group drained, no restart
+        # budget consumed.
+        assert got.status.elastic_replicas["trainer"] == 2
+        assert got.status.scaling_replica_name == "trainer"
+        assert got.status.phase == TrainingJobPhase.SCALING
+        assert got.status.restart_counts["trainer"] == 0
+        assert pods_of(cs) == []
+        sync(tc, job)  # drain observed -> marker cleared
+        assert get_job(cs).status.scaling_replica_name == ""
+        sync(tc, job)  # recreate at new width
+        pods = pods_of(cs)
+        assert [p.name for p in pods] == ["job-trainer-0", "job-trainer-1"]
+        env = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        assert env[constants.ELASTIC_REPLICAS_ENV] == "2"
+        assert env[constants.NUM_PROCESSES_ENV] == "2"
+        assert env["TRAINER_INSTANCES_NUM"] == "2"
+
+    def test_shrink_floor_is_min_replicas(self):
+        cs, tc = make_env()
+        job = self._running_elastic_job(cs, tc, replicas=2, min_replicas=2)
+        node = cs.nodes.get_node("node-1")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)
+        got = get_job(cs)
+        # At the floor: the ordinary restart machinery fires instead.
+        assert got.status.elastic_replicas == {}
+        assert got.status.restart_counts["trainer"] == 1
+
+    def test_edl_manual_never_shrinks(self):
+        cs, tc = make_env()
+        for i in range(2):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=2, min_replicas=1, max_replicas=2,
+                       edl_policy="Manual",
+                       restart_policy=RestartPolicy.ON_NODE_FAIL,
+                       restart_scope=RestartScope.POD)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for i in range(2):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        node = cs.nodes.get_node("node-1")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.elastic_replicas == {}
+        assert got.status.restart_counts["trainer"] == 1
+
+    def test_starvation_shrink(self):
+        cs, tc = make_env()
+        tc.options.scale_pending_time = 0.05
+        cs.nodes.create(make_ready_node("node-0"))
+        cs.nodes.create(make_ready_node("node-1"))
+        job = make_job(replicas=3, min_replicas=2, max_replicas=3,
+                       edl_policy="Auto")
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-trainer-0", node="node-0")
+        set_pod_running(cs, "job-trainer-1", node="node-1")
+        # Pod 2 stays Pending-unschedulable past the grace window.
+        pod = cs.pods.get("default", "job-trainer-2")
+        pod.status.conditions = [Condition(
+            type="PodScheduled", status=ConditionStatus.FALSE,
+            reason="Unschedulable", message="0/2 nodes available")]
+        cs.pods.update(pod)
+        time.sleep(0.1)  # past the scale_pending_time grace window
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.elastic_replicas["trainer"] == 2
+        assert got.status.scaling_replica_name == "trainer"
+        sync(tc, job, n=2)
+        assert [p.name for p in pods_of(cs)] == ["job-trainer-0", "job-trainer-1"]
+        # Out-of-range service removed along with the width change.
+        svc_names = sorted(s.metadata.name for s in cs.services.list("default"))
+        assert "job-trainer-2" not in svc_names
+
+    def test_reexpand_probe_commit(self):
+        """Probe flow: degraded group arms a reservation, which schedules ->
+        the resize commits and the group re-rendezvouses at full width."""
+        cs, tc = make_env()
+        tc.options.scale_up_delay = 0.01
+        job = self._running_elastic_job(cs, tc)
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job, n=3)  # shrink, drain, recreate at 2
+        for i in range(2):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        # Capacity returns.
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.TRUE
+        cs.nodes.update(node)
+        time.sleep(0.02)  # past the re-expand backoff
+        sync(tc, job)
+        got = get_job(cs)
+        # Probe armed: reservation requested, running group untouched.
+        assert got.status.scale_probes == {"trainer": 3}
+        assert got.status.elastic_replicas == {"trainer": 2}
+        sync(tc, job)  # reservation pod created
+        assert [p.name for p in pods_of(cs)] == [
+            "job-trainer-0", "job-trainer-1", "job-trainer-2"]
+        # Still Running at width 2 while the reservation is pending.
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        # Reservation schedules -> commit: drain for re-rendezvous.
+        pod = cs.pods.get("default", "job-trainer-2")
+        pod.spec.node_name = "node-2"
+        cs.pods.update(pod)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.scale_probes == {}
+        assert got.status.elastic_replicas == {}
+        assert got.status.scaling_replica_name == "trainer"
+        sync(tc, job, n=2)
+        assert len(pods_of(cs)) == 3
+        for i in range(3):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.RUNNING
+        assert got.status.scale_up_attempts == {}
+
+    def test_reexpand_probe_failure_nondestructive(self):
+        """A probe that finds no capacity is discarded without touching the
+        running group, and the backoff doubles."""
+        cs, tc = make_env()
+        tc.options.scale_up_delay = 0.01
+        tc.options.scale_pending_time = 0.03
+        job = self._running_elastic_job(cs, tc)
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job, n=3)
+        for i in range(2):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        time.sleep(0.02)
+        sync(tc, job)  # probe armed
+        assert get_job(cs).status.scale_probes == {"trainer": 3}
+        sync(tc, job)  # reservation created
+        # Reservation starves: unschedulable past the grace window.
+        pod = cs.pods.get("default", "job-trainer-2")
+        pod.status.conditions = [Condition(
+            type="PodScheduled", status=ConditionStatus.FALSE,
+            reason="Unschedulable", message="0/2 nodes available")]
+        cs.pods.update(pod)
+        time.sleep(0.05)
+        sync(tc, job)
+        got = get_job(cs)
+        # Probe discarded; running pods untouched; attempt counted.
+        assert got.status.scale_probes == {}
+        assert got.status.elastic_replicas == {"trainer": 2}
+        assert got.status.scale_up_attempts == {"trainer": 1}
+        assert got.status.scaling_replica_name == ""
+        assert [p.name for p in pods_of(cs)] == [
+            "job-trainer-0", "job-trainer-1"]
+        assert got.status.phase == TrainingJobPhase.RUNNING
+
+    def test_max_replicas_expansion_target(self):
+        """maxReplicas > replicas is live: the probe targets max width."""
+        cs, tc = make_env()
+        tc.options.scale_up_delay = 0.01
+        for i in range(3):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=2, min_replicas=1, max_replicas=3,
+                       edl_policy="Auto")
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for i in range(2):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        # No prior resize -> no probe (last_scale_times empty): stable.
+        sync(tc, job)
+        assert get_job(cs).status.scale_probes == {}
+        # After any resize event the group grows toward max when capacity
+        # allows: simulate a degraded record.
+        fresh = get_job(cs)
+        fresh.status.elastic_replicas["trainer"] = 2
+        fresh.status.last_scale_times["trainer"] = time.time() - 10
+        cs.trainingjobs.update(fresh)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.scale_probes == {"trainer": 3}
+
+    def test_no_shrink_after_success(self):
+        # A resize discards finished work; once any pod succeeded the group
+        # falls back to the ordinary machinery.
+        cs, tc = make_env()
+        tc.options.scale_pending_time = 0.01
+        cs.nodes.create(make_ready_node("node-0"))
+        job = make_job(replicas=3, min_replicas=1, max_replicas=3,
+                       edl_policy="Auto")
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_terminated(cs, "job-trainer-0", exit_code=0)
+        pod = cs.pods.get("default", "job-trainer-1")
+        pod.status.conditions = [Condition(
+            type="PodScheduled", status=ConditionStatus.FALSE,
+            reason="Unschedulable", message="0/1 nodes available")]
+        cs.pods.update(pod)
+        time.sleep(0.05)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.elastic_replicas == {}
+        assert cs.pods.get("default", "job-trainer-0") is not None
+
+    def test_shrink_floor_never_below_one(self):
+        cs, tc = make_env()
+        tc.options.scale_pending_time = 0.01
+        job = make_job(replicas=2, min_replicas=0, max_replicas=2,
+                       edl_policy="Auto")
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for i in range(2):
+            pod = cs.pods.get("default", f"job-trainer-{i}")
+            pod.status.conditions = [Condition(
+                type="PodScheduled", status=ConditionStatus.FALSE,
+                reason="Unschedulable", message="0/0 nodes available")]
+            cs.pods.update(pod)
+        time.sleep(0.05)
+        sync(tc, job)
+        got = get_job(cs)
+        # min_replicas=0 clamps to 1, never 0 (which could neither re-expand
+        # nor be told apart from completion).
+        assert got.status.elastic_replicas.get("trainer") == 1
+
+    def test_multi_group_resize_restarts_all_groups(self):
+        # Every group's env cross-references the resized group's host list;
+        # a resize must re-rendezvous all of them.
+        cs, tc = make_env()
+        for i in range(3):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=2, min_replicas=1, max_replicas=2,
+                       edl_policy="Auto",
+                       restart_policy=RestartPolicy.ON_NODE_FAIL)
+        job.spec.replica_specs["pserver"] = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="aitj-main", image="img",
+                          ports=[ContainerPort(name="aitj-2223",
+                                               container_port=2223)])])))
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-pserver-0", node="node-0")
+        set_pod_running(cs, "job-trainer-0", node="node-1")
+        set_pod_running(cs, "job-trainer-1", node="node-2")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)
+        got = get_job(cs)
+        assert got.status.elastic_replicas["trainer"] == 1
+        assert pods_of(cs) == []  # pserver drained too
+        sync(tc, job, n=2)
+        pods = [p.name for p in pods_of(cs)]
+        assert pods == ["job-pserver-0", "job-trainer-0"]
+        # The recreated pserver sees the degraded trainer world.
+        env = {e.name: e.value
+               for e in cs.pods.get("default", "job-pserver-0")
+               .spec.containers[0].env}
+        assert env["TRAINER_INSTANCES_NUM"] == "1"
+
+    def test_reservation_pod_marked(self):
+        # Probe reservations carry the canary env so real workloads idle
+        # instead of crashing the rendezvous.
+        cs, tc = make_env()
+        tc.options.scale_up_delay = 0.01
+        job = self._running_elastic_job(cs, tc)
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job, n=3)
+        for i in range(2):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        time.sleep(0.02)
+        sync(tc, job, n=2)  # arm probe + create reservation
+        res = cs.pods.get("default", "job-trainer-2")
+        env = {e.name: e.value for e in res.spec.containers[0].env}
+        assert env[constants.RESERVATION_ENV] == "1"
+        base = cs.pods.get("default", "job-trainer-0")
+        base_env = {e.name for e in base.spec.containers[0].env}
+        assert constants.RESERVATION_ENV not in base_env

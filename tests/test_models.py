@@ -4,6 +4,9 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
+from conftest import apply_jax_platform_override
+
+apply_jax_platform_override()
 import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P

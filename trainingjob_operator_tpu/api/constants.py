@@ -53,6 +53,10 @@ NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
 MEGASCALE_COORDINATOR_ENV = "MEGASCALE_COORDINATOR_ADDRESS"
 CHECKPOINT_DIR_ENV = "TRAININGJOB_CHECKPOINT_DIR"
 ELASTIC_REPLICAS_ENV = "TRAININGJOB_ELASTIC_REPLICAS"
+# Set to "1" on re-expand reservation pods: the workload must idle (capacity
+# canary), not join the (full) rendezvous -- it is restarted with a real rank
+# once the resize commits.
+RESERVATION_ENV = "TRAININGJOB_RESERVATION"
 
 # --- GKE TPU node selectors / resources (north star: BASELINE.json) ---------
 GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"

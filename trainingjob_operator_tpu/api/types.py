@@ -364,6 +364,21 @@ class TrainingJobStatus:
     # TPU extension: current elastic width per replica group (replicas actually
     # provisioned right now; differs from spec.replicas while degraded).
     elastic_replicas: Dict[str, int] = field(default_factory=dict)
+    # TPU extension: elastic-resize drain marker (mirrors restart_replica_name:
+    # while set, reconcile stalls until the group's pods drain, then the group
+    # is recreated at the new width with fresh rendezvous env).
+    scaling_replica_name: str = ""
+    # TPU extension: per-group wall time of the last elastic resize and number
+    # of re-expand probes since the group last ran at full width (drives the
+    # exponential scale-up backoff; keyed by replica name so independent
+    # elastic groups don't corrupt each other's schedule).
+    last_scale_times: Dict[str, float] = field(default_factory=dict)
+    scale_up_attempts: Dict[str, int] = field(default_factory=dict)
+    # TPU extension: in-flight non-destructive re-expand probes (rtype ->
+    # target width).  While set, reservation pods are provisioned beyond the
+    # elastic width; the running group is only re-rendezvoused once they all
+    # schedule, so a failed probe never tears down running work.
+    scale_probes: Dict[str, int] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"phase": self.phase}
@@ -385,6 +400,14 @@ class TrainingJobStatus:
             d["lastReconcileTime"] = iso(self.last_reconcile_time)
         if self.elastic_replicas:
             d["elasticReplicas"] = dict(self.elastic_replicas)
+        if self.scaling_replica_name:
+            d["scalingReplicaName"] = self.scaling_replica_name
+        if self.last_scale_times:
+            d["lastScaleTimes"] = {n: iso(t) for n, t in self.last_scale_times.items()}
+        if self.scale_up_attempts:
+            d["scaleUpAttempts"] = dict(self.scale_up_attempts)
+        if self.scale_probes:
+            d["scaleProbes"] = dict(self.scale_probes)
         return d
 
     @classmethod
@@ -401,6 +424,13 @@ class TrainingJobStatus:
             end_time=from_iso(d.get("endTime")),
             last_reconcile_time=from_iso(d.get("lastReconcileTime")),
             elastic_replicas={n: int(v) for n, v in (d.get("elasticReplicas") or {}).items()},
+            scaling_replica_name=d.get("scalingReplicaName", ""),
+            last_scale_times={n: from_iso(t)
+                              for n, t in (d.get("lastScaleTimes") or {}).items()},
+            scale_up_attempts={n: int(v)
+                               for n, v in (d.get("scaleUpAttempts") or {}).items()},
+            scale_probes={n: int(v)
+                          for n, v in (d.get("scaleProbes") or {}).items()},
         )
 
 

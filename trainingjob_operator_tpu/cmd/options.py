@@ -39,6 +39,12 @@ class OperatorOptions:
     gc_interval: float = 600.0                 # reference: controller.go:204
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     backend: str = "sim"                       # sim | localproc | kube
+    # Elastic resize (TPU extension; the reference never resizes, SURVEY §2.6):
+    # how long a pod may sit unschedulable before the group shrinks to the
+    # replicas that did get capacity, and how long a degraded group runs before
+    # the first re-expand probe (doubles per failed probe, capped at 15 min).
+    scale_pending_time: float = 30.0
+    scale_up_delay: float = 30.0
 
     @classmethod
     def add_flags(cls, parser: argparse.ArgumentParser) -> None:
@@ -71,6 +77,13 @@ class OperatorOptions:
                             help="Path of the leader-election lock file.")
         parser.add_argument("--backend", choices=("sim", "localproc", "kube"),
                             default="sim", help="Cluster runtime backend.")
+        parser.add_argument("--scale-pending-period", type=float, default=30.0,
+                            dest="scale_pending_time",
+                            help="Unschedulable grace before an elastic group "
+                                 "shrinks to scheduled capacity, seconds.")
+        parser.add_argument("--scale-up-delay", type=float, default=30.0,
+                            help="Delay before a degraded elastic group probes "
+                                 "a re-expand, seconds (exponential backoff).")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "OperatorOptions":
@@ -86,6 +99,8 @@ class OperatorOptions:
             enable_creating_failed=args.enable_creating_failed,
             gc_interval=args.gc_interval,
             backend=args.backend,
+            scale_pending_time=args.scale_pending_time,
+            scale_up_delay=args.scale_up_delay,
         )
         opt.leader_election.leader_elect = args.leader_elect
         opt.leader_election.lock_path = args.leader_lock

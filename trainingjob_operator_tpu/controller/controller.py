@@ -257,7 +257,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
 
         ending_phases: Dict[str, str] = {}
         aggregation_msg: List[str] = []
-        if not job.status.restart_replica_name:
+        if (not job.status.restart_replica_name
+                and not job.status.scaling_replica_name):
             for rtype in sorted(job.spec.replica_specs):
                 ending_phase, msg = self.reconcile_pods(job, pods, rtype)
                 if msg and msg not in aggregation_msg:
@@ -270,6 +271,13 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         job, TrainingJobPhase.TERMINATING,
                         constants.TERMINATING_REASON, msg)
                     job.status.restart_replica_name = rtype
+                    break
+                if ending_phase == TrainingJobPhase.SCALING:
+                    # Elastic resize: same two-phase drain, scaling marker.
+                    update_job_conditions(
+                        job, TrainingJobPhase.SCALING,
+                        constants.SCALING_REASON, msg)
+                    job.status.scaling_replica_name = rtype
                     break
                 if ending_phase:
                     ending_phases[rtype] = ending_phase

@@ -53,6 +53,20 @@ def get_slices(objects: Sequence[Any], replicas: int) -> List[List[Any]]:
     return slices
 
 
+def pod_index(obj: Any) -> Optional[int]:
+    """The replica-index label as an int, or None when absent/garbled."""
+    raw = obj.metadata.labels.get(constants.REPLICA_INDEX_LABEL, "")
+    return int(raw) if raw.isdigit() else None
+
+
+def pods_below_width(objects: Sequence[Any], width: int) -> List[Any]:
+    """Objects whose index is inside the current elastic width.  Reservation
+    (probe) pods and not-yet-drained out-of-range pods sit above it and must
+    not count toward the group's replica status."""
+    return [o for o in objects
+            if (idx := pod_index(o)) is not None and idx < width]
+
+
 def is_retryable_exit_code(exit_codes: Sequence[int], restarting_exit_code: str) -> bool:
     """True iff every observed non-zero exit code is in the configured retry
     set (reference: isRetryableExitCode, controller.go:442-452 -- AND over

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
 from trainingjob_operator_tpu.api.types import (
+    EdlPolicy,
     RestartPolicy,
     RestartScope,
     EndingPolicy,
@@ -32,6 +33,8 @@ from trainingjob_operator_tpu.controller.naming import (
     gen_labels,
     get_slices,
     is_retryable_exit_code,
+    pod_index,
+    pods_below_width,
 )
 from trainingjob_operator_tpu.controller.service import get_ports_from_container, get_ports_from_job
 from trainingjob_operator_tpu.core.objects import (
@@ -134,27 +137,66 @@ class PodReconciler:
         self._initialize_replica_status(job, rtype)
         self._initialize_restart_counts(job, rtype)
 
-        pod_slices = get_slices(replica_pods, replicas)
+        # An in-flight re-expand probe provisions reservation slots beyond the
+        # elastic width (non-destructive: the running group is untouched until
+        # the reservations actually schedule).
+        probe_target = (job.status.scale_probes.get(rtype, 0)
+                        if spec.edl_policy == EdlPolicy.AUTO else 0)
+        pod_slices = get_slices(replica_pods, max(replicas, probe_target))
         node_ready = self.get_node_status()
         message = ""
         failed_reasons: List[str] = []
         failed_phase = TrainingJobPhase.FAILED
         creating_msgs: Dict[str, List[str]] = {}
+        now = time.time()
+        unschedulable = 0
+        probe_failed = False
 
         for index, pod_slice in enumerate(pod_slices):
             if not pod_slice:
                 log.info("creating pod %s/%s %s-%d", job.namespace, job.name, rt, index)
                 self.create_new_pod(job, rt, str(index),
-                                    str(job.status.restart_counts.get(rtype, 0)), spec)
+                                    str(job.status.restart_counts.get(rtype, 0)),
+                                    spec, reservation=index >= replicas)
                 continue
 
             pod = pod_slice[0]
+            if index >= replicas:
+                # Reservation slot: capacity canary only -- none of the policy
+                # machinery applies until the group re-rendezvouses.
+                created = pod.metadata.creation_timestamp
+                stale = (created is not None and
+                         now - created > self.options.scale_pending_time)
+                dead_node = (pod.spec.node_name
+                             and pod.spec.node_name not in node_ready)
+                if ((stale and self.get_pod_scheduling_message(pod))
+                        or dead_node or pod.status.phase == PodPhase.FAILED):
+                    probe_failed = True
+                continue
             sched_msg = self.get_pod_scheduling_message(pod)
             if sched_msg:
                 message = f"{rt}: {sched_msg} "
+                created = pod.metadata.creation_timestamp
+                if (created is not None
+                        and now - created > self.options.scale_pending_time):
+                    unschedulable += 1
             phase, is_restart, cmsg = self.reconcile_containers(job, pod, rtype, node_ready)
             if cmsg:
                 failed_reasons.append(cmsg)
+
+            if phase == TrainingJobPhase.NODE_FAIL:
+                # Elastic shrink on capacity loss (TPU spot preemption / host
+                # failure): instead of blocking on a full-width restart, drop
+                # the group to the surviving replicas and re-rendezvous.  New
+                # semantics -- the reference declares Min/MaxReplicas but never
+                # resizes (SURVEY.md §2.6); does not consume restart_limit.
+                ending = self._maybe_shrink_on_capacity_loss(
+                    job, rtype, rt, spec, replicas, pods, replica_pods,
+                    node_ready, cmsg)
+                if ending:
+                    self._recount_replica_status(
+                        job, rtype, pods_below_width(replica_pods, replicas))
+                    return ending
 
             if is_restart:
                 limit = spec.restart_limit
@@ -162,7 +204,8 @@ class PodReconciler:
                     ending = self._restart_pods(job, rtype, rt, pod, pods, pod_slices,
                                                 phase, cmsg)
                     if ending:
-                        self._recount_replica_status(job, rtype, replica_pods)
+                        self._recount_replica_status(
+                            job, rtype, pods_below_width(replica_pods, replicas))
                         return ending
 
             if phase == TrainingJobPhase.CREATING:
@@ -188,7 +231,8 @@ class PodReconciler:
             if phase == TrainingJobPhase.NODE_FAIL:
                 failed_phase = TrainingJobPhase.NODE_FAIL
 
-        self._recount_replica_status(job, rtype, replica_pods)
+        self._recount_replica_status(
+            job, rtype, pods_below_width(replica_pods, replicas))
         rs = job.status.replica_statuses[rtype]
 
         # Whole-group ending policies (pod.go:298-315).
@@ -199,10 +243,184 @@ class PodReconciler:
                 message = ", ".join(failed_reasons)
             return failed_phase, f"All {rtype} pods are failed, {message}"
 
+        # Resolve an in-flight re-expand probe: all reservations scheduled ->
+        # commit (the only destructive step, taken exactly when capacity is
+        # confirmed); any reservation starved/failed -> discard reservations,
+        # keep the running group untouched, back off.
+        if probe_target:
+            ending = self._resolve_expand_probe(job, rtype, rt, replicas,
+                                                probe_target, probe_failed,
+                                                pods, replica_pods, now)
+            if ending:
+                return ending
+
+        # Elastic starvation shrink: replicas stuck unschedulable past the
+        # grace window give their slots back (shrink to scheduled capacity,
+        # floor min_replicas).  Covers initial admission onto a partial
+        # cluster.  Never fires once part of the group has succeeded -- a
+        # resize would discard and re-run the finished work.
+        if (unschedulable and spec.edl_policy == EdlPolicy.AUTO
+                and rs.succeeded == 0):
+            new_width = max(replicas - unschedulable, self._min_width(spec))
+            if new_width < replicas:
+                return self._elastic_resize(
+                    job, rtype, rt, new_width, pods, replica_pods, force=False,
+                    msg=f"{unschedulable} {rt} pods unschedulable for "
+                        f">{self.options.scale_pending_time:.0f}s; shrinking "
+                        f"{replicas}->{new_width}")
+
+        # Elastic re-expand: a degraded group that is stably running starts a
+        # non-destructive capacity probe after a (backed-off) delay.
+        self._maybe_start_expand_probe(job, rtype, rt, spec, replicas, rs, now)
+
         if creating_msgs:
             msgs = [f"pods {pods_} {m}" for m, pods_ in creating_msgs.items()]
             return TrainingJobPhase.NONE, ", ".join(msgs)
         return TrainingJobPhase.NONE, message
+
+    # -- elastic resize (TPU extension; SURVEY.md §2.6, §5.3 "Gap vs.
+    #    elastic" -- the north-star <90s recovery path) ----------------------
+
+    @staticmethod
+    def _min_width(spec: Any) -> int:
+        """Shrink floor: never below 1 -- a group elastically resized to zero
+        could neither probe back up nor distinguish itself from completion."""
+        desired = spec.replicas if spec.replicas is not None else 1
+        lo = spec.min_replicas if spec.min_replicas is not None else desired
+        return max(lo, 1)
+
+    @staticmethod
+    def _full_width(spec: Any) -> int:
+        """Expansion target: maxReplicas when set (making the field live,
+        unlike the reference where it is dead, SURVEY.md §2.6), else the
+        declared width."""
+        desired = spec.replicas if spec.replicas is not None else 1
+        if spec.max_replicas is not None:
+            return max(desired, spec.max_replicas)
+        return desired
+
+    def _maybe_shrink_on_capacity_loss(self, job: TPUTrainingJob, rtype: str,
+                                       rt: str, spec: Any, replicas: int,
+                                       all_pods: List[Pod],
+                                       replica_pods: List[Pod],
+                                       node_ready: Dict[str, bool],
+                                       msg: str) -> Optional[Tuple[str, str]]:
+        if spec.edl_policy != EdlPolicy.AUTO:
+            return None
+        base_pods = pods_below_width(replica_pods, replicas)
+        if any(p.status.phase == PodPhase.SUCCEEDED for p in base_pods):
+            return None  # resizing would discard finished work
+        lost = sum(1 for p in base_pods
+                   if p.spec.node_name and p.spec.node_name not in node_ready)
+        new_width = max(replicas - lost, self._min_width(spec))
+        if lost == 0 or new_width >= replicas:
+            return None  # nothing lost, or already at the floor -> restart path
+        return self._elastic_resize(
+            job, rtype, rt, new_width, all_pods, replica_pods, force=True,
+            msg=f"{lost} {rt} pods lost their node ({msg}); shrinking "
+                f"{replicas}->{new_width}")
+
+    def _maybe_start_expand_probe(self, job: TPUTrainingJob, rtype: str,
+                                  rt: str, spec: Any, replicas: int,
+                                  rs: Any, now: float) -> None:
+        """Arm a non-destructive capacity probe: reservation pods beyond the
+        current width are provisioned on the next sync; the running group is
+        only re-rendezvoused once they all schedule."""
+        full = self._full_width(spec)
+        if (spec.edl_policy != EdlPolicy.AUTO or replicas >= full
+                or rs.active != replicas or replicas == 0
+                or rtype in job.status.scale_probes):
+            return
+        last = job.status.last_scale_times.get(rtype)
+        if last is None:
+            return
+        attempts = job.status.scale_up_attempts.get(rtype, 0)
+        delay = min(self.options.scale_up_delay * (2 ** attempts), 900.0)
+        if now - last < delay:
+            # Re-check when the backoff expires.
+            self.enqueue_job(job, delay=max(delay - (now - last), 1.0))
+            return
+        job.status.scale_probes[rtype] = full
+        job.status.last_scale_times[rtype] = now
+        self.recorder.event(
+            job, EventRecorder.NORMAL, constants.SCALING_REASON,
+            f"probing capacity to re-expand {rt} {replicas}->{full} "
+            f"(attempt {attempts + 1})")
+        self.enqueue_job(job)
+
+    def _resolve_expand_probe(self, job: TPUTrainingJob, rtype: str, rt: str,
+                              replicas: int, probe_target: int,
+                              probe_failed: bool, all_pods: List[Pod],
+                              replica_pods: List[Pod],
+                              now: float) -> Optional[Tuple[str, str]]:
+        probe_pods = [p for p in replica_pods
+                      if (idx := pod_index(p)) is not None and idx >= replicas]
+        if probe_failed:
+            for p in probe_pods:
+                self.pod_control.delete_pod(p.namespace, p.name, job)
+            job.status.scale_probes.pop(rtype, None)
+            job.status.scale_up_attempts[rtype] = (
+                job.status.scale_up_attempts.get(rtype, 0) + 1)
+            job.status.last_scale_times[rtype] = now
+            self.recorder.event(
+                job, EventRecorder.NORMAL, constants.SCALING_REASON,
+                f"re-expand probe of {rt} to {probe_target} found no "
+                f"capacity; staying at {replicas}")
+            return None
+        if any(p.status.phase == PodPhase.SUCCEEDED
+               for p in pods_below_width(replica_pods, replicas)):
+            # The group started completing while the probe was in flight:
+            # committing would discard finished work.  Cancel the probe.
+            for p in probe_pods:
+                self.pod_control.delete_pod(p.namespace, p.name, job)
+            job.status.scale_probes.pop(rtype, None)
+            return None
+        if (len(probe_pods) == probe_target - replicas
+                and all(p.spec.node_name for p in probe_pods)):
+            # Capacity confirmed: commit the resize (the one destructive step).
+            job.status.scale_probes.pop(rtype, None)
+            return self._elastic_resize(
+                job, rtype, rt, probe_target, all_pods, replica_pods,
+                force=False,
+                msg=f"capacity confirmed; re-expanding {rt} "
+                    f"{replicas}->{probe_target}")
+        return None
+
+    def _elastic_resize(self, job: TPUTrainingJob, rtype: str, rt: str,
+                        new_width: int, all_pods: List[Pod],
+                        replica_pods: List[Pod], force: bool,
+                        msg: str) -> Tuple[str, str]:
+        """Record the new width and drain: a width change invalidates the
+        rendezvous env (world size, host lists) of every pod that names this
+        group, so the resized group -- and, in a multi-group job, every other
+        group whose env cross-references it (setEnv injects all groups' host
+        lists, pod.go:548-652) -- restarts together and re-assembles at the
+        new size.  Already-succeeded pods of other groups keep their finished
+        work.  Reuses the two-phase drain machinery
+        (status.scaling_replica_name, mirroring the reference's
+        RestartReplicaName flow, status.go:114-143).
+        """
+        spec = job.spec.replica_specs[rtype]
+        desired = spec.replicas if spec.replicas is not None else 1
+        if new_width == desired:
+            job.status.elastic_replicas.pop(rtype, None)
+        else:
+            job.status.elastic_replicas[rtype] = new_width
+        # A resize supersedes any in-flight probe (its reservations are
+        # deleted with the rest of the group below).
+        job.status.scale_probes.pop(rtype, None)
+        job.status.last_scale_times[rtype] = time.time()
+        self.recorder.event(job, EventRecorder.NORMAL, constants.SCALING_REASON, msg)
+        log.info("elastic resize %s/%s %s: %s", job.namespace, job.name, rt, msg)
+        grace = 0 if force else None
+        targets = list(replica_pods)
+        if len(job.spec.replica_specs) > 1:
+            targets += [p for p in all_pods
+                        if p.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+                        != rt and p.status.phase != PodPhase.SUCCEEDED]
+        for p in targets:
+            self.pod_control.delete_pod(p.namespace, p.name, job, grace_period=grace)
+        return TrainingJobPhase.SCALING, msg
 
     def _restart_pods(self, job: TPUTrainingJob, rtype: str, rt: str, pod: Pod,
                       all_pods: List[Pod], pod_slices: List[List[Pod]],
@@ -340,7 +558,8 @@ class PodReconciler:
     # -- pod creation (reference: pod.go:483-546) ----------------------------
 
     def create_new_pod(self, job: TPUTrainingJob, rt: str, index: str,
-                       restart_count: str, spec: Any) -> None:
+                       restart_count: str, spec: Any,
+                       reservation: bool = False) -> None:
         job_key = meta_namespace_key(job)
         self.expectations.expect_creations(pods_key(job_key, rt), 1)
 
@@ -367,6 +586,12 @@ class PodReconciler:
             pod.spec.scheduler_name = job.spec.scheduler_name
 
         self.set_env(pod, job, spec, rt, index, restart_count)
+        if reservation:
+            # Re-expand capacity canary: the workload idles instead of joining
+            # a rendezvous whose world it is not part of
+            # (rendezvous.hold_reservation_if_needed).
+            for container in pod.spec.init_containers + pod.spec.containers:
+                container.env.append(EnvVar(constants.RESERVATION_ENV, "1"))
         self.set_tpu_provisioning(pod, job, spec, rt, index)
 
         if spec.restart_policy:

@@ -100,6 +100,14 @@ class ServiceReconciler:
         for index, service_slice in enumerate(service_slices):
             if not service_slice and has_container_port(job, rtype):
                 self.create_new_service(job, rtype, str(index), ports)
+        # Elastic shrink leaves services beyond the current width; remove them
+        # so DNS reflects the live world (the reference never deletes services,
+        # service.go:83-88 -- but it also never resizes).
+        for svc in rt_services:
+            idx = svc.metadata.labels.get(constants.REPLICA_INDEX_LABEL, "")
+            if idx.isdigit() and int(idx) >= replicas:
+                self.service_control.delete_service(svc.metadata.namespace,
+                                                    svc.metadata.name, job)
 
     def create_new_service(self, job: TPUTrainingJob, rtype: str, index: str,
                            ports: List[int]) -> None:

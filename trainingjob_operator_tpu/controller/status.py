@@ -30,6 +30,7 @@ from trainingjob_operator_tpu.client.tracker import ConflictError
 from trainingjob_operator_tpu.controller.naming import (
     effective_replicas,
     filter_for_replica_type,
+    pods_below_width,
 )
 from trainingjob_operator_tpu.core.objects import (
     Condition,
@@ -152,7 +153,46 @@ class StatusManager:
         for rtype in job.spec.replica_specs:
             self._initialize_replica_status(job, rtype)
             rt_pods = filter_for_replica_type(pods, rtype.lower())
-            self._recount_replica_status(job, rtype, rt_pods)
+            # Reservation (probe) pods and not-yet-drained out-of-range pods
+            # sit above the elastic width and must not count.
+            self._recount_replica_status(
+                job, rtype,
+                pods_below_width(rt_pods, effective_replicas(job, rtype)))
+
+        # Elastic-resize drain: wait for the resized group's pods to vanish,
+        # then clear the marker so the next sync recreates the group at the
+        # new width with fresh rendezvous env (mirrors the restart drain).
+        if job.status.scaling_replica_name:
+            rname = job.status.scaling_replica_name
+            if rname not in job.spec.replica_specs:
+                job.status.scaling_replica_name = ""
+                return
+            # A resize re-rendezvouses every group whose env references the
+            # resized one -- all of them in a multi-group job (pod.py
+            # _elastic_resize) -- so wait on the matching pod set.  Succeeded
+            # pods of other groups keep their finished work and are excluded.
+            if len(job.spec.replica_specs) > 1:
+                scope_pods = [
+                    p for p in pods
+                    if (p.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+                        == rname.lower()
+                        or p.status.phase != PodPhase.SUCCEEDED)]
+            else:
+                scope_pods = filter_for_replica_type(pods, rname.lower())
+            if len(scope_pods) == 0:
+                width = effective_replicas(job, rname)
+                update_job_conditions(
+                    job, TrainingJobPhase.SCALING, constants.SCALING_REASON,
+                    f"{rname.lower()} resized to {width} replicas; recreating")
+                job.status.scaling_replica_name = ""
+            else:
+                # Converge stragglers: a pod created in the same sync that
+                # triggered the resize missed the original delete sweep and
+                # would wedge the drain forever.
+                for p in scope_pods:
+                    if p.metadata.deletion_timestamp is None:
+                        self.pod_control.delete_pod(p.namespace, p.name, job)
+            return
 
         # Two-phase restart: wait for the scope's pods to drain, then flip to
         # Restarting and clear the marker (status.go:114-143).
@@ -175,7 +215,8 @@ class StatusManager:
                                       PHASE_REASON[TrainingJobPhase.RESTARTING],
                                       f"{rname.lower()} pods are restarting now")
                 job.status.restart_replica_name = ""
-            elif scope == RestartScope.POD and len(rt_pods) < replicas:
+            elif (scope == RestartScope.POD
+                  and len(pods_below_width(rt_pods, replicas)) < replicas):
                 update_job_conditions(job, TrainingJobPhase.RESTARTING,
                                       PHASE_REASON[TrainingJobPhase.RESTARTING],
                                       "pod is restarting now")
@@ -254,9 +295,15 @@ class StatusManager:
                 job.status.start_running_time = now
             update_job_conditions(job, TrainingJobPhase.RUNNING,
                                   constants.RUNNING_REASON, "all pods are running")
+        if is_running and job.status.scale_up_attempts:
+            # Any group back at full width resets its own re-expand backoff.
+            job.status.scale_up_attempts = {
+                rt: n for rt, n in job.status.scale_up_attempts.items()
+                if rt in job.status.elastic_replicas}
 
         if (is_creating and is_scheduled
-                and job.status.phase != TrainingJobPhase.RESTARTING):
+                and job.status.phase not in (TrainingJobPhase.RESTARTING,
+                                             TrainingJobPhase.SCALING)):
             update_job_conditions(job, TrainingJobPhase.CREATING,
                                   constants.CREATING_REASON, message)
 
@@ -265,7 +312,8 @@ class StatusManager:
                                   constants.RESTARTING_REASON, message)
 
         if (not is_scheduled and not is_restarting
-                and job.status.phase != TrainingJobPhase.RESTARTING):
+                and job.status.phase not in (TrainingJobPhase.RESTARTING,
+                                             TrainingJobPhase.SCALING)):
             if job.status.start_time is None:
                 job.status.start_time = now
             update_job_conditions(job, TrainingJobPhase.PENDING,

@@ -31,12 +31,18 @@ def _block_attn(q, k, v, m, l, o, scale, mask):
 
     B, Tq, Hq, D = q.shape
     Tk, Hkv = k.shape[1], k.shape[2]
+    # Softmax statistics in float32 regardless of compute dtype (the flash-
+    # attention convention): bf16 max/exp/sum loses enough precision over long
+    # sequences to move the training loss.
     if Hq != Hkv:
         g = Hq // Hkv
         qg = q.reshape(B, Tq, Hkv, g, D)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).reshape(B, Hq, Tq, Tk) * scale
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32)
+        s = s.reshape(B, Hq, Tq, Tk) * scale
     else:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None, :, :], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -48,9 +54,12 @@ def _block_attn(q, k, v, m, l, o, scale, mask):
     l_new = l * correction + p.sum(axis=-1)
     if Hq != Hkv:
         pg = p.reshape(B, Hkv, Hq // Hkv, Tq, Tk)
-        pv = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v).reshape(B, Tq, Hq, D)
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v,
+                        preferred_element_type=jnp.float32)
+        pv = pv.reshape(B, Tq, Hq, D)
     else:
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                        preferred_element_type=jnp.float32)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -72,9 +81,10 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
 
-    m0 = jnp.full((B, H, T), NEG_INF, q.dtype)
-    l0 = jnp.zeros((B, H, T), q.dtype)
-    o0 = jnp.zeros_like(q)
+    # f32 accumulators (softmax stats + output) independent of compute dtype.
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
 
     base = jnp.arange(T)
 
@@ -98,7 +108,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
 
     m, l, o, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, o0, k, v))
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return o / denom
+    return (o / denom).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
@@ -133,10 +143,12 @@ def reference_attention(q, k, v, *, causal: bool = True,
 
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jnp.exp(s - s.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

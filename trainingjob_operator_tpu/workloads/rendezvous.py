@@ -35,12 +35,25 @@ class Rendezvous:
     tpu_topology: str = ""
     slice_id: int = 0
     num_slices: int = 1
+    is_reservation: bool = False
     group_instances: Dict[str, List[str]] = field(default_factory=dict)
     group_hosts: Dict[str, List[str]] = field(default_factory=dict)
 
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    def hold_reservation_if_needed(self) -> None:
+        """Re-expand reservation pods (capacity canaries) idle here instead of
+        joining a rendezvous they are not part of; the operator restarts them
+        with a real rank once the resize commits.  Call first in every
+        workload main."""
+        if not self.is_reservation:
+            return
+        import time as _time
+
+        while True:  # until the operator deletes/restarts this pod
+            _time.sleep(3600)
 
     def hosts(self, group: str) -> List[str]:
         """host:port list of a replica group (after any localproc rewrite)."""
@@ -65,6 +78,7 @@ def from_env(env: Optional[Dict[str, str]] = None) -> Rendezvous:
         tpu_topology=e.get(constants.TPU_TOPOLOGY_ENV, ""),
         slice_id=int(e.get(constants.SLICE_ID_ENV, "0") or 0),
         num_slices=int(e.get(constants.NUM_SLICES_ENV, "1") or 1),
+        is_reservation=e.get(constants.RESERVATION_ENV, "") == "1",
     )
     for key, value in e.items():
         if key.endswith("_INSTANCES") and not key.endswith("_NUM"):
@@ -86,6 +100,7 @@ def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
     plane (coordinator + process ids).
     """
     rdv = rdv or from_env()
+    rdv.hold_reservation_if_needed()  # capacity canaries never join
     apply_platform_override()
     if rdv.num_processes > 1 and rdv.coordinator_address:
         import jax
