@@ -996,3 +996,44 @@ class TestElastic:
         base = cs.pods.get("default", "job-trainer-0")
         base_env = {e.name for e in base.spec.containers[0].env}
         assert constants.RESERVATION_ENV not in base_env
+
+    def test_reexpand_partial_capacity_commits_partial_width(self):
+        """Probe to full width with only part of the capacity back: commit
+        the replicas that landed instead of discarding them."""
+        cs, tc = make_env()
+        tc.options.scale_up_delay = 0.01
+        tc.options.scale_pending_time = 0.03
+        job = self._running_elastic_job(cs, tc)  # width 3 on node-0..2
+        for name in ("node-1", "node-2"):
+            node = cs.nodes.get_node(name)
+            node.status.conditions[0].status = ConditionStatus.FALSE
+            cs.nodes.update(node)
+        sync(tc, job, n=3)  # shrink to 1, drain, recreate
+        set_pod_running(cs, "job-trainer-0", node="node-0")
+        sync(tc, job)
+        assert get_job(cs).status.elastic_replicas == {"trainer": 1}
+        # Only node-1 comes back.
+        node = cs.nodes.get_node("node-1")
+        node.status.conditions[0].status = ConditionStatus.TRUE
+        cs.nodes.update(node)
+        time.sleep(0.02)
+        sync(tc, job)  # arm probe to 3
+        assert get_job(cs).status.scale_probes == {"trainer": 3}
+        sync(tc, job)  # reservations 1 and 2 created
+        pod = cs.pods.get("default", "job-trainer-1")
+        pod.spec.node_name = "node-1"
+        cs.pods.update(pod)
+        pod = cs.pods.get("default", "job-trainer-2")
+        pod.status.conditions = [Condition(
+            type="PodScheduled", status=ConditionStatus.FALSE,
+            reason="Unschedulable", message="0/2 nodes available")]
+        cs.pods.update(pod)
+        time.sleep(0.05)
+        sync(tc, job)
+        got = get_job(cs)
+        # Partial commit: width 2 (landed reservation), not a discard.
+        assert got.status.elastic_replicas == {"trainer": 2}
+        assert got.status.scaling_replica_name == "trainer"
+        sync(tc, job, n=2)
+        assert [p.name for p in pods_of(cs)] == [
+            "job-trainer-0", "job-trainer-1"]

@@ -154,3 +154,35 @@ class TestLocalProc:
             and all(p.metadata.labels[constants.RESTART_COUNT_LABEL] == "2"
                     and p.spec.node_name != victim
                     for p in cs.pods.list("default")), 20), phase(cs, "nf")
+
+
+class TestPSWorkerE2E:
+    def test_ps_worker_job_completes(self, cluster):
+        """BASELINE config 2: PS + worker ReplicaSpecs as real subprocesses,
+        rendezvousing through the injected multi-group env."""
+        cs, tc, rt = cluster
+        job = TPUTrainingJob(metadata=ObjectMeta(name="psjob",
+                                                 namespace="default"))
+        from trainingjob_operator_tpu.core.objects import EnvVar
+
+        def group(port, n):
+            return ReplicaSpec(
+                replicas=n,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(
+                        name="aitj-main",
+                        command=[sys.executable, "-u", "-m",
+                                 "trainingjob_operator_tpu.workloads.ps_worker"],
+                        env=[EnvVar("MNIST_STEPS", "8"),
+                             EnvVar("MNIST_BATCH", "16"),
+                             EnvVar("MNIST_HIDDEN", "16"),
+                             EnvVar("PS_TIMEOUT", "60")],
+                        ports=[ContainerPort(name=f"aitj-{port}",
+                                             container_port=port)])])))
+
+        job.spec.replica_specs["pserver"] = group(7821, 1)
+        job.spec.replica_specs["worker"] = group(7831, 2)
+        cs.trainingjobs.create(job)
+        assert wait_for(
+            lambda: phase(cs, "psjob") == TrainingJobPhase.SUCCEEDED, 60), \
+            phase(cs, "psjob")

@@ -125,3 +125,23 @@ class TestRingAttention:
         g = jax.grad(loss)(qs)
         assert g.shape == q.shape
         assert bool(jnp.isfinite(g).all())
+
+
+class TestFitSpec:
+    def test_truncates_spec_longer_than_rank(self):
+        from jax.sharding import PartitionSpec as P
+
+        from trainingjob_operator_tpu.parallel.sharding import fit_spec
+
+        mesh = make_mesh(MeshSpec.of(fsdp=4, tp=2))
+        fitted = fit_spec(P(None, "fsdp", "tp"), (16, 16), mesh)
+        assert len(fitted) <= 2
+
+    def test_replicates_non_divisible_axes(self):
+        from jax.sharding import PartitionSpec as P
+
+        from trainingjob_operator_tpu.parallel.sharding import fit_spec
+
+        mesh = make_mesh(MeshSpec.of(fsdp=4, tp=2))
+        fitted = fit_spec(P(None, "fsdp", "tp"), (2, 6, 8), mesh)
+        assert fitted == P(None, None, "tp")

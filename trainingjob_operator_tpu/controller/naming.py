@@ -53,6 +53,15 @@ def get_slices(objects: Sequence[Any], replicas: int) -> List[List[Any]]:
     return slices
 
 
+def full_width(spec: Any) -> int:
+    """Elastic expansion target: maxReplicas when set (live semantics, unlike
+    the reference's dead field, SURVEY.md §2.6), else the declared width."""
+    desired = spec.replicas if spec.replicas is not None else 1
+    if spec.max_replicas is not None:
+        return max(desired, spec.max_replicas)
+    return desired
+
+
 def pod_index(obj: Any) -> Optional[int]:
     """The replica-index label as an int, or None when absent/garbled."""
     raw = obj.metadata.labels.get(constants.REPLICA_INDEX_LABEL, "")

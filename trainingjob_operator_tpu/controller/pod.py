@@ -32,6 +32,7 @@ from trainingjob_operator_tpu.controller.naming import (
     gen_general_name,
     gen_labels,
     get_slices,
+    full_width,
     is_retryable_exit_code,
     pod_index,
     pods_below_width,
@@ -250,7 +251,8 @@ class PodReconciler:
         if probe_target:
             ending = self._resolve_expand_probe(job, rtype, rt, replicas,
                                                 probe_target, probe_failed,
-                                                pods, replica_pods, now)
+                                                pods, replica_pods,
+                                                node_ready, now)
             if ending:
                 return ending
 
@@ -291,13 +293,7 @@ class PodReconciler:
 
     @staticmethod
     def _full_width(spec: Any) -> int:
-        """Expansion target: maxReplicas when set (making the field live,
-        unlike the reference where it is dead, SURVEY.md §2.6), else the
-        declared width."""
-        desired = spec.replicas if spec.replicas is not None else 1
-        if spec.max_replicas is not None:
-            return max(desired, spec.max_replicas)
-        return desired
+        return full_width(spec)
 
     def _maybe_shrink_on_capacity_loss(self, job: TPUTrainingJob, rtype: str,
                                        rt: str, spec: Any, replicas: int,
@@ -352,10 +348,43 @@ class PodReconciler:
                               replicas: int, probe_target: int,
                               probe_failed: bool, all_pods: List[Pod],
                               replica_pods: List[Pod],
+                              node_ready: Dict[str, bool],
                               now: float) -> Optional[Tuple[str, str]]:
         probe_pods = [p for p in replica_pods
                       if (idx := pod_index(p)) is not None and idx >= replicas]
+        if any(p.status.phase == PodPhase.SUCCEEDED
+               for p in pods_below_width(replica_pods, replicas)):
+            # The group started completing while the probe was in flight:
+            # committing would discard finished work.  Cancel the probe.
+            for p in probe_pods:
+                self.pod_control.delete_pod(p.namespace, p.name, job)
+            job.status.scale_probes.pop(rtype, None)
+            return None
+        landed = [p for p in probe_pods
+                  if p.spec.node_name and p.spec.node_name in node_ready
+                  and p.status.phase != PodPhase.FAILED]
+        if (not probe_failed
+                and len(probe_pods) == probe_target - replicas
+                and len(landed) == len(probe_pods)):
+            # Full capacity confirmed: commit (the one destructive step).
+            job.status.scale_probes.pop(rtype, None)
+            return self._elastic_resize(
+                job, rtype, rt, probe_target, all_pods, replica_pods,
+                force=False,
+                msg=f"capacity confirmed; re-expanding {rt} "
+                    f"{replicas}->{probe_target}")
         if probe_failed:
+            if landed:
+                # Partial capacity: commit what actually landed rather than
+                # training below available capacity forever (the remaining
+                # gap re-probes with backoff from the new width).
+                job.status.scale_probes.pop(rtype, None)
+                return self._elastic_resize(
+                    job, rtype, rt, replicas + len(landed), all_pods,
+                    replica_pods, force=False,
+                    msg=f"partial capacity; re-expanding {rt} "
+                        f"{replicas}->{replicas + len(landed)} "
+                        f"(wanted {probe_target})")
             for p in probe_pods:
                 self.pod_control.delete_pod(p.namespace, p.name, job)
             job.status.scale_probes.pop(rtype, None)
@@ -366,24 +395,6 @@ class PodReconciler:
                 job, EventRecorder.NORMAL, constants.SCALING_REASON,
                 f"re-expand probe of {rt} to {probe_target} found no "
                 f"capacity; staying at {replicas}")
-            return None
-        if any(p.status.phase == PodPhase.SUCCEEDED
-               for p in pods_below_width(replica_pods, replicas)):
-            # The group started completing while the probe was in flight:
-            # committing would discard finished work.  Cancel the probe.
-            for p in probe_pods:
-                self.pod_control.delete_pod(p.namespace, p.name, job)
-            job.status.scale_probes.pop(rtype, None)
-            return None
-        if (len(probe_pods) == probe_target - replicas
-                and all(p.spec.node_name for p in probe_pods)):
-            # Capacity confirmed: commit the resize (the one destructive step).
-            job.status.scale_probes.pop(rtype, None)
-            return self._elastic_resize(
-                job, rtype, rt, probe_target, all_pods, replica_pods,
-                force=False,
-                msg=f"capacity confirmed; re-expanding {rt} "
-                    f"{replicas}->{probe_target}")
         return None
 
     def _elastic_resize(self, job: TPUTrainingJob, rtype: str, rt: str,

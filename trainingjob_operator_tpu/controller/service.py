@@ -20,6 +20,7 @@ from trainingjob_operator_tpu.controller.naming import (
     gen_general_name,
     gen_labels,
     get_slices,
+    pod_index,
 )
 from trainingjob_operator_tpu.core.objects import Container, Service, ServicePort, ServiceSpec
 
@@ -104,8 +105,8 @@ class ServiceReconciler:
         # so DNS reflects the live world (the reference never deletes services,
         # service.go:83-88 -- but it also never resizes).
         for svc in rt_services:
-            idx = svc.metadata.labels.get(constants.REPLICA_INDEX_LABEL, "")
-            if idx.isdigit() and int(idx) >= replicas:
+            idx = pod_index(svc)
+            if idx is not None and idx >= replicas:
                 self.service_control.delete_service(svc.metadata.namespace,
                                                     svc.metadata.name, job)
 

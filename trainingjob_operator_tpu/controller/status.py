@@ -30,6 +30,7 @@ from trainingjob_operator_tpu.client.tracker import ConflictError
 from trainingjob_operator_tpu.controller.naming import (
     effective_replicas,
     filter_for_replica_type,
+    full_width,
     pods_below_width,
 )
 from trainingjob_operator_tpu.core.objects import (
@@ -296,10 +297,12 @@ class StatusManager:
             update_job_conditions(job, TrainingJobPhase.RUNNING,
                                   constants.RUNNING_REASON, "all pods are running")
         if is_running and job.status.scale_up_attempts:
-            # Any group back at full width resets its own re-expand backoff.
+            # A group back at FULL width (maxReplicas when set) resets its own
+            # re-expand backoff; groups still below it keep backing off.
             job.status.scale_up_attempts = {
                 rt: n for rt, n in job.status.scale_up_attempts.items()
-                if rt in job.status.elastic_replicas}
+                if rt in spec.replica_specs
+                and effective_replicas(job, rt) < full_width(spec.replica_specs[rt])}
 
         if (is_creating and is_scheduled
                 and job.status.phase not in (TrainingJobPhase.RESTARTING,

@@ -38,12 +38,13 @@ class BertConfig:
         return self.dim // self.n_heads
 
 
+#: Stacked layer params carry a leading scan axis that stays unsharded.
 SHARDING_RULES = [
     (r"tok_embed|pos_embed", ("tp", "fsdp")),
-    (r"attn/w[qkv]$", ("fsdp", "tp")),
-    (r"attn/wo$", ("tp", "fsdp")),
-    (r"mlp/w_in$", ("fsdp", "tp")),
-    (r"mlp/w_out$", ("tp", "fsdp")),
+    (r"attn/w[qkv]$", (None, "fsdp", "tp")),
+    (r"attn/wo$", (None, "tp", "fsdp")),
+    (r"mlp/w_in$", (None, "fsdp", "tp")),
+    (r"mlp/w_out$", (None, "tp", "fsdp")),
     (r".*", ()),
 ]
 

@@ -53,13 +53,15 @@ class LlamaConfig:
 
 #: path-pattern -> PartitionSpec args (parallel/sharding.py Rules).
 #: fsdp shards the big dim; tp shards heads/ffn/vocab.
+#: Megatron-style layout; stacked layer params carry a leading scan axis that
+#: stays unsharded (None) -- fsdp/tp apply to the matmul dims.
 SHARDING_RULES = [
     (r"tok_embed", ("tp", "fsdp")),
     (r"lm_head", ("fsdp", "tp")),
-    (r"attn/w[qkv]$", ("fsdp", "tp")),
-    (r"attn/wo$", ("tp", "fsdp")),
-    (r"mlp/w_(gate|up)$", ("fsdp", "tp")),
-    (r"mlp/w_down$", ("tp", "fsdp")),
+    (r"attn/w[qkv]$", (None, "fsdp", "tp")),
+    (r"attn/wo$", (None, "tp", "fsdp")),
+    (r"mlp/w_(gate|up)$", (None, "fsdp", "tp")),
+    (r"mlp/w_down$", (None, "tp", "fsdp")),
     (r"norm", (None,)),
 ]
 

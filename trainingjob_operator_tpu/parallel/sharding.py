@@ -39,6 +39,27 @@ def spec_for_path(path: str, rules: Rules):
     return P()
 
 
+def fit_spec(spec, shape, mesh):
+    """Drop sharding on axes the dimension cannot divide (fall back to
+    replicated on that axis) -- keeps one rule set valid across model sizes
+    (a tiny debug config and a 7B share the same rules)."""
+    import math
+
+    from jax.sharding import PartitionSpec as P
+
+    fitted: List[Optional[object]] = []
+    for i, entry in enumerate(spec):
+        if i >= len(shape):
+            break  # spec longer than rank (e.g. stacked rule, unstacked leaf)
+        if entry is None:
+            fitted.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        size = math.prod(mesh.shape[n] for n in names)
+        fitted.append(entry if size and shape[i] % size == 0 else None)
+    return P(*fitted)
+
+
 def shard_pytree(tree: Any, rules: Rules, mesh) -> Any:
     """Device-put every leaf with its rule's NamedSharding."""
     import jax
@@ -46,7 +67,8 @@ def shard_pytree(tree: Any, rules: Rules, mesh) -> Any:
 
     def place(key_path, leaf):
         spec = spec_for_path(path_of(key_path), rules)
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(
+            leaf, NamedSharding(mesh, fit_spec(spec, leaf.shape, mesh)))
 
     return jax.tree_util.tree_map_with_path(place, tree)
 
@@ -57,7 +79,9 @@ def sharding_pytree(tree: Any, rules: Rules, mesh) -> Any:
     from jax.sharding import NamedSharding
 
     return jax.tree_util.tree_map_with_path(
-        lambda kp, _: NamedSharding(mesh, spec_for_path(path_of(kp), rules)),
+        lambda kp, leaf: NamedSharding(
+            mesh, fit_spec(spec_for_path(path_of(kp), rules),
+                           getattr(leaf, "shape", ()), mesh)),
         tree)
 
 

@@ -1,0 +1,120 @@
+"""BERT-base masked-LM pretrain -- BASELINE config 4 (v5e-16, 4 hosts).
+
+Multi-host TPU path: every pod calls ``jax.distributed.initialize`` from the
+operator-injected coordinator env (SURVEY.md §5.8), then builds ONE global
+``dp x tp`` mesh over all chips of the slice.  Parameters are sharded by the
+model's rules (tp on the head/ffn axes), the batch by dp; each process feeds
+its local shard of the global batch via
+``make_array_from_process_local_data`` and XLA inserts every collective --
+the multi-host program is byte-identical on every worker.
+
+Run: ``python -m trainingjob_operator_tpu.workloads.bert_pretrain``.
+Env: BERT_CONFIG=tiny|base, BERT_TP (model-parallel width, default 1),
+BERT_STEPS, BERT_BATCH (global), BERT_SEQ, BERT_LR.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def synthetic_mlm_batch(key, batch: int, seq: int, vocab: int,
+                        mask_token: int = 0, rate: float = 0.15):
+    """Random tokens; 15% positions masked out and to be predicted."""
+    import jax
+    import jax.numpy as jnp
+
+    kt, km = jax.random.split(key)
+    targets = jax.random.randint(kt, (batch, seq), 1, vocab)
+    mask = jax.random.bernoulli(km, rate, (batch, seq))
+    tokens = jnp.where(mask, mask_token, targets)
+    return {"tokens": tokens, "targets": targets,
+            "mask": mask.astype(jnp.int32)}
+
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous, train
+
+    rdv = rendezvous.initialize_jax_distributed()
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from trainingjob_operator_tpu.models import bert
+    from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+    from trainingjob_operator_tpu.parallel.sharding import shard_pytree
+
+    cfg = (bert.BertConfig.base()
+           if os.environ.get("BERT_CONFIG", "tiny") == "base"
+           else bert.BertConfig.tiny())
+    tp = int(os.environ.get("BERT_TP", "1"))
+    steps = int(os.environ.get("BERT_STEPS", "20"))
+    global_batch = int(os.environ.get("BERT_BATCH", "32"))
+    seq = int(os.environ.get("BERT_SEQ", "128"))
+    lr = float(os.environ.get("BERT_LR", "1e-4"))
+
+    mesh = mesh_from_rendezvous(rdv, model_parallel=tp)
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    batch_sharding = NamedSharding(mesh, P(data_axes))
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+    if global_batch % n_data != 0:
+        global_batch = max(n_data, global_batch // n_data * n_data)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params = shard_pytree(params, bert.SHARDING_RULES, mesh)
+    tx = optax.adamw(lr, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step_fn(p, o, b):
+        loss, grads = jax.value_and_grad(bert.loss_fn)(p, b, cfg)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    local_batch = global_batch // max(jax.process_count(), 1)
+
+    def batch_at(i):
+        k = jax.random.fold_in(jax.random.PRNGKey(11 + rdv.process_id), i)
+        local = synthetic_mlm_batch(k, local_batch, seq, cfg.vocab_size)
+        if jax.process_count() == 1:
+            return {name: jax.device_put(v, batch_sharding)
+                    for name, v in local.items()}
+        return {name: jax.make_array_from_process_local_data(
+                    batch_sharding, np.asarray(v))
+                for name, v in local.items()}
+
+    # Shared checkpoint path: rank 0 writes, everyone restores (world size
+    # may change across restarts only via job respec; width is fixed here).
+    state = train.CheckpointState.restore_or_init(
+        rdv, {"step": 0}, subdir="bert")
+    start_step = int(state.value["step"])
+
+    loss = None
+    t_start = None
+    for i in range(start_step, steps):
+        params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
+        if i == start_step:
+            jax.block_until_ready(loss)
+            t_start = time.time()
+        if (i + 1) % 10 == 0 or i == steps - 1:
+            print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
+            if rdv.process_id == 0:
+                state.save({"step": i + 1})
+    jax.block_until_ready(loss)
+    dt = max(time.time() - (t_start or time.time()), 1e-9)
+    done = max(steps - start_step - 1, 1)
+    tokens_s = done * global_batch * seq / dt
+    print(f"done: steps={done} tokens/s={tokens_s:.0f} "
+          f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"processes={jax.process_count()} "
+          f"final_loss={float(loss) if loss is not None else -1:.4f}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Elastic Llama-2 pretrain -- BASELINE config 5 (preemptible v5e-32).
+
+The flagship elastic workload: width comes from the operator
+(TRAININGJOB_ELASTIC_REPLICAS / JAX process env), so after a spot preemption
+the SAME program restarts at whatever width survived, rebuilds a narrower
+``dp x fsdp x tp (x sp)`` mesh over the remaining chips, restores the shared
+checkpoint, and keeps training -- the workload half of the operator's elastic
+resize (controller/pod.py _elastic_resize); recovery budget <90 s
+(BASELINE.md).
+
+Parallelism is the scaling-book layout: fsdp shards params/optimizer over the
+data axis (per-layer all-gathers ride ICI), tp shards heads/ffn, sp enables
+ring attention for long context (parallel/ringattention.py), dp carries
+multislice DCN when present.  The global batch is kept constant across widths
+(per-process share rescales), so the loss trajectory is width-independent.
+
+Run: ``python -m trainingjob_operator_tpu.workloads.llama_elastic``.
+Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_STEPS, LLAMA_BATCH
+(global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous, train
+
+    rdv = rendezvous.initialize_jax_distributed()
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from trainingjob_operator_tpu.models import llama
+    from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+    from trainingjob_operator_tpu.parallel.sharding import (
+        batch_spec,
+        shard_pytree,
+        sharding_pytree,
+    )
+
+    cfg = (llama.LlamaConfig.llama2_7b()
+           if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
+           else llama.LlamaConfig.tiny())
+    tp = int(os.environ.get("LLAMA_TP", "1"))
+    sp = int(os.environ.get("LLAMA_SP", "1"))
+    steps = int(os.environ.get("LLAMA_STEPS", "20"))
+    global_batch = int(os.environ.get("LLAMA_BATCH", "8"))
+    seq = int(os.environ.get("LLAMA_SEQ", "128"))
+    lr = float(os.environ.get("LLAMA_LR", "3e-4"))
+    ckpt_every = int(os.environ.get("LLAMA_CKPT_EVERY", "10"))
+
+    mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp)
+    use_sp = sp > 1
+    print(f"elastic width {rdv.elastic_replicas}, mesh "
+          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+          f"{llama.num_params(cfg)/1e6:.1f}M params, restart "
+          f"{rdv.restart_count}", flush=True)
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+    if global_batch % n_data != 0:
+        global_batch = max(n_data, global_batch // n_data * n_data)
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    params = shard_pytree(params, llama.SHARDING_RULES, mesh)
+    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = tx.init(params)
+    batch_sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=use_sp))
+
+    @jax.jit
+    def step_fn(p, o, tokens):
+        def loss(pp):
+            return llama.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh,
+                                 sequence_parallel=use_sp)
+
+        l, grads = jax.value_and_grad(loss)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, l
+
+    local_batch = global_batch // max(jax.process_count(), 1)
+
+    def batch_at(i):
+        k = jax.random.fold_in(jax.random.PRNGKey(17 + rdv.process_id), i)
+        tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
+                                    cfg.vocab_size)
+        if jax.process_count() == 1:
+            return jax.device_put(tokens, batch_sharding)
+        return jax.make_array_from_process_local_data(
+            batch_sharding, np.asarray(tokens))
+
+    # Elastic resume: ONE checkpoint path shared across widths and ranks.
+    # Rank 0 saves host copies (width-independent); every rank restores and
+    # re-shards onto its current mesh.
+    state = train.CheckpointState.restore_or_init(
+        rdv, {"params": None, "opt_state": None, "step": 0}, subdir="llama")
+    start_step = int(state.value["step"])
+    if start_step > 0 and state.value["params"] is not None:
+        params = jax.device_put(
+            state.value["params"],
+            sharding_pytree(state.value["params"], llama.SHARDING_RULES, mesh))
+        # Orbax round-trips NamedTuple/tuple containers as lists; rebuild the
+        # live optimizer structure from the restored leaves, re-sharded like
+        # the freshly-initialized opt state.
+        host_opt = jax.tree.unflatten(jax.tree.structure(opt_state),
+                                      jax.tree.leaves(state.value["opt_state"]))
+
+        def put(host, like):
+            # Mesh-sharded leaves keep their sharding; scalars (adam count)
+            # go mesh-replicated so jit sees one device set.
+            sh = like.sharding if isinstance(like.sharding, NamedSharding) \
+                else NamedSharding(mesh, P())
+            return jax.device_put(host, sh)
+
+        opt_state = jax.tree.map(put, host_opt, opt_state)
+        print(f"resumed at step {start_step} (width "
+              f"{rdv.elastic_replicas})", flush=True)
+
+    def save(i):
+        if rdv.process_id != 0:
+            return
+        state.save({"params": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state), "step": i})
+
+    loss = None
+    t_start = None
+    for i in range(start_step, steps):
+        params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
+        if i == start_step:
+            jax.block_until_ready(loss)
+            t_start = time.time()
+        if (i + 1) % ckpt_every == 0 or i == steps - 1:
+            print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
+            save(i + 1)
+    jax.block_until_ready(loss)
+    dt = max(time.time() - (t_start or time.time()), 1e-9)
+    done = max(steps - start_step - 1, 1)
+    print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "
+          f"width={rdv.elastic_replicas} "
+          f"final_loss={float(loss) if loss is not None else -1:.4f} "
+          f"restart_count={rdv.restart_count}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

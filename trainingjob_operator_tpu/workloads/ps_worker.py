@@ -1,0 +1,247 @@
+"""Parameter-server / worker MNIST -- BASELINE config 2 (the reference's
+TF2-style PS+worker ReplicaSpecs job on 4 CPU pods).
+
+Exercises the multi-group rendezvous contract end-to-end: two replica groups
+("pserver", "worker"), each pod finding the other group through the injected
+``{RT}_HOSTS`` lists (reference: setEnv, pod.go:548-652).  The data plane is a
+minimal real parameter-server protocol over TCP -- parameters are sharded
+across pservers by key; workers pull shards, compute gradients on synthetic
+MNIST, and push updates.  Deliberately numpy-only: PS architectures predate
+the all-reduce style that XLA compiles natively, so this workload exists for
+capability parity on CPU replica groups, not for the TPU fast path (that's
+resnet_dp/bert_pretrain/llama_elastic).
+
+Run: ``python -m trainingjob_operator_tpu.workloads.ps_worker`` inside a pod
+of either group; the entrypoint dispatches on TRAININGJOB_REPLICA_NAME.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+PSERVER_GROUP = "PSERVER"
+WORKER_GROUP = "WORKER"
+
+
+# -- framing ----------------------------------------------------------------
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# -- model (numpy MLP with hand-rolled gradients) ---------------------------
+
+def init_params(hidden: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    return {
+        "w1": rng.randn(784, hidden).astype(np.float32) * 0.05,
+        "b1": np.zeros(hidden, np.float32),
+        "w2": rng.randn(hidden, 10).astype(np.float32) * 0.05,
+        "b2": np.zeros(10, np.float32),
+    }
+
+
+def loss_and_grads(params, x, y):
+    z1 = x @ params["w1"] + params["b1"]
+    h = np.maximum(z1, 0.0)
+    logits = h @ params["w2"] + params["b2"]
+    logits -= logits.max(axis=1, keepdims=True)
+    e = np.exp(logits)
+    p = e / e.sum(axis=1, keepdims=True)
+    n = x.shape[0]
+    loss = -np.log(np.maximum(p[np.arange(n), y], 1e-9)).mean()
+    dlogits = p
+    dlogits[np.arange(n), y] -= 1.0
+    dlogits /= n
+    grads = {
+        "w2": h.T @ dlogits,
+        "b2": dlogits.sum(0),
+    }
+    dh = dlogits @ params["w2"].T
+    dz1 = dh * (z1 > 0)
+    grads["w1"] = x.T @ dz1
+    grads["b1"] = dz1.sum(0)
+    return loss, grads
+
+
+def synthetic_batch(rng, batch: int):
+    labels = rng.randint(0, 10, size=batch)
+    centers = np.random.RandomState(1234).randn(10, 784).astype(np.float32) * 0.5
+    images = centers[labels] + rng.randn(batch, 784).astype(np.float32) * 0.3
+    return images.astype(np.float32), labels
+
+
+def shard_keys(keys: List[str], num_shards: int) -> List[List[str]]:
+    """Deterministic key -> pserver assignment (round-robin over sorted)."""
+    shards: List[List[str]] = [[] for _ in range(num_shards)]
+    for i, key in enumerate(sorted(keys)):
+        shards[i % num_shards].append(key)
+    return shards
+
+
+# -- pserver ----------------------------------------------------------------
+
+def run_pserver(rdv) -> int:
+    hidden = int(os.environ.get("MNIST_HIDDEN", "64"))
+    my_hosts = rdv.hosts(PSERVER_GROUP)
+    n_ps = len(my_hosts)
+    bind_port = int(my_hosts[rdv.replica_index].rsplit(":", 1)[1])
+    expected_workers = len(rdv.group_instances.get(WORKER_GROUP, [])) or 1
+
+    full = init_params(hidden)
+    mine = set(shard_keys(list(full), n_ps)[rdv.replica_index])
+    params = {k: v for k, v in full.items() if k in mine}
+    lock = threading.Lock()
+    done = threading.Event()
+    done_count = [0]
+
+    server = socket.socket()
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("", bind_port))
+    server.listen(16)
+    server.settimeout(0.5)
+    print(f"pserver {rdv.replica_index}/{n_ps} serving {sorted(mine)} "
+          f"on :{bind_port}", flush=True)
+
+    def handle(conn: socket.socket) -> None:
+        with conn:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "pull":
+                    with lock:
+                        send_msg(conn, {"params": params})
+                elif op == "push":
+                    lr = float(msg.get("lr", 1e-2))
+                    with lock:
+                        for k, g in msg["grads"].items():
+                            if k in params:
+                                params[k] -= lr * g
+                    send_msg(conn, {"ok": True})
+                elif op == "done":
+                    with lock:
+                        done_count[0] += 1
+                        if done_count[0] >= expected_workers:
+                            done.set()
+                    send_msg(conn, {"ok": True})
+                else:
+                    send_msg(conn, {"error": f"unknown op {op!r}"})
+
+    threads: List[threading.Thread] = []
+    deadline = time.time() + float(os.environ.get("PS_TIMEOUT", "300"))
+    while not done.is_set():
+        if time.time() > deadline:
+            print("pserver: timed out waiting for workers", flush=True)
+            return 1
+        try:
+            conn, _ = server.accept()
+        except socket.timeout:
+            continue
+        th = threading.Thread(target=handle, args=(conn,), daemon=True)
+        th.start()
+        threads.append(th)
+    server.close()
+    print(f"pserver {rdv.replica_index}: all {expected_workers} workers done",
+          flush=True)
+    return 0
+
+
+# -- worker -----------------------------------------------------------------
+
+def _connect(host_port: str, timeout: float) -> socket.socket:
+    host, port = host_port.rsplit(":", 1)
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return socket.create_connection((host, int(port)), timeout=5)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def run_worker(rdv) -> int:
+    steps = int(os.environ.get("MNIST_STEPS", "30"))
+    batch = int(os.environ.get("MNIST_BATCH", "64"))
+    lr = float(os.environ.get("MNIST_LR", "0.05"))
+    ps_hosts = rdv.hosts(PSERVER_GROUP)
+    if not ps_hosts:
+        print("worker: no pserver hosts injected", flush=True)
+        return 1
+    conns = [_connect(hp, timeout=float(os.environ.get("PS_TIMEOUT", "120")))
+             for hp in ps_hosts]
+    rng = np.random.RandomState(1000 + rdv.replica_index)
+
+    loss = float("nan")
+    t0 = time.time()
+    for i in range(steps):
+        params: Dict[str, np.ndarray] = {}
+        for conn in conns:
+            send_msg(conn, {"op": "pull"})
+            params.update(recv_msg(conn)["params"])
+        x, y = synthetic_batch(rng, batch)
+        loss, grads = loss_and_grads(params, x, y)
+        shards = shard_keys(list(grads), len(conns))
+        for conn, keys in zip(conns, shards):
+            send_msg(conn, {"op": "push", "lr": lr,
+                            "grads": {k: grads[k] for k in keys}})
+            recv_msg(conn)
+        if (i + 1) % 10 == 0 or i == steps - 1:
+            print(f"worker {rdv.replica_index} step {i+1}/{steps} "
+                  f"loss {loss:.4f}", flush=True)
+    for conn in conns:
+        send_msg(conn, {"op": "done"})
+        recv_msg(conn)
+        conn.close()
+    dt = time.time() - t0
+    print(f"worker {rdv.replica_index} done: {steps} steps in {dt:.2f}s "
+          f"final_loss={loss:.4f}", flush=True)
+    return 0
+
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous
+
+    rdv = rendezvous.from_env()
+    rdv.hold_reservation_if_needed()
+    role = (rdv.replica_name or "worker").lower()
+    if role.startswith("pserver") or role.startswith("ps"):
+        return run_pserver(rdv)
+    return run_worker(rdv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

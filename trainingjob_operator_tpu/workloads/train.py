@@ -23,22 +23,35 @@ class CheckpointState:
         self._mngr = manager
 
     @classmethod
-    def restore_or_init(cls, rdv: Rendezvous,
-                        init_value: Dict[str, Any]) -> "CheckpointState":
+    def restore_or_init(cls, rdv: Rendezvous, init_value: Dict[str, Any],
+                        subdir: Optional[str] = None) -> "CheckpointState":
+        """Per-replica path by default; pass ``subdir`` for one path shared by
+        every process of the job (elastic resume: the checkpoint must survive
+        a world-size change, so it cannot be keyed on rank)."""
         directory = rdv.checkpoint_dir
         if not directory:
             return cls("", init_value, None)
         import orbax.checkpoint as ocp
 
-        path = os.path.join(os.path.abspath(directory),
-                            rdv.replica_name or "worker", str(rdv.replica_index))
+        if subdir is not None:
+            path = os.path.join(os.path.abspath(directory), subdir)
+        else:
+            path = os.path.join(os.path.abspath(directory),
+                                rdv.replica_name or "worker",
+                                str(rdv.replica_index))
         os.makedirs(path, exist_ok=True)
         manager = ocp.CheckpointManager(
             path, options=ocp.CheckpointManagerOptions(max_to_keep=2))
         latest = manager.latest_step()
         if latest is not None:
-            restored = manager.restore(
-                latest, args=ocp.args.StandardRestore(init_value))
+            try:
+                restored = manager.restore(
+                    latest, args=ocp.args.StandardRestore(init_value))
+            except ValueError:
+                # Template has placeholder (None) leaves -- e.g. elastic
+                # resume where the param tree is only known from the
+                # checkpoint itself: restore the saved structure as-is.
+                restored = manager.restore(latest)
             return cls(path, restored, manager)
         return cls(path, init_value, manager)
 
