@@ -133,3 +133,33 @@ class TestJaxWorkloads:
         out = capsys.readouterr().out
         assert "resumed at step 4" in out
         assert "steps=" in out
+
+    def test_bert_resume_restores_params(self, monkeypatch, tmp_path, capsys):
+        from trainingjob_operator_tpu.workloads import bert_pretrain
+
+        monkeypatch.setenv("BERT_STEPS", "2")
+        monkeypatch.setenv("BERT_BATCH", "8")
+        monkeypatch.setenv("BERT_SEQ", "32")
+        monkeypatch.setenv("TRAININGJOB_CHECKPOINT_DIR", str(tmp_path))
+        assert bert_pretrain.main() == 0
+        capsys.readouterr()
+        monkeypatch.setenv("BERT_STEPS", "4")
+        assert bert_pretrain.main() == 0
+        out = capsys.readouterr().out
+        assert "resumed at step 2" in out
+
+    def test_resnet_resume_restores_full_state(self, monkeypatch, tmp_path,
+                                               capsys):
+        from trainingjob_operator_tpu.workloads import resnet_dp
+
+        monkeypatch.setenv("RESNET_STEPS", "12")
+        monkeypatch.setenv("RESNET_BATCH", "8")
+        monkeypatch.setenv("RESNET_IMAGE", "32")
+        monkeypatch.setenv("TRAININGJOB_CHECKPOINT_DIR", str(tmp_path))
+        assert resnet_dp.main() == 0
+        first = capsys.readouterr().out
+        # Second invocation starts where the first checkpointed (step 12 ==
+        # steps) so zero additional optimization happens.
+        assert resnet_dp.main() == 0
+        out = capsys.readouterr().out
+        assert "steps=1 " in out or "imgs/s" in out

@@ -108,11 +108,9 @@ def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
 
 
 def _rmsnorm(x, scale, eps):
-    import jax.numpy as jnp
+    from trainingjob_operator_tpu.ops import rmsnorm
 
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jnp.reciprocal(jnp.sqrt(var + eps)).astype(x.dtype)
-            * scale.astype(x.dtype))
+    return rmsnorm(x, scale, eps)
 
 
 def _rope(x, positions, theta):
@@ -165,13 +163,18 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
 
             o = ring_attention_sharded(q, k, v, mesh, causal=True)
         else:
-            from trainingjob_operator_tpu.parallel.ringattention import (
-                reference_attention)
+            # Dense path: Pallas flash attention on TPU (GQA-native, no KV
+            # repeat in memory), identical-math XLA fallback elsewhere.  On a
+            # mesh the kernel runs per-shard via shard_map (a custom call is
+            # opaque to GSPMD).
+            from trainingjob_operator_tpu.ops import flash_attention
+            from trainingjob_operator_tpu.ops.flash_attention import (
+                flash_attention_sharded)
 
-            if group > 1:  # GQA: expand kv heads for the dense path
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
-            o = reference_attention(q, k, v, causal=True)
+            if mesh is not None and mesh.devices.size > 1:
+                o = flash_attention_sharded(q, k, v, mesh, causal=True)
+            else:
+                o = flash_attention(q, k, v, causal=True)
         o = o.reshape(B, T, c.dim)
         return o @ layer["attn"]["wo"].astype(compute)
 

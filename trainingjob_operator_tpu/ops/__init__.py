@@ -1,0 +1,38 @@
+"""TPU kernels (Pallas) with XLA fallbacks.
+
+The compute path of this framework is XLA; these kernels cover the spots
+where hand-scheduling beats the compiler -- flash attention (VMEM-resident
+softmax statistics, no [T, T] materialization in HBM) and small fusions.
+Every op dispatches: Pallas on TPU, numerically-identical XLA reference
+elsewhere (CPU tests, interpret mode), so call sites never branch.
+
+Reference parity note: the reference operator has no kernels (it is a Go
+control plane, SURVEY.md §0); this package exists because the TPU build owns
+the workload layer too (SURVEY.md §7).
+"""
+
+import os
+
+
+def use_pallas() -> bool:
+    """Pallas on real TPU unless explicitly disabled; interpret mode when
+    TRAININGJOB_PALLAS=interpret (testing the kernels off-TPU)."""
+    mode = os.environ.get("TRAININGJOB_PALLAS", "auto")
+    if mode in ("0", "off"):
+        return False
+    if mode == "interpret":
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    import jax
+
+    return (os.environ.get("TRAININGJOB_PALLAS") == "interpret"
+            or jax.default_backend() != "tpu")
+
+
+from trainingjob_operator_tpu.ops.flash_attention import flash_attention  # noqa: E402,F401
+from trainingjob_operator_tpu.ops.fused import rmsnorm  # noqa: E402,F401

@@ -1,0 +1,219 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Online-softmax attention: for each query block the kernel streams KV blocks
+through VMEM, keeping running max/denominator statistics in f32 -- the [T, T]
+score matrix never exists in HBM, so HBM traffic is O(T*D) instead of O(T^2)
+and the block matmuls stay on the MXU.  GQA maps query head h to KV head
+h // (Hq/Hkv) in the BlockSpec index map, so grouped KV is never repeated in
+memory.  Causal query blocks stop their KV loop at the diagonal (no wasted
+blocks above it).
+
+Backward is rematerialized through the XLA reference implementation (exact
+same math) -- the standard trade: recompute the O(T^2) probabilities at
+higher FLOPs rather than save them.  For sequence-parallel long context, use
+parallel/ringattention.py instead; this kernel is the single-device fast
+path the ring's per-step block computation mirrors.
+
+Off TPU the public entrypoint dispatches to the same-math XLA reference
+(ops.use_pallas), and TRAININGJOB_PALLAS=interpret runs the real kernel in
+interpreter mode for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+            padded_len: int, kv_len: int, scale: float, causal: bool):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [BQ, D]
+    bq, d = q.shape
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    if causal:
+        # KV blocks strictly above the diagonal contribute nothing.
+        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        num_kb = padded_len // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = cols < kv_len  # padded key rows never attend
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            valid = jnp.logical_and(valid, cols <= rows)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, interpret: bool):
+    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> [B, Hq, T, D]."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    group = H // Hkv
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+
+    # Pad the sequence up to the block grid; padded key positions are masked
+    # inside the kernel (cols < kv_len), padded query rows are sliced off.
+    import math
+
+    step = math.lcm(block_q, block_k)
+    padded = math.ceil(T / step) * step
+    if padded != T:
+        width = ((0, 0), (0, 0), (0, padded - T), (0, 0))
+        q = jnp.pad(q, width)
+        k = jnp.pad(k, width)
+        v = jnp.pad(v, width)
+
+    grid = (B, H, padded // block_q)
+    kernel = functools.partial(_kernel, block_q=block_q, block_k=block_k,
+                               padded_len=padded, kv_len=T, scale=scale,
+                               causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, padded, D),
+                         lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, padded, D),
+                         lambda b, h, i: (b, h // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :, :T, :] if padded != T else out
+
+
+def _reference(q, k, v, *, scale: float, causal: bool):
+    """Same math in plain XLA (f32 softmax statistics); [B, H, T, D]."""
+    import jax.numpy as jnp
+
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    if H != Hkv:
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
+
+    if use_pallas():
+        return _flash_forward(q, k, v, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              interpret=pallas_interpret())
+    return _reference(q, k, v, scale=scale, causal=causal)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    return _flash(q, k, v, scale, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    # Rematerialize through the reference (identical math): trades O(T^2)
+    # recompute FLOPs for not saving the probability matrix.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference(q_, k_, v_, scale=scale, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Flash attention over [B, T, H, D] tensors (GQA: k/v may have fewer
+    heads).  Pallas on TPU, XLA reference elsewhere; differentiable."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # Kernel layout is [B, H, T, D].
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, float(scale), causal, block_q, block_k)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
+                            scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128):
+    """Flash attention under a dp/fsdp x tp mesh via shard_map.
+
+    A Pallas kernel is an opaque custom call to GSPMD, so it must run
+    per-shard: batch is sharded over the data axes, heads over tp (attention
+    is head-independent, and contiguous head blocks keep the GQA
+    query->kv-head mapping local to the shard).  q/k/v: [B, T, H, D] global.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+
+        compat = {"check_vma": False}
+    except ImportError:  # jax < 0.8
+        from jax.experimental.shard_map import shard_map
+
+        compat = {"check_rep": False}
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    batch = data_axes if len(data_axes) > 1 else (
+        data_axes[0] if data_axes else None)
+    tp = "tp" if "tp" in mesh.axis_names else None
+    spec = P(batch, None, tp, None)
+
+    fn = shard_map(
+        functools.partial(flash_attention, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **compat)
+    return fn(q, k, v)

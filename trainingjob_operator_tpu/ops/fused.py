@@ -1,0 +1,90 @@
+"""Small fused Pallas kernels: RMSNorm.
+
+RMSNorm is HBM-bandwidth bound; the fused kernel reads each row once, keeps
+the reduction in VMEM (f32), and writes once -- no intermediate mean-square
+array round-trips to HBM.  Backward rematerializes through the XLA reference
+(same math).  Off TPU the entrypoint dispatches to the reference
+(ops.use_pallas); TRAININGJOB_PALLAS=interpret exercises the real kernel on
+CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _rmsnorm_kernel(x_ref, scale_ref, o_ref, *, eps: float):
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)                     # [BR, D]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps) * scale_ref[...].astype(jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _rmsnorm_forward(x2d, scale, *, eps: float, block_rows: int,
+                     interpret: bool):
+    from jax.experimental import pallas as pl
+
+    rows, d = x2d.shape
+    block_rows = min(block_rows, rows)
+    kernel = functools.partial(_rmsnorm_kernel, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(x2d, scale)
+
+
+def _reference(x, scale, *, eps: float):
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps)
+            * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x, scale, eps):
+    from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
+
+    if not use_pallas():
+        return _reference(x, scale, eps=eps)
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    rows = x2d.shape[0]
+    block = rows
+    for candidate in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if rows % candidate == 0:
+            block = candidate
+            break
+    out = _rmsnorm_forward(x2d, scale, eps=eps, block_rows=block,
+                           interpret=pallas_interpret())
+    return out.reshape(shape)
+
+
+def _rmsnorm_fwd(x, scale, eps):
+    return _rmsnorm(x, scale, eps), (x, scale)
+
+
+def _rmsnorm_bwd(eps, res, g):
+    x, scale = res
+    _, vjp = jax.vjp(lambda x_, s_: _reference(x_, s_, eps=eps), x, scale)
+    return vjp(g)
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
+
+
+def rmsnorm(x, scale, eps: float = 1e-5):
+    """Fused RMSNorm over the last axis; differentiable, dtype-preserving."""
+    return _rmsnorm(x, scale, float(eps))

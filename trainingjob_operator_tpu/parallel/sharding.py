@@ -40,17 +40,20 @@ def spec_for_path(path: str, rules: Rules):
 
 
 def fit_spec(spec, shape, mesh):
-    """Drop sharding on axes the dimension cannot divide (fall back to
-    replicated on that axis) -- keeps one rule set valid across model sizes
-    (a tiny debug config and a 7B share the same rules)."""
+    """Adapt a rule's PartitionSpec to a concrete leaf: align to the TRAILING
+    dims when the spec is longer than the rank (stacked-layer rules carry a
+    leading scan-axis entry that unstacked leaves don't have), and drop
+    sharding on axes the dimension cannot divide (replicate there) -- keeps
+    one rule set valid across model sizes."""
     import math
 
     from jax.sharding import PartitionSpec as P
 
+    entries = list(spec)
+    if len(entries) > len(shape):
+        entries = entries[len(entries) - len(shape):]
     fitted: List[Optional[object]] = []
-    for i, entry in enumerate(spec):
-        if i >= len(shape):
-            break  # spec longer than rank (e.g. stacked rule, unstacked leaf)
+    for i, entry in enumerate(entries):
         if entry is None:
             fitted.append(None)
             continue

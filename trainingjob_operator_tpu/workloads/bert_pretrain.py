@@ -61,8 +61,7 @@ def main() -> int:
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     batch_sharding = NamedSharding(mesh, P(data_axes))
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    if global_batch % n_data != 0:
-        global_batch = max(n_data, global_batch // n_data * n_data)
+    global_batch = train.round_global_batch(global_batch, n_data)
 
     params = bert.init_params(cfg, jax.random.PRNGKey(0))
     params = shard_pytree(params, bert.SHARDING_RULES, mesh)
@@ -80,18 +79,19 @@ def main() -> int:
     def batch_at(i):
         k = jax.random.fold_in(jax.random.PRNGKey(11 + rdv.process_id), i)
         local = synthetic_mlm_batch(k, local_batch, seq, cfg.vocab_size)
-        if jax.process_count() == 1:
-            return {name: jax.device_put(v, batch_sharding)
-                    for name, v in local.items()}
-        return {name: jax.make_array_from_process_local_data(
-                    batch_sharding, np.asarray(v))
+        return {name: train.globalize_batch(batch_sharding, v)
                 for name, v in local.items()}
 
-    # Shared checkpoint path: rank 0 writes, everyone restores (world size
-    # may change across restarts only via job respec; width is fixed here).
+    # Shared rank-agnostic checkpoint: rank 0 writes host copies of the full
+    # training state; every rank restores and re-shards onto its mesh.
     state = train.CheckpointState.restore_or_init(
-        rdv, {"step": 0}, subdir="bert")
+        rdv, {"params": None, "opt_state": None, "step": 0}, subdir="bert")
     start_step = int(state.value["step"])
+    if start_step > 0 and state.value["params"] is not None:
+        params, opt_state = train.reshard_restored(
+            state.value["params"], state.value["opt_state"],
+            bert.SHARDING_RULES, mesh, opt_state)
+        print(f"resumed at step {start_step}", flush=True)
 
     loss = None
     t_start = None
@@ -102,8 +102,11 @@ def main() -> int:
             t_start = time.time()
         if (i + 1) % 10 == 0 or i == steps - 1:
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
+            host_params = train.host_replicated_copy(params, mesh)
+            host_opt = train.host_replicated_copy(opt_state, mesh)
             if rdv.process_id == 0:
-                state.save({"step": i + 1})
+                state.save({"params": host_params, "opt_state": host_opt,
+                            "step": i + 1})
     jax.block_until_ready(loss)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)

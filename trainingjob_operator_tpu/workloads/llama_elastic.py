@@ -34,14 +34,13 @@ def main() -> int:
     import jax
     import numpy as np
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     from trainingjob_operator_tpu.models import llama
     from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
     from trainingjob_operator_tpu.parallel.sharding import (
         batch_spec,
         shard_pytree,
-        sharding_pytree,
     )
 
     cfg = (llama.LlamaConfig.llama2_7b()
@@ -64,8 +63,7 @@ def main() -> int:
 
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    if global_batch % n_data != 0:
-        global_batch = max(n_data, global_batch // n_data * n_data)
+    global_batch = train.round_global_batch(global_batch, n_data)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     params = shard_pytree(params, llama.SHARDING_RULES, mesh)
@@ -89,10 +87,7 @@ def main() -> int:
         k = jax.random.fold_in(jax.random.PRNGKey(17 + rdv.process_id), i)
         tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
                                     cfg.vocab_size)
-        if jax.process_count() == 1:
-            return jax.device_put(tokens, batch_sharding)
-        return jax.make_array_from_process_local_data(
-            batch_sharding, np.asarray(tokens))
+        return train.globalize_batch(batch_sharding, tokens)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
     # Rank 0 saves host copies (width-independent); every rank restores and
@@ -101,31 +96,20 @@ def main() -> int:
         rdv, {"params": None, "opt_state": None, "step": 0}, subdir="llama")
     start_step = int(state.value["step"])
     if start_step > 0 and state.value["params"] is not None:
-        params = jax.device_put(
-            state.value["params"],
-            sharding_pytree(state.value["params"], llama.SHARDING_RULES, mesh))
-        # Orbax round-trips NamedTuple/tuple containers as lists; rebuild the
-        # live optimizer structure from the restored leaves, re-sharded like
-        # the freshly-initialized opt state.
-        host_opt = jax.tree.unflatten(jax.tree.structure(opt_state),
-                                      jax.tree.leaves(state.value["opt_state"]))
-
-        def put(host, like):
-            # Mesh-sharded leaves keep their sharding; scalars (adam count)
-            # go mesh-replicated so jit sees one device set.
-            sh = like.sharding if isinstance(like.sharding, NamedSharding) \
-                else NamedSharding(mesh, P())
-            return jax.device_put(host, sh)
-
-        opt_state = jax.tree.map(put, host_opt, opt_state)
+        params, opt_state = train.reshard_restored(
+            state.value["params"], state.value["opt_state"],
+            llama.SHARDING_RULES, mesh, opt_state)
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
     def save(i):
+        # All processes participate in the all-gather (collective); only
+        # rank 0 writes.
+        host_params = train.host_replicated_copy(params, mesh)
+        host_opt = train.host_replicated_copy(opt_state, mesh)
         if rdv.process_id != 0:
             return
-        state.save({"params": jax.device_get(params),
-                    "opt_state": jax.device_get(opt_state), "step": i})
+        state.save({"params": host_params, "opt_state": host_opt, "step": i})
 
     loss = None
     t_start = None

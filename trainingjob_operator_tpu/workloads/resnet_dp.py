@@ -48,9 +48,7 @@ def main() -> int:
     mesh = Mesh(np.array(devices), ("dp",))
     batch_sharding = NamedSharding(mesh, P("dp"))
     replicated = NamedSharding(mesh, P())
-    if global_batch % len(devices) != 0:
-        global_batch = max(len(devices),
-                           global_batch // len(devices) * len(devices))
+    global_batch = train.round_global_batch(global_batch, len(devices))
 
     key = jax.random.PRNGKey(0)
     params, stats = resnet.init_params(cfg, key)
@@ -76,11 +74,22 @@ def main() -> int:
         return (jax.device_put(images, batch_sharding),
                 jax.device_put(labels, batch_sharding))
 
+    # Full training state: params, batch-norm statistics, and optimizer
+    # momentum all resume, so the post-restart trajectory matches an
+    # uninterrupted run.
     state = train.CheckpointState.restore_or_init(
-        rdv, {"params": jax.device_get(params), "step": 0})
+        rdv, {"params": jax.device_get(params),
+              "stats": jax.device_get(stats),
+              "opt_state": jax.device_get(opt_state), "step": 0})
     start_step = int(state.value["step"])
     if start_step > 0:
         params = jax.device_put(state.value["params"], replicated)
+        stats = jax.device_put(state.value["stats"], replicated)
+        host_opt = jax.tree.unflatten(jax.tree.structure(opt_state),
+                                      jax.tree.leaves(state.value["opt_state"]))
+        opt_state = jax.tree.map(
+            lambda host, _: jax.device_put(host, replicated),
+            host_opt, opt_state)
 
     loss = None
     t_start = None
@@ -93,7 +102,10 @@ def main() -> int:
             t_start = time.time()
         if (i + 1) % 10 == 0 or i == steps - 1:
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
-            state.save({"params": jax.device_get(params), "step": i + 1})
+            state.save({"params": jax.device_get(params),
+                        "stats": jax.device_get(stats),
+                        "opt_state": jax.device_get(opt_state),
+                        "step": i + 1})
     jax.block_until_ready(loss)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)

@@ -44,14 +44,21 @@ class CheckpointState:
             path, options=ocp.CheckpointManagerOptions(max_to_keep=2))
         latest = manager.latest_step()
         if latest is not None:
-            try:
+            import jax
+
+            has_placeholders = any(
+                leaf is None for leaf in jax.tree.leaves(
+                    init_value, is_leaf=lambda x: x is None))
+            if has_placeholders:
+                # Elastic resume: the param tree is only known from the
+                # checkpoint itself; restore the saved structure as-is.
+                restored = manager.restore(latest)
+            else:
+                # Strict: a template/checkpoint mismatch (e.g. resumed with a
+                # different model config) must fail loudly here, not deep in
+                # a jitted step later.
                 restored = manager.restore(
                     latest, args=ocp.args.StandardRestore(init_value))
-            except ValueError:
-                # Template has placeholder (None) leaves -- e.g. elastic
-                # resume where the param tree is only known from the
-                # checkpoint itself: restore the saved structure as-is.
-                restored = manager.restore(latest)
             return cls(path, restored, manager)
         return cls(path, init_value, manager)
 
@@ -64,3 +71,75 @@ class CheckpointState:
         step = int(value.get("step", 0))
         self._mngr.save(step, args=ocp.args.StandardSave(value))
         self._mngr.wait_until_finished()
+
+
+def round_global_batch(global_batch: int, shards: int) -> int:
+    """Largest multiple of ``shards`` <= global_batch (floor ``shards``)."""
+    shards = max(shards, 1)
+    return max(shards, global_batch // shards * shards)
+
+
+def globalize_batch(sharding, local):
+    """Per-process local batch shard -> global sharded array (identity when
+    single-process)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    import numpy as np
+
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local))
+
+
+def host_replicated_copy(tree: Any, mesh) -> Any:
+    """Numpy host copy of a (possibly cross-host sharded) pytree.
+
+    ``jax.device_get`` alone raises on arrays with non-addressable shards
+    (multi-host fsdp/tp): first all-gather to a fully-replicated layout via a
+    jitted identity with replicated out_shardings, then fetch.  Used for
+    rank-agnostic checkpoints that must survive an elastic width change.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None or jax.process_count() == 1:
+        return jax.device_get(tree)
+    replicated = NamedSharding(mesh, P())
+    gather = jax.jit(lambda t: t, out_shardings=jax.tree.map(
+        lambda _: replicated, tree))
+    return jax.device_get(gather(tree))
+
+
+def throughput_line(prefix: str, steps_done: int, units_per_step: int,
+                    seconds: float, unit: str = "tokens") -> str:
+    rate = steps_done * units_per_step / max(seconds, 1e-9)
+    return f"{prefix} steps={steps_done} {unit}/s={rate:.0f}"
+
+
+def reshard_restored(host_params: Any, host_opt: Any, rules, mesh,
+                     opt_state_like: Any):
+    """Re-shard host (numpy) checkpoint copies onto the CURRENT mesh.
+
+    The elastic contract: checkpoints are rank- and width-agnostic host
+    trees; after a resize the same checkpoint lands on a different mesh
+    shape.  Params follow the model's sharding rules; the optimizer tree is
+    rebuilt into the live (possibly NamedTuple) structure -- orbax round-trips
+    containers as lists -- with scalar leaves going mesh-replicated.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from trainingjob_operator_tpu.parallel.sharding import sharding_pytree
+
+    params = jax.device_put(host_params,
+                            sharding_pytree(host_params, rules, mesh))
+    host_opt = jax.tree.unflatten(jax.tree.structure(opt_state_like),
+                                  jax.tree.leaves(host_opt))
+
+    def put(host, like):
+        sharding = like.sharding if isinstance(like.sharding, NamedSharding) \
+            else NamedSharding(mesh, P())
+        return jax.device_put(host, sharding)
+
+    opt_state = jax.tree.map(put, host_opt, opt_state_like)
+    return params, opt_state
