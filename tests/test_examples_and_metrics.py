@@ -1,0 +1,136 @@
+"""Example manifests parse/validate; metrics registry and HTTP exposition."""
+
+import glob
+import json
+import os
+import urllib.request
+
+from trainingjob_operator_tpu.api.defaults import set_defaults
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.api.validation import validate_job
+from trainingjob_operator_tpu.utils.metrics import (
+    MetricsRegistry,
+    serve_metrics,
+)
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+class TestExamples:
+    def test_all_examples_parse_validate_roundtrip(self):
+        paths = sorted(glob.glob(os.path.join(EXAMPLES, "*.yaml")))
+        assert len(paths) >= 5  # one per BASELINE config
+        for path in paths:
+            job = TPUTrainingJob.from_yaml(open(path).read())
+            set_defaults(job)
+            violations = validate_job(job)
+            assert violations == [], f"{os.path.basename(path)}: {violations}"
+            # Round-trip stability.
+            again = TPUTrainingJob.from_dict(job.to_dict())
+            assert again.to_dict() == job.to_dict(), path
+
+    def test_elastic_example_declares_range(self):
+        job = TPUTrainingJob.from_yaml(
+            open(os.path.join(EXAMPLES, "llama2-7b-elastic-v5e32.yaml")).read())
+        spec = job.spec.replica_specs["trainer"]
+        assert spec.edl_policy == "Auto"
+        assert spec.min_replicas < spec.replicas
+        assert spec.tpu is not None and spec.tpu.preemptible
+
+    def test_tpu_examples_geometry_consistent(self):
+        from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
+
+        for name in ("resnet50-v5e8.yaml", "bert-v5e16.yaml",
+                     "llama2-7b-elastic-v5e32.yaml"):
+            job = TPUTrainingJob.from_yaml(
+                open(os.path.join(EXAMPLES, name)).read())
+            spec = job.spec.replica_specs["trainer"]
+            shape = resolve_slice_shape(spec.tpu)
+            assert shape.hosts * spec.tpu.slice_count == spec.replicas, name
+
+
+class TestMetrics:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        reg.inc("ops_total")
+        reg.inc("ops_total", 2)
+        reg.gauge("depth", lambda: 7.0)
+        for v in (0.002, 0.02, 0.2, 2.0):
+            reg.observe("latency_seconds", v)
+        snap = reg.snapshot()
+        assert snap["ops_total"] == 3
+        assert snap["depth"] == 7.0
+        assert snap["latency_seconds_count"] == 4
+        assert snap["latency_seconds_p50"] > 0
+
+    def test_labels(self):
+        reg = MetricsRegistry()
+        reg.inc("restarts_total", rtype="trainer")
+        reg.inc("restarts_total", rtype="pserver")
+        snap = reg.snapshot()
+        assert snap['restarts_total{rtype="trainer"}'] == 1
+
+    def test_prometheus_rendering(self):
+        reg = MetricsRegistry()
+        reg.inc("a_total", 5)
+        reg.observe("lat", 0.003)
+        text = reg.render_prometheus()
+        assert "a_total 5" in text
+        assert 'lat_bucket{le="0.005"} 1' in text
+        assert "lat_count 1" in text
+
+    def test_http_endpoint(self):
+        reg = MetricsRegistry()
+        reg.inc("hits_total")
+        server = serve_metrics(0, reg)
+        try:
+            port = server.server_address[1]
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "hits_total 1" in text
+            js = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json").read())
+            assert js["hits_total"] == 1
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz").read()
+            assert health == b"ok\n"
+            dump = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/threads").read().decode()
+            assert "metrics-http" in dump
+        finally:
+            server.shutdown()
+
+    def test_controller_reports(self):
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.controller.controller import (
+            TrainingJobController)
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ContainerPort,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from trainingjob_operator_tpu.api.types import ReplicaSpec
+
+        cs = Clientset()
+        tc = TrainingJobController(cs)
+        job = TPUTrainingJob(metadata=ObjectMeta(name="m", namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=2,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="aitj-main", image="img",
+                          ports=[ContainerPort(name="aitj-1", container_port=1)])])))
+        cs.trainingjobs.create(job)
+        before = tc.metrics.snapshot().get("trainingjob_pods_created_total", 0)
+        tc.sync_handler("default/m")
+        snap = tc.metrics.snapshot()
+        assert snap["trainingjob_pods_created_total"] >= before + 2
+        assert snap["trainingjob_reconcile_seconds_count"] >= 1
+        # Gauges register on run() and deregister on stop() (a stopped
+        # controller must not shadow a running one in the global registry).
+        tc.run(workers=1)
+        assert tc.metrics.snapshot()["trainingjob_jobs"] >= 1.0
+        tc.stop()
+        assert "trainingjob_jobs" not in tc.metrics.snapshot()

@@ -57,6 +57,9 @@ def main(argv: Optional[list] = None) -> int:
                              "jobs reach an ending phase.")
     parser.add_argument("--nodes", type=int, default=2,
                         help="Virtual node count for sim/localproc backends.")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="Serve /metrics, /metrics.json, /healthz and "
+                             "/debug/threads on this port (0 = disabled).")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     opt = OperatorOptions.from_args(args)
@@ -70,6 +73,13 @@ def main(argv: Optional[list] = None) -> int:
     clientset = Clientset()
     runtime = build_runtime(opt, clientset, args)
     controller = TrainingJobController(clientset, options=opt)
+
+    metrics_server = None
+    if args.metrics_port:
+        from trainingjob_operator_tpu.utils.metrics import serve_metrics
+
+        metrics_server = serve_metrics(args.metrics_port)
+        print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():
         runtime.start()
@@ -89,6 +99,8 @@ def main(argv: Optional[list] = None) -> int:
         finally:
             controller.stop()
             runtime.stop()
+            if metrics_server is not None:
+                metrics_server.shutdown()
 
     if opt.leader_election.leader_elect:
         LeaderElector(opt.leader_election).run(run_operator, stop=stop)

@@ -13,6 +13,7 @@ from typing import Any, Optional
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.core.objects import OwnerReference, Pod, Service
 from trainingjob_operator_tpu.utils.events import EventRecorder
+from trainingjob_operator_tpu.utils.metrics import METRICS
 
 log = logging.getLogger("trainingjob.control")
 
@@ -43,6 +44,7 @@ class PodControl:
         pod.metadata.namespace = namespace
         pod.metadata.owner_references = [gen_owner_reference(job)]
         created = self._cs.pods.create(pod)
+        METRICS.inc("trainingjob_pods_created_total")
         self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulCreatePod",
                              f"Created pod: {created.name}")
         return created
@@ -53,6 +55,7 @@ class PodControl:
             self._cs.pods.delete(namespace, name, grace_period=grace_period)
         except KeyError:
             return
+        METRICS.inc("trainingjob_pods_deleted_total")
         self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulDeletePod",
                              f"Deleted pod: {name}")
 

@@ -94,6 +94,9 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # the reference's V(4) log line).
         self.sync_count = 0
         self.sync_seconds_total = 0.0
+        from trainingjob_operator_tpu.utils.metrics import METRICS
+
+        self.metrics = METRICS
 
     # -- job event handlers (reference: trainingjob.go:17-51) ----------------
 
@@ -142,6 +145,13 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     def run(self, workers: Optional[int] = None, wait: bool = False) -> None:
         n = workers or self.options.thread_num
         log.info("starting training-job controller with %d workers", n)
+        # Gauges live exactly as long as the controller runs (a closure held
+        # by the process-global registry would otherwise pin a stopped
+        # instance and shadow the running one).
+        self.metrics.gauge("trainingjob_workqueue_depth",
+                           lambda: float(len(self.work_queue)))
+        self.metrics.gauge("trainingjob_jobs",
+                           lambda: float(len(self.trainingjob_lister.list(None))))
         for i in range(n):
             th = threading.Thread(target=self._worker, daemon=True,
                                   name=f"trainingjob-worker-{i}")
@@ -159,6 +169,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             self._stop.wait()
 
     def stop(self) -> None:
+        self.metrics.remove_gauge("trainingjob_workqueue_depth")
+        self.metrics.remove_gauge("trainingjob_jobs")
         self._stop.set()
         if self._gc is not None:
             self._gc.stop()
@@ -230,7 +242,10 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             return True
         finally:
             self.sync_count += 1
-            self.sync_seconds_total += time.time() - start
+            dt = time.time() - start
+            self.sync_seconds_total += dt
+            self.metrics.inc("trainingjob_syncs_total")
+            self.metrics.observe("trainingjob_reconcile_seconds", dt)
 
     def satisfied_expectations(self, job: TPUTrainingJob) -> bool:
         """All replica groups' in-flight creates/deletes observed

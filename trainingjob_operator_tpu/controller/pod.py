@@ -421,6 +421,7 @@ class PodReconciler:
         # deleted with the rest of the group below).
         job.status.scale_probes.pop(rtype, None)
         job.status.last_scale_times[rtype] = time.time()
+        self.metrics.inc("trainingjob_elastic_resizes_total")
         self.recorder.event(job, EventRecorder.NORMAL, constants.SCALING_REASON, msg)
         log.info("elastic resize %s/%s %s: %s", job.namespace, job.name, rt, msg)
         grace = 0 if force else None
@@ -441,6 +442,7 @@ class PodReconciler:
         force = phase == TrainingJobPhase.NODE_FAIL
         grace = 0 if force else None
         self._update_restart_count(job, rtype)
+        self.metrics.inc("trainingjob_restarts_total")
         msg = f"restart times is {job.status.restart_counts.get(rtype, 0)}, {msg} "
         spec = job.spec.replica_specs[rtype]
         scope = spec.restart_scope

@@ -1,0 +1,226 @@
+"""Operator observability: metrics registry + HTTP exposition.
+
+The reference's only observability is leveled klog text and Status.Conditions
+(SURVEY.md §5.5 -- no Prometheus endpoint, no pprof).  This module is the
+improvement §5.1 asks for: per-reconcile latency histograms, queue depth,
+restart/scale counters, a Prometheus text endpoint, and a thread-dump page
+(the Python analogue of Go's /debug/pprof/goroutine).
+
+Thread-safe; one process-global registry (``METRICS``) so the controller,
+pod/service control, and runtimes all report into the same place.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Histogram bucket upper bounds (seconds) for latency-style metrics.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def _key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count", "vmax")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +Inf bucket
+        self.total = 0.0
+        self.count = 0
+        self.vmax = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.vmax = max(self.vmax, value)
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper bound of the bucket)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts[:-1]):
+            seen += c
+            if seen >= target:
+                return self.buckets[i]
+        return self.vmax
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._hists: Dict[str, _Histogram] = {}
+        self.started_at = time.time()
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = _key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def gauge(self, name: str, fn: Callable[[], float], **labels: str) -> None:
+        """Register a pull-style gauge (evaluated at scrape time)."""
+        with self._lock:
+            self._gauges[_key(name, labels)] = fn
+
+    def remove_gauge(self, name: str, **labels: str) -> None:
+        """Deregister a gauge (component shutting down; its closure must not
+        keep the component alive or shadow a newer instance)."""
+        with self._lock:
+            self._gauges.pop(_key(name, labels), None)
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                **labels: str) -> None:
+        key = _key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram(buckets)
+            hist.observe(value)
+
+    # -- exposition ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            gauges = {k: fn for k, fn in self._gauges.items()}
+            counters = dict(self._counters)
+            hists = {
+                k: {"count": h.count, "sum": h.total, "max": h.vmax,
+                    "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
+                for k, h in self._hists.items()
+            }
+        out: Dict[str, Any] = {"uptime_seconds": time.time() - self.started_at}
+        out.update(counters)
+        for k, fn in gauges.items():
+            try:
+                out[k] = fn()
+            except Exception:
+                out[k] = None
+        for k, stats in hists.items():
+            for stat, v in stats.items():
+                out[f"{k}_{stat}"] = v
+        return out
+
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hists.items())
+        for key, value in counters:
+            lines.append(f"{key} {value}")
+        for key, fn in gauges:
+            try:
+                lines.append(f"{key} {fn()}")
+            except Exception:
+                pass
+        for key, h in hists:
+            base, _, labels = key.partition("{")
+            labels = ("{" + labels) if labels else ""
+
+            def lbl(extra: str) -> str:
+                if not labels:
+                    return "{" + extra + "}"
+                return labels[:-1] + "," + extra + "}"
+
+            cum = 0
+            for ub, c in zip(h.buckets, h.counts[:-1]):
+                cum += c
+                lines.append(f'{base}_bucket{lbl(f"le=\"{ub}\"")} {cum}')
+            lines.append(f'{base}_bucket{lbl("le=\"+Inf\"")} {h.count}')
+            lines.append(f"{base}_sum{labels} {h.total}")
+            lines.append(f"{base}_count{labels} {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+#: Process-global registry.
+METRICS = MetricsRegistry()
+
+
+def thread_dump() -> str:
+    """All live threads with stacks -- Go's /debug/pprof/goroutine analogue."""
+    import sys
+
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in frames.items():
+        parts.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        parts.append("".join(traceback.format_stack(frame)))
+    return "\n".join(parts)
+
+
+def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
+                  host: str = "127.0.0.1"):
+    """Serve /metrics (Prometheus text), /metrics.json, /healthz and
+    /debug/threads on a daemon thread; ``.shutdown()`` stops it and closes
+    the socket.
+
+    Binds loopback by default -- /debug/threads exposes live stacks, the
+    pprof convention (expose beyond localhost only deliberately via
+    ``host="0.0.0.0"``).  Threaded with per-connection timeouts so one stuck
+    client can neither block other scrapes nor hang operator shutdown.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry or METRICS
+
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 5  # settimeout on the connection: drop stuck clients
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            routes = {
+                "/metrics": ("text/plain; version=0.0.4",
+                             lambda: reg.render_prometheus()),
+                "/metrics.json": ("application/json",
+                                  lambda: json.dumps(reg.snapshot(),
+                                                     indent=2)),
+                "/healthz": ("text/plain", lambda: "ok\n"),
+                "/debug/threads": ("text/plain", thread_dump),
+            }
+            route = routes.get(self.path.split("?")[0])
+            if route is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            ctype, render = route
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def shutdown(self):
+            super().shutdown()
+            self.server_close()
+
+    server = _Server((host, port), Handler)
+    th = threading.Thread(target=server.serve_forever, daemon=True,
+                          name="metrics-http")
+    th.start()
+    return server
