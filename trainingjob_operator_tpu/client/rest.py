@@ -1,0 +1,229 @@
+"""Kubernetes apiserver REST client -- stdlib only, no dependencies.
+
+The reference reaches the apiserver through generated client-go clients
+(pkg/client/, cmd/app/server.go:111-151).  This is the equivalent transport
+layer built directly on http.client + ssl: kubeconfig / in-cluster auth,
+JSON CRUD, and streaming watch.  Keeping it dependency-free means the kube
+backend works wherever Python does -- no ``kubernetes`` package needed.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Optional, Tuple
+from urllib.parse import urlencode, urlsplit
+
+from trainingjob_operator_tpu.client.tracker import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"apiserver {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class ClusterConfig:
+    """Where and how to reach one apiserver."""
+
+    server: str = "https://127.0.0.1:6443"
+    token: str = ""
+    ca_data: bytes = b""           # PEM
+    client_cert_data: bytes = b""  # PEM
+    client_key_data: bytes = b""   # PEM
+    insecure_skip_tls_verify: bool = False
+
+    @classmethod
+    def in_cluster(cls) -> "ClusterConfig":
+        """Pod-mounted serviceaccount (KUBERNETES_SERVICE_HOST/_PORT)."""
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SERVICEACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        with open(os.path.join(SERVICEACCOUNT_DIR, "ca.crt"), "rb") as f:
+            ca = f.read()
+        return cls(server=f"https://{host}:{port}", token=token, ca_data=ca)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str = "",
+                        context: str = "") -> "ClusterConfig":
+        """Minimal kubeconfig loader: current-context cluster + user with
+        token / client-cert / CA (data or file variants)."""
+        import yaml
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+
+        def by_name(section, name):
+            for entry in cfg.get(section) or []:
+                if entry.get("name") == name:
+                    return entry.get(section.rstrip("s"), {})
+            raise KeyError(f"{section}/{name} not in kubeconfig")
+
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = by_name("contexts", ctx_name)
+        cluster = by_name("clusters", ctx["cluster"])
+        user = by_name("users", ctx["user"]) if ctx.get("user") else {}
+
+        def load(data_key: str, file_key: str) -> bytes:
+            if cluster.get(data_key):
+                return base64.b64decode(cluster[data_key])
+            if user.get(data_key):
+                return base64.b64decode(user[data_key])
+            src = cluster.get(file_key) or user.get(file_key)
+            if src:
+                with open(src, "rb") as f:
+                    return f.read()
+            return b""
+
+        return cls(
+            server=cluster.get("server", "https://127.0.0.1:6443"),
+            token=user.get("token", ""),
+            ca_data=load("certificate-authority-data", "certificate-authority"),
+            client_cert_data=load("client-certificate-data", "client-certificate"),
+            client_key_data=load("client-key-data", "client-key"),
+            insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+        )
+
+
+class RestClient:
+    """Thread-safe JSON REST transport to one apiserver.
+
+    One persistent connection per calling thread (http.client is not
+    thread-safe); watches hold their own connection open for streaming.
+    """
+
+    def __init__(self, config: ClusterConfig):
+        self._cfg = config
+        self._local = threading.local()
+        split = urlsplit(config.server)
+        self._https = split.scheme == "https"
+        self._host = split.hostname or "127.0.0.1"
+        self._port = split.port or (443 if self._https else 80)
+        self._ssl_ctx = self._build_ssl() if self._https else None
+
+    def _build_ssl(self) -> ssl.SSLContext:
+        ctx = ssl.create_default_context()
+        if self._cfg.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self._cfg.ca_data:
+            ctx.load_verify_locations(cadata=self._cfg.ca_data.decode())
+        if self._cfg.client_cert_data and self._cfg.client_key_data:
+            # ssl wants files; write them once per client.
+            cert = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            cert.write(self._cfg.client_cert_data)
+            cert.close()
+            key = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            key.write(self._cfg.client_key_data)
+            key.close()
+            ctx.load_cert_chain(cert.name, key.name)
+        return ctx
+
+    def _connection(self, fresh: bool = False):
+        import http.client
+
+        if not fresh:
+            conn = getattr(self._local, "conn", None)
+            if conn is not None:
+                return conn
+        if self._https:
+            conn = http.client.HTTPSConnection(
+                self._host, self._port, context=self._ssl_ctx, timeout=60)
+        else:
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=60)
+        if not fresh:
+            self._local.conn = conn
+        return conn
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Accept": "application/json",
+                   "Content-Type": "application/json"}
+        if self._cfg.token:
+            headers["Authorization"] = f"Bearer {self._cfg.token}"
+        return headers
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None,
+                query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        if query:
+            path = f"{path}?{urlencode(query)}"
+        payload = json.dumps(body).encode() if body is not None else None
+        for attempt in (0, 1):  # one retry on a stale keep-alive connection
+            conn = self._connection()
+            try:
+                conn.request(method, path, body=payload,
+                             headers=self._headers())
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (ConnectionError, ssl.SSLError, OSError):
+                self._local.conn = None
+                if attempt:
+                    raise
+        return self._decode(resp.status, data, method, path)
+
+    @staticmethod
+    def _decode(status: int, data: bytes, method: str,
+                path: str) -> Dict[str, Any]:
+        try:
+            obj = json.loads(data) if data else {}
+        except json.JSONDecodeError:
+            obj = {"message": data.decode(errors="replace")}
+        if status == 404:
+            raise NotFoundError("", "", path)
+        if status == 409:
+            if obj.get("reason") == "AlreadyExists":
+                raise AlreadyExistsError(obj.get("message", path))
+            raise ConflictError(obj.get("message", path))
+        if status >= 400:
+            raise ApiError(status, obj.get("message", f"{method} {path}"))
+        return obj
+
+    def watch(self, path: str, resource_version: str = "",
+              timeout_seconds: int = 0) -> Iterator[Tuple[str, Dict[str, Any]]]:
+        """Stream (event_type, object) pairs until the server closes.
+
+        A dedicated connection: the stream would otherwise block CRUD.
+        """
+        query = {"watch": "true"}
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        if timeout_seconds:
+            query["timeoutSeconds"] = str(timeout_seconds)
+        conn = self._connection(fresh=True)
+        conn.request("GET", f"{path}?{urlencode(query)}",
+                     headers=self._headers())
+        resp = conn.getresponse()
+        if resp.status >= 400:
+            data = resp.read()
+            conn.close()
+            self._decode(resp.status, data, "WATCH", path)
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                yield event.get("type", ""), event.get("object", {})
+        finally:
+            conn.close()

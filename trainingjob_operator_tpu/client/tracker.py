@@ -246,6 +246,44 @@ class ObjectTracker:
                 self._enqueue_event(kind, WatchEvent(DELETED, cur))
         self._drain_events()
 
+    # -- reflector mirror API (kube backend cache) ---------------------------
+
+    def mirror_upsert(self, obj: Any) -> None:
+        """Store an object observed from an external apiserver AS-IS (its
+        resourceVersion is authoritative -- the tracker must not restamp it)
+        and emit ADDED/MODIFIED.  Used by the kube reflector; the tracker is
+        then purely an informer cache, never the source of truth."""
+        with self._lock:
+            stored = copy.deepcopy(obj)
+            key = obj_key(stored)
+            etype = MODIFIED if key in self._objects else ADDED
+            self._objects[key] = stored
+            self._enqueue_event(stored.KIND, WatchEvent(etype, stored))
+        self._drain_events()
+
+    def mirror_delete(self, kind: str, namespace: str, name: str) -> None:
+        """Drop a mirrored object (DELETED observed upstream); no grace/
+        finalizer machinery -- the apiserver already did all of that."""
+        with self._lock:
+            cur = self._objects.pop((kind, namespace, name), None)
+            if cur is not None:
+                self._enqueue_event(kind, WatchEvent(DELETED, cur))
+        self._drain_events()
+
+    def mirror_replace(self, kind: str, objs: List[Any]) -> None:
+        """Full-state resync for one kind (the reflector's initial LIST or a
+        re-list after a watch gap): upsert everything observed, delete
+        everything local that upstream no longer has."""
+        seen = set()
+        for obj in objs:
+            seen.add(obj_key(obj))
+            self.mirror_upsert(obj)
+        with self._lock:
+            stale = [k for k in self._objects
+                     if k[0] == kind and k not in seen]
+        for _, ns, name in stale:
+            self.mirror_delete(kind, ns, name)
+
     # -- introspection -------------------------------------------------------
 
     def count(self, kind: str) -> int:
