@@ -1,0 +1,23 @@
+# CI recipe: `make ci` = the full gate (tests + multichip dryrun + compile
+# check).  The virtual 8-device CPU mesh stands in for multi-chip TPU
+# (SURVEY.md §7); bench runs on real hardware out-of-band.
+
+PY ?= python
+VDEV ?= 8
+
+.PHONY: test dryrun bench install ci
+
+test:
+	$(PY) -m pytest tests/ -q
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=$(VDEV) \
+	JAX_PLATFORMS=cpu DRYRUN_DEVICES=$(VDEV) $(PY) __graft_entry__.py
+
+bench:
+	$(PY) bench.py
+
+install:
+	$(PY) -m pip install -e . --no-build-isolation
+
+ci: test dryrun

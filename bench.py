@@ -14,12 +14,9 @@ Prints exactly one JSON line.
 """
 
 import json
-import os
 import statistics
 import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.api.types import (

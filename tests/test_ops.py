@@ -63,6 +63,20 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_causal_block_q_smaller_than_block_k(self):
+        # Regression: with block_q % block_k != 0 and block_k > block_q the
+        # causal KV-block count must be ceil((qi+1)*block_q / block_k);
+        # counting from the block start skipped diagonal KV blocks.
+        from trainingjob_operator_tpu.ops import flash_attention
+        from trainingjob_operator_tpu.parallel.ringattention import (
+            reference_attention)
+
+        q, k, v = qkv(T=96 * 4)
+        got = flash_attention(q, k, v, causal=True, block_q=96, block_k=128)
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_grads_match_reference(self):
         from trainingjob_operator_tpu.ops import flash_attention
         from trainingjob_operator_tpu.parallel.ringattention import (
@@ -82,6 +96,32 @@ class TestFlashAttention:
         for a, b in zip(g_flash, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("Hkv", [4, 2])
+    def test_bwd_kernel_grads(self, causal, Hkv):
+        """The Pallas dq/dkv kernels (interpret mode) against the reference
+        vjp: GQA group-sum, non-causal, uneven blocks, non-divisible T."""
+        from trainingjob_operator_tpu.ops import flash_attention
+        from trainingjob_operator_tpu.parallel.ringattention import (
+            reference_attention)
+
+        q, k, v = qkv(T=40, H=4, Hkv=Hkv)
+        cot = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+        def rep(x):
+            return jnp.repeat(x, 4 // Hkv, axis=2) if Hkv != 4 else x
+
+        _, vjp = jax.vjp(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, block_q=16, block_k=8), q, k, v)
+        got = vjp(cot)
+        _, rvjp = jax.vjp(lambda a, b, c: reference_attention(
+            a, rep(b), rep(c), causal=causal), q, k, v)
+        want = rvjp(cot)
+        for name, a, b in zip("qkv", got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg=f"d{name} (causal={causal}, Hkv={Hkv})")
 
     def test_bf16_io_f32_stats(self):
         from trainingjob_operator_tpu.ops import flash_attention

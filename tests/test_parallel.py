@@ -127,6 +127,81 @@ class TestRingAttention:
         assert bool(jnp.isfinite(g).all())
 
 
+class TestCollectives:
+    class FakeDev:
+        def __init__(self, slice_index):
+            self.slice_index = slice_index
+
+    class FakeMesh:
+        """Duck-typed mesh over fake devices with slice ids."""
+
+        def __init__(self, arr, names):
+            import numpy as _np
+
+            self.devices = _np.array(arr, dtype=object)
+            self.axis_names = tuple(names)
+            self.shape = dict(zip(names, self.devices.shape))
+
+    def _two_slice_mesh(self):
+        # dp=2 crosses slices, tp=2 stays inside each slice.
+        d = [[self.FakeDev(0), self.FakeDev(0)],
+             [self.FakeDev(1), self.FakeDev(1)]]
+        return self.FakeMesh(d, ("dp", "tp"))
+
+    def test_axis_crosses_dcn(self):
+        from trainingjob_operator_tpu.parallel import collectives
+
+        mesh = self._two_slice_mesh()
+        assert collectives.axis_crosses_dcn(mesh, "dp")
+        assert not collectives.axis_crosses_dcn(mesh, "tp")
+
+    def test_require_ici_axis(self):
+        from trainingjob_operator_tpu.parallel import collectives
+
+        mesh = self._two_slice_mesh()
+        assert collectives.require_ici_axis(mesh, "tp") == 2
+        with pytest.raises(ValueError, match="DCN"):
+            collectives.require_ici_axis(mesh, "dp")
+        with pytest.raises(ValueError, match="no 'sp'"):
+            collectives.require_axis(mesh, "sp")
+
+    def test_cpu_mesh_is_all_ici(self):
+        from trainingjob_operator_tpu.parallel import collectives
+
+        mesh = make_mesh(MeshSpec.of(dp=2, tp=4))
+        assert not collectives.axis_crosses_dcn(mesh, "dp")
+        assert collectives.require_ici_axis(mesh, "tp") == 4
+
+    def test_ring_permutation(self):
+        from trainingjob_operator_tpu.parallel import collectives
+
+        assert collectives.ring_permutation(3) == ((0, 1), (1, 2), (2, 0))
+        assert collectives.ring_permutation(3, reverse=True) == (
+            (0, 2), (1, 0), (2, 1))
+
+    def test_hierarchical_psum_matches_joint(self):
+        from functools import partial
+
+        from trainingjob_operator_tpu.parallel import collectives
+
+        mesh = make_mesh(MeshSpec.of(dp=2, fsdp=4))
+        x = jnp.arange(8.0).reshape(2, 4)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp", "fsdp")))
+        try:
+            from jax import shard_map
+
+            compat = {"check_vma": False}
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+            compat = {"check_rep": False}
+        fn = shard_map(
+            partial(collectives.hierarchical_psum, mesh=mesh,
+                    axes=("dp", "fsdp")),
+            mesh=mesh, in_specs=P("dp", "fsdp"), out_specs=P(), **compat)
+        np.testing.assert_allclose(np.asarray(fn(x)), 28.0)
+
+
 class TestFitSpec:
     def test_truncates_spec_longer_than_rank(self):
         from jax.sharding import PartitionSpec as P

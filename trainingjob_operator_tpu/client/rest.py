@@ -125,17 +125,26 @@ class RestClient:
         elif self._cfg.ca_data:
             ctx.load_verify_locations(cadata=self._cfg.ca_data.decode())
         if self._cfg.client_cert_data and self._cfg.client_key_data:
-            # ssl wants files; write them once per client.
+            # ssl wants files; write them briefly and remove as soon as the
+            # context has read them -- key material must not persist on disk.
             cert = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
-            cert.write(self._cfg.client_cert_data)
-            cert.close()
             key = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
-            key.write(self._cfg.client_key_data)
-            key.close()
-            ctx.load_cert_chain(cert.name, key.name)
+            try:
+                cert.write(self._cfg.client_cert_data)
+                cert.close()
+                key.write(self._cfg.client_key_data)
+                key.close()
+                ctx.load_cert_chain(cert.name, key.name)
+            finally:
+                for path in (cert.name, key.name):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
         return ctx
 
-    def _connection(self, fresh: bool = False):
+    def _connection(self, fresh: bool = False,
+                    timeout: Optional[float] = 60):
         import http.client
 
         if not fresh:
@@ -144,10 +153,11 @@ class RestClient:
                 return conn
         if self._https:
             conn = http.client.HTTPSConnection(
-                self._host, self._port, context=self._ssl_ctx, timeout=60)
+                self._host, self._port, context=self._ssl_ctx,
+                timeout=timeout)
         else:
             conn = http.client.HTTPConnection(self._host, self._port,
-                                              timeout=60)
+                                              timeout=timeout)
         if not fresh:
             self._local.conn = conn
         return conn
@@ -165,7 +175,11 @@ class RestClient:
         if query:
             path = f"{path}?{urlencode(query)}"
         payload = json.dumps(body).encode() if body is not None else None
-        for attempt in (0, 1):  # one retry on a stale keep-alive connection
+        # Stale keep-alive connections are retried once, but only for
+        # idempotent methods: a POST whose connection died mid-flight may
+        # already have been applied (duplicate create on retry).
+        retries = (0, 1) if method in ("GET", "PUT", "DELETE") else (0,)
+        for attempt in retries:
             conn = self._connection()
             try:
                 conn.request(method, path, body=payload,
@@ -175,7 +189,7 @@ class RestClient:
                 break
             except (ConnectionError, ssl.SSLError, OSError):
                 self._local.conn = None
-                if attempt:
+                if attempt == retries[-1]:
                     raise
         return self._decode(resp.status, data, method, path)
 
@@ -205,9 +219,14 @@ class RestClient:
         query = {"watch": "true"}
         if resource_version:
             query["resourceVersion"] = resource_version
-        if timeout_seconds:
-            query["timeoutSeconds"] = str(timeout_seconds)
-        conn = self._connection(fresh=True)
+        # Always bound the stream server-side: with no socket timeout below, a
+        # half-open connection (apiserver crash, NAT drop without FIN) would
+        # otherwise hang readline() forever.  The server closes cleanly at
+        # timeoutSeconds and the reflector re-lists/re-watches.
+        query["timeoutSeconds"] = str(timeout_seconds or 300)
+        # No socket timeout: a healthy watch may be silent far longer than any
+        # keep-alive interval; lifetime is bounded by timeoutSeconds above.
+        conn = self._connection(fresh=True, timeout=None)
         conn.request("GET", f"{path}?{urlencode(query)}",
                      headers=self._headers())
         resp = conn.getresponse()

@@ -1,21 +1,26 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels -- forward AND backward.
 
-Online-softmax attention: for each query block the kernel streams KV blocks
-through VMEM, keeping running max/denominator statistics in f32 -- the [T, T]
-score matrix never exists in HBM, so HBM traffic is O(T*D) instead of O(T^2)
-and the block matmuls stay on the MXU.  GQA maps query head h to KV head
-h // (Hq/Hkv) in the BlockSpec index map, so grouped KV is never repeated in
-memory.  Causal query blocks stop their KV loop at the diagonal (no wasted
-blocks above it).
+Online-softmax attention: for each query block the forward kernel streams KV
+blocks through VMEM, keeping running max/denominator statistics in f32 -- the
+[T, T] score matrix never exists in HBM, so HBM traffic is O(T*D) instead of
+O(T^2) and the block matmuls stay on the MXU.  GQA maps query head h to KV
+head h // (Hq/Hkv) in the BlockSpec index map, so grouped KV is never
+repeated in memory.  Causal query blocks stop their KV loop at the diagonal
+(no wasted blocks above it).
 
-Backward is rematerialized through the XLA reference implementation (exact
-same math) -- the standard trade: recompute the O(T^2) probabilities at
-higher FLOPs rather than save them.  For sequence-parallel long context, use
-parallel/ringattention.py instead; this kernel is the single-device fast
-path the ring's per-step block computation mirrors.
+Backward is the FlashAttention-2 scheme as two Pallas kernels: probabilities
+are recomputed blockwise in VMEM from the saved log-sum-exp (never saved to
+HBM), accumulation in f32.  The dQ kernel iterates KV blocks per query block;
+the dK/dV kernel iterates query blocks per KV block (starting at the causal
+diagonal), producing per-query-head dK/dV that are group-summed for GQA.
+``delta = rowsum(dO * O)`` is the one cheap XLA precomputation.
+
+For sequence-parallel long context, use parallel/ringattention.py; this
+kernel is the single-device fast path the ring's per-step block computation
+mirrors.
 
 Off TPU the public entrypoint dispatches to the same-math XLA reference
-(ops.use_pallas), and TRAININGJOB_PALLAS=interpret runs the real kernel in
+(ops.use_pallas), and TRAININGJOB_PALLAS=interpret runs the real kernels in
 interpreter mode for CPU tests.
 """
 
@@ -29,8 +34,9 @@ import jax
 NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-            padded_len: int, kv_len: int, scale: float, causal: bool):
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+            block_k: int, padded_len: int, kv_len: int, scale: float,
+            causal: bool):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -43,8 +49,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     acc0 = jnp.zeros((bq, d), jnp.float32)
 
     if causal:
-        # KV blocks strictly above the diagonal contribute nothing.
-        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+        # KV blocks strictly above the diagonal contribute nothing; the last
+        # needed block is the one holding column (qi+1)*block_q - 1 (ceil
+        # division -- counting from the block *start* under-counts whenever
+        # block_q % block_k != 0 and skips diagonal blocks).
+        num_kb = pl.cdiv((qi + 1) * block_q, block_k)
     else:
         num_kb = padded_len // block_k
 
@@ -74,11 +83,33 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
 
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # Log-sum-exp per query row: the only softmax statistic the backward
+    # kernels need to recompute probabilities exactly.
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _pad_seq(x, padded: int):
+    import jax.numpy as jnp
+
+    T = x.shape[2]
+    if padded == T:
+        return x
+    width = [(0, 0)] * x.ndim
+    width[2] = (0, padded - T)
+    return jnp.pad(x, width)
+
+
+def _padded_len(T: int, block_q: int, block_k: int) -> int:
+    import math
+
+    step = math.lcm(block_q, block_k)
+    return math.ceil(T / step) * step
 
 
 def _flash_forward(q, k, v, *, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool):
-    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> [B, Hq, T, D]."""
+    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> (out [B, Hq, T, D],
+    lse [B, Hq, T] f32)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -90,21 +121,16 @@ def _flash_forward(q, k, v, *, scale: float, causal: bool,
 
     # Pad the sequence up to the block grid; padded key positions are masked
     # inside the kernel (cols < kv_len), padded query rows are sliced off.
-    import math
-
-    step = math.lcm(block_q, block_k)
-    padded = math.ceil(T / step) * step
-    if padded != T:
-        width = ((0, 0), (0, 0), (0, padded - T), (0, 0))
-        q = jnp.pad(q, width)
-        k = jnp.pad(k, width)
-        v = jnp.pad(v, width)
+    padded = _padded_len(T, block_q, block_k)
+    q = _pad_seq(q, padded)
+    k = _pad_seq(k, padded)
+    v = _pad_seq(v, padded)
 
     grid = (B, H, padded // block_q)
     kernel = functools.partial(_kernel, block_q=block_q, block_k=block_k,
                                padded_len=padded, kv_len=T, scale=scale,
                                causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -114,32 +140,221 @@ def _flash_forward(q, k, v, *, scale: float, causal: bool,
             pl.BlockSpec((1, 1, padded, D),
                          lambda b, h, i: (b, h // group, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, padded), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
-    return out[:, :, :T, :] if padded != T else out
+    if padded != T:
+        out, lse = out[:, :, :T, :], lse[:, :, :T]
+    return out, lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_q: int, block_k: int, padded_len: int,
+                   kv_len: int, scale: float, causal: bool):
+    """dQ for one query block: stream KV blocks, recompute p from lse."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)          # [BQ, D]
+    do = do_ref[0, 0].astype(jnp.float32)        # [BQ, D]
+    lse = lse_ref[0, 0][:, None]                 # [BQ, 1] f32
+    delta = delta_ref[0, 0][:, None]             # [BQ, 1] f32
+    bq, d = q.shape
+
+    if causal:
+        num_kb = pl.cdiv((qi + 1) * block_q, block_k)
+    else:
+        num_kb = padded_len // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        z = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = cols < kv_len
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            valid = jnp.logical_and(valid, cols <= rows)
+        p = jnp.where(valid, jnp.exp(z - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        dz = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            dz, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    padded_len: int, kv_len: int, scale: float, causal: bool,
+                    group: int):
+    """dK/dV for one KV block: stream query blocks from the causal diagonal
+    down.  The grid runs over KV heads; the GQA group's query heads are
+    accumulated here in VMEM, so only [B, Hkv, T, D] ever reaches HBM."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)          # [BK, D]
+    v = v_ref[0, 0].astype(jnp.float32)          # [BK, D]
+    bk, d = k.shape
+
+    num_qb = padded_len // block_q
+    # First query block intersecting the diagonal: earlier blocks are fully
+    # above it (all rows < first col of this KV block) and contribute 0.
+    qb_start = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        valid = cols < kv_len
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            valid = jnp.logical_and(valid, cols <= rows)
+        for g in range(group):  # static unroll over the GQA group
+            q = q_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            do = do_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            lse = lse_ref[0, g, pl.ds(qb * block_q, block_q)][:, None]
+            delta = delta_ref[0, g, pl.ds(qb * block_q, block_q)][:, None]
+            z = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+            p = jnp.where(valid, jnp.exp(z - lse), 0.0)       # [BQ, BK]
+            dv = dv + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [BK, D]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [BQ, BK]
+            dz = p * (dp - delta) * scale
+            dk = dk + jax.lax.dot_general(
+                dz, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [BK, D]
+        return dk, dv
+
+    zero = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (zero, zero))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool, delta):
+    """Pallas backward: q/g [B, H, T, D], k/v [B, Hkv, T, D], lse/delta
+    [B, H, T] f32 -> (dq, dk, dv) in the input dtypes/shapes."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    group = H // Hkv
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    padded = _padded_len(T, block_q, block_k)
+
+    qp, kp, vp, gp = (_pad_seq(x, padded) for x in (q, k, v, g))
+    # Padded rows carry lse=0/delta=0 and zero dO, so every gradient
+    # contribution from them vanishes (p*0 or 0@...).
+    lsep = _pad_seq(lse[..., None], padded)[..., 0]
+    deltap = _pad_seq(delta[..., None], padded)[..., 0]
+
+    common = dict(block_q=block_q, block_k=block_k, padded_len=padded,
+                  kv_len=T, scale=scale, causal=causal)
+
+    q_blocked = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
+    kv_full = pl.BlockSpec((1, 1, padded, D),
+                           lambda b, h, i: (b, h // group, 0, 0))
+    stat_blocked = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B, H, padded // block_q),
+        in_specs=[q_blocked, kv_full, kv_full, q_blocked, stat_blocked,
+                  stat_blocked],
+        out_specs=q_blocked,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)
+
+    # dK/dV gridded over KV heads; the block index h covers query heads
+    # [h*group, (h+1)*group) (contiguous under the h // group GQA mapping),
+    # accumulated inside the kernel so HBM only ever sees [B, Hkv, T, D].
+    qgrp_full = pl.BlockSpec((1, group, padded, D),
+                             lambda b, h, i: (b, h, 0, 0))
+    kv_blocked = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0))
+    statgrp_full = pl.BlockSpec((1, group, padded), lambda b, h, i: (b, h, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common, group=group),
+        grid=(B, Hkv, padded // block_k),
+        in_specs=[qgrp_full, kv_blocked, kv_blocked, qgrp_full, statgrp_full,
+                  statgrp_full],
+        out_specs=[kv_blocked, kv_blocked],
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, padded, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hkv, padded, D), v.dtype)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)
+
+    return dq[:, :, :T, :], dk[:, :, :T, :], dv[:, :, :T, :]
+
+
+def _scores(q, k, *, scale: float, causal: bool):
+    """Masked f32 score matrix [B, H, Tq, Tk] (GQA keys repeated)."""
+    import jax.numpy as jnp
+
+    H, T = q.shape[1], q.shape[2]
+    Hkv = k.shape[1]
+    if H != Hkv:
+        k = jnp.repeat(k, H // Hkv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return s
 
 
 def _reference(q, k, v, *, scale: float, causal: bool):
     """Same math in plain XLA (f32 softmax statistics); [B, H, T, D]."""
     import jax.numpy as jnp
 
-    B, H, T, D = q.shape
-    Hkv = k.shape[1]
+    H = q.shape[1]
+    Hkv = v.shape[1]
     if H != Hkv:
-        k = jnp.repeat(k, H // Hkv, axis=1)
         v = jnp.repeat(v, H // Hkv, axis=1)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+    s = _scores(q, k, scale=scale, causal=causal)
     p = jnp.exp(s - s.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _reference_lse(q, k, *, scale: float, causal: bool):
+    """Log-sum-exp rows of the reference scores -- [B, H, T] f32 (matches the
+    forward kernel's second output)."""
+    import jax.numpy as jnp
+
+    s = _scores(q, k, scale=scale, causal=causal)
+    m = s.max(-1)
+    return m + jnp.log(jnp.exp(s - m[..., None]).sum(-1))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -147,20 +362,40 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
     from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
 
     if use_pallas():
-        return _flash_forward(q, k, v, scale=scale, causal=causal,
-                              block_q=block_q, block_k=block_k,
-                              interpret=pallas_interpret())
+        out, _ = _flash_forward(q, k, v, scale=scale, causal=causal,
+                                block_q=block_q, block_k=block_k,
+                                interpret=pallas_interpret())
+        return out
     return _reference(q, k, v, scale=scale, causal=causal)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    return _flash(q, k, v, scale, causal, block_q, block_k), (q, k, v)
+    from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
+
+    if use_pallas():
+        out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=pallas_interpret())
+    else:
+        out = _reference(q, k, v, scale=scale, causal=causal)
+        lse = _reference_lse(q, k, scale=scale, causal=causal)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v = res
-    # Rematerialize through the reference (identical math): trades O(T^2)
-    # recompute FLOPs for not saving the probability matrix.
+    from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
+
+    q, k, v, out, lse = res
+    if use_pallas():
+        import jax.numpy as jnp
+
+        # delta = rowsum(dO * O): the only precomputation the FA-2 backward
+        # needs beyond lse; cheap elementwise XLA.
+        delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+        return _flash_backward(q, k, v, lse, g, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=pallas_interpret(), delta=delta)
+    # Off TPU: rematerialize through the reference (identical math).
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _reference(q_, k_, v_, scale=scale, causal=causal),
         q, k, v)

@@ -1,13 +1,72 @@
-"""Named-axis collective helpers used inside shard_map'd code.
+"""Mesh-aware collective helpers: topology introspection + ring primitives.
 
-XLA compiles these onto ICI (intra-slice) or DCN (across the dp axis when it
-spans slices); there is no NCCL-style backend to manage (SURVEY.md §5.8) --
-topology correctness is the operator's job, collective choice is ours.
+XLA compiles named-axis collectives onto ICI (intra-slice) or DCN (across
+slices); there is no NCCL-style backend to manage (SURVEY.md §5.8) -- what IS
+ours to get right is *which* link a collective rides.  This module owns that:
+it can tell whether a mesh axis crosses slice boundaries (DCN), validates
+latency-sensitive patterns (the ring) against it, and orders hierarchical
+reductions ICI-first so the narrow DCN hop moves pre-reduced data.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Sequence, Tuple
+
+
+# -- mesh topology introspection (host-side, outside jit) --------------------
+
+def device_slice_id(device: Any) -> int:
+    """Which TPU slice a device belongs to (0 when the platform has no
+    slice notion, e.g. CPU test meshes)."""
+    return int(getattr(device, "slice_index", 0) or 0)
+
+
+def axis_crosses_dcn(mesh: Any, axis: str) -> bool:
+    """True iff moving along ``axis`` (holding the others fixed) ever crosses
+    a slice boundary -- i.e. collectives on this axis ride DCN."""
+    import numpy as np
+
+    if axis not in mesh.axis_names:
+        raise ValueError(f"axis {axis!r} not in mesh {mesh.axis_names}")
+    idx = mesh.axis_names.index(axis)
+    devs = np.asarray(mesh.devices)
+    moved = np.moveaxis(devs, idx, 0)
+    columns = moved.reshape(moved.shape[0], -1)
+    for col in columns.T:
+        ids = {device_slice_id(d) for d in col}
+        if len(ids) > 1:
+            return True
+    return False
+
+
+def require_axis(mesh: Any, axis: str) -> int:
+    """Validate ``axis`` exists on ``mesh``; return its size."""
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has axes {mesh.axis_names}, no {axis!r}; build the mesh "
+            f"with MeshSpec.of(..., {axis}=n) (parallel/mesh.py)")
+    return int(mesh.shape[axis])
+
+
+def require_ici_axis(mesh: Any, axis: str) -> int:
+    """Validate ``axis`` exists AND stays inside a slice (ICI).  Ring
+    attention and per-layer fsdp gathers are latency/bandwidth-bound; letting
+    them silently ride DCN is the classic multislice perf bug."""
+    size = require_axis(mesh, axis)
+    if axis_crosses_dcn(mesh, axis):
+        raise ValueError(
+            f"mesh axis {axis!r} crosses slice boundaries (DCN); ring/"
+            f"per-layer collectives must ride ICI -- put the DCN hop on the "
+            f"leading dp axis instead (parallel/mesh.py axis convention)")
+    return size
+
+
+# -- in-shard_map collectives ------------------------------------------------
+
+def psum(x: Any, axis: str):
+    import jax
+
+    return jax.lax.psum(x, axis)
 
 
 def pmean(x: Any, axis: str):
@@ -16,10 +75,62 @@ def pmean(x: Any, axis: str):
     return jax.lax.pmean(x, axis)
 
 
-def psum(x: Any, axis: str):
+def axis_index(axis: str):
     import jax
 
-    return jax.lax.psum(x, axis)
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    """Size of a named axis from inside shard_map (compile-time constant)."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis)
+    except (AttributeError, TypeError):  # older jax
+        return jax.lax.psum(1, axis)
+
+
+def ring_permutation(axis_size: int,
+                     reverse: bool = False) -> Tuple[Tuple[int, int], ...]:
+    """Source->destination pairs rotating one hop around the ring.  On TPU a
+    ring permutation maps onto neighbor ICI links, so each hop is
+    contention-free and overlaps with compute."""
+    if reverse:
+        return tuple((i, (i - 1) % axis_size) for i in range(axis_size))
+    return tuple((i, (i + 1) % axis_size) for i in range(axis_size))
+
+
+def ppermute_next(x: Any, axis: str, axis_size: int):
+    """Rotate a block one step around the ring (shard i -> i+1)."""
+    import jax
+
+    return jax.lax.ppermute(x, axis, ring_permutation(axis_size))
+
+
+def ppermute_prev(x: Any, axis: str, axis_size: int):
+    """Rotate one step the other way (shard i -> i-1); a bidirectional ring
+    halves the hop count for non-causal exchanges."""
+    import jax
+
+    return jax.lax.ppermute(x, axis, ring_permutation(axis_size,
+                                                      reverse=True))
+
+
+def hierarchical_psum(x: Any, mesh: Any, axes: Sequence[str]):
+    """All-reduce over several mesh axes, ICI axes first.
+
+    Reducing intra-slice before the DCN hop means the slow link carries data
+    already reduced by the ICI axes' width -- the standard two-stage
+    multislice all-reduce.  With a single axis (or all-ICI axes) this is just
+    psum; call inside shard_map.
+    """
+    import jax
+
+    ordered = sorted(axes, key=lambda a: axis_crosses_dcn(mesh, a))
+    for axis in ordered:
+        x = jax.lax.psum(x, axis)
+    return x
 
 
 def all_gather(x: Any, axis: str, *, tiled: bool = True):
@@ -33,17 +144,3 @@ def reduce_scatter(x: Any, axis: str, *, scatter_dimension: int = 0):
 
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
                                 tiled=True)
-
-
-def ppermute_next(x: Any, axis: str, axis_size: int):
-    """Rotate a block one step around the ring (i -> i+1)."""
-    import jax
-
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    return jax.lax.ppermute(x, axis, perm)
-
-
-def axis_index(axis: str):
-    import jax
-
-    return jax.lax.axis_index(axis)

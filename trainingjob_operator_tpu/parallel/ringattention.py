@@ -76,7 +76,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
 
     from trainingjob_operator_tpu.parallel import collectives
 
-    sp = collectives.psum(1, axis_name)
+    sp = collectives.axis_size(axis_name)
     my = collectives.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -125,6 +125,12 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
         from jax.experimental.shard_map import shard_map
 
         compat = {"check_rep": False}
+
+    from trainingjob_operator_tpu.parallel import collectives
+
+    # The ring must ride neighbor ICI links; a DCN-crossing sp axis would
+    # serialize every hop over the slow inter-slice network.
+    collectives.require_ici_axis(mesh, axis_name)
 
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     batch = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)

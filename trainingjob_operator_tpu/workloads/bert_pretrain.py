@@ -82,15 +82,16 @@ def main() -> int:
         return {name: train.globalize_batch(batch_sharding, v)
                 for name, v in local.items()}
 
-    # Shared rank-agnostic checkpoint: rank 0 writes host copies of the full
-    # training state; every rank restores and re-shards onto its mesh.
+    # Shared rank-agnostic checkpoint: sharded orbax save/restore -- each
+    # host writes/reads only its shards; restore reshards onto the current
+    # mesh (the live params/opt_state act as the sharding template).
     state = train.CheckpointState.restore_or_init(
-        rdv, {"params": None, "opt_state": None, "step": 0}, subdir="bert")
+        rdv, {"params": params, "opt_state": opt_state, "step": 0},
+        subdir="bert", mesh=mesh)
     start_step = int(state.value["step"])
-    if start_step > 0 and state.value["params"] is not None:
-        params, opt_state = train.reshard_restored(
-            state.value["params"], state.value["opt_state"],
-            bert.SHARDING_RULES, mesh, opt_state)
+    params = state.value["params"]
+    opt_state = state.value["opt_state"]
+    if start_step > 0:
         print(f"resumed at step {start_step}", flush=True)
 
     loss = None
@@ -102,12 +103,11 @@ def main() -> int:
             t_start = time.time()
         if (i + 1) % 10 == 0 or i == steps - 1:
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
-            host_params = train.host_replicated_copy(params, mesh)
-            host_opt = train.host_replicated_copy(opt_state, mesh)
-            if rdv.process_id == 0:
-                state.save({"params": host_params, "opt_state": host_opt,
-                            "step": i + 1})
+            # Collective sharded background save: all processes call it.
+            state.save({"params": params, "opt_state": opt_state,
+                        "step": i + 1})
     jax.block_until_ready(loss)
+    state.finalize()
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     tokens_s = done * global_batch * seq / dt

@@ -90,26 +90,24 @@ def main() -> int:
         return train.globalize_batch(batch_sharding, tokens)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
-    # Rank 0 saves host copies (width-independent); every rank restores and
-    # re-shards onto its current mesh.
+    # Sharded orbax save/restore -- each host writes/reads only its own
+    # shards, and restore reshards onto the CURRENT (possibly narrower) mesh;
+    # nothing is ever gathered to one host (7B + AdamW replicated is ~78 GB,
+    # far beyond one v5e chip's 16 GB HBM).
     state = train.CheckpointState.restore_or_init(
-        rdv, {"params": None, "opt_state": None, "step": 0}, subdir="llama")
+        rdv, {"params": params, "opt_state": opt_state, "step": 0},
+        subdir="llama", mesh=mesh)
     start_step = int(state.value["step"])
-    if start_step > 0 and state.value["params"] is not None:
-        params, opt_state = train.reshard_restored(
-            state.value["params"], state.value["opt_state"],
-            llama.SHARDING_RULES, mesh, opt_state)
+    params = state.value["params"]
+    opt_state = state.value["opt_state"]
+    if start_step > 0:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
     def save(i):
-        # All processes participate in the all-gather (collective); only
-        # rank 0 writes.
-        host_params = train.host_replicated_copy(params, mesh)
-        host_opt = train.host_replicated_copy(opt_state, mesh)
-        if rdv.process_id != 0:
-            return
-        state.save({"params": host_params, "opt_state": host_opt, "step": i})
+        # Collective: every process calls save; the write is sharded and
+        # asynchronous (the step loop does not block on I/O).
+        state.save({"params": params, "opt_state": opt_state, "step": i})
 
     loss = None
     t_start = None
@@ -122,6 +120,7 @@ def main() -> int:
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
             save(i + 1)
     jax.block_until_ready(loss)
+    state.finalize()  # commit any in-flight background save before exit
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

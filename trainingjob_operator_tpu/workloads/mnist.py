@@ -111,6 +111,7 @@ def main() -> int:
         if (i + 1) % 20 == 0 or i == num_steps - 1:
             print(f"step {i+1}/{num_steps} loss {float(loss):.4f}", flush=True)
             state.save({"params": params, "opt_state": opt_state, "step": i + 1})
+    state.finalize()  # commit any in-flight background save before exit
     dt = time.time() - t0
 
     # Final train accuracy on the last shard.

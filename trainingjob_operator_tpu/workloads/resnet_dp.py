@@ -107,6 +107,7 @@ def main() -> int:
                         "opt_state": jax.device_get(opt_state),
                         "step": i + 1})
     jax.block_until_ready(loss)
+    state.finalize()  # commit any in-flight background save before exit
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} imgs/s={done * global_batch / dt:.1f} "

@@ -4,6 +4,15 @@ The reference delegates checkpointing entirely to the workload, contributing
 only the restart-count env and stable identity (SURVEY.md §5.4).  This module
 is the workload half of that contract: orbax-backed save/restore under the
 injected checkpoint dir, resumed whenever the operator restarts the pod.
+
+Checkpointing is **sharded and asynchronous**: sharded ``jax.Array`` leaves
+are saved distributed -- every host writes only its addressable shards to the
+shared directory, nothing is ever gathered to one device or host (a fully
+replicated gather of Llama-2-7B + AdamW state is ~78 GB and OOMs a 16 GB v5e
+chip) -- and the save runs in the background so the step loop never blocks on
+I/O; ``finalize()`` barriers before exit.  Restore reshards onto the
+*current* mesh, whatever width the job came back at -- the storage format is
+the global array, so elastic resume needs no gather/re-shard choreography.
 """
 
 from __future__ import annotations
@@ -15,7 +24,7 @@ from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
 
 
 class CheckpointState:
-    """Tiny orbax wrapper: one pytree, latest-step retention."""
+    """Orbax wrapper: one pytree, async save, latest-step retention."""
 
     def __init__(self, directory: str, value: Dict[str, Any], manager: Any):
         self.value = value
@@ -24,10 +33,17 @@ class CheckpointState:
 
     @classmethod
     def restore_or_init(cls, rdv: Rendezvous, init_value: Dict[str, Any],
-                        subdir: Optional[str] = None) -> "CheckpointState":
+                        subdir: Optional[str] = None,
+                        mesh: Any = None) -> "CheckpointState":
         """Per-replica path by default; pass ``subdir`` for one path shared by
         every process of the job (elastic resume: the checkpoint must survive
-        a world-size change, so it cannot be keyed on rank)."""
+        a world-size change, so it cannot be keyed on rank).
+
+        ``jax.Array`` leaves in ``init_value`` act as the restore template:
+        the checkpoint is restored *onto their shardings* (the current mesh),
+        regardless of the mesh shape at save time.  ``None`` leaves mean the
+        structure is only known from the checkpoint itself.
+        """
         directory = rdv.checkpoint_dir
         if not directory:
             return cls("", init_value, None)
@@ -50,19 +66,42 @@ class CheckpointState:
                 leaf is None for leaf in jax.tree.leaves(
                     init_value, is_leaf=lambda x: x is None))
             if has_placeholders:
-                # Elastic resume: the param tree is only known from the
-                # checkpoint itself; restore the saved structure as-is.
+                # The param tree is only known from the checkpoint itself;
+                # restore the saved structure as-is.
                 restored = manager.restore(latest)
             else:
-                # Strict: a template/checkpoint mismatch (e.g. resumed with a
-                # different model config) must fail loudly here, not deep in
-                # a jitted step later.
+                # Abstract template: sharded leaves restore distributed onto
+                # their CURRENT sharding (elastic resume across widths); a
+                # template/checkpoint structure mismatch (e.g. resumed with a
+                # different model config) fails loudly here, not deep in a
+                # jitted step later.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                def abstract(x):
+                    if isinstance(x, jax.Array):
+                        sharding = x.sharding
+                        if (mesh is not None
+                                and not isinstance(sharding, NamedSharding)):
+                            # Leaves created off-mesh (e.g. optimizer step
+                            # counters) restore mesh-replicated; a committed
+                            # single-device leaf would poison the jitted
+                            # step's device set.
+                            sharding = NamedSharding(mesh, PartitionSpec())
+                        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                    sharding=sharding)
+                    return x
+
+                template = jax.tree.map(abstract, init_value)
                 restored = manager.restore(
-                    latest, args=ocp.args.StandardRestore(init_value))
+                    latest, args=ocp.args.StandardRestore(template))
             return cls(path, restored, manager)
         return cls(path, init_value, manager)
 
-    def save(self, value: Dict[str, Any]) -> None:
+    def save(self, value: Dict[str, Any], wait: bool = False) -> None:
+        """Background save (all processes must call it -- sharded leaves are
+        written collectively, each host its own shards).  A new save waits for
+        the previous one's commit; pass ``wait=True`` to barrier immediately
+        (pre-exit / preemption checkpoint)."""
         self.value = value
         if self._mngr is None:
             return
@@ -70,7 +109,13 @@ class CheckpointState:
 
         step = int(value.get("step", 0))
         self._mngr.save(step, args=ocp.args.StandardSave(value))
-        self._mngr.wait_until_finished()
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def finalize(self) -> None:
+        """Barrier on any in-flight background save; call before exit."""
+        if self._mngr is not None:
+            self._mngr.wait_until_finished()
 
 
 def round_global_batch(global_batch: int, shards: int) -> int:
@@ -91,55 +136,7 @@ def globalize_batch(sharding, local):
     return jax.make_array_from_process_local_data(sharding, np.asarray(local))
 
 
-def host_replicated_copy(tree: Any, mesh) -> Any:
-    """Numpy host copy of a (possibly cross-host sharded) pytree.
-
-    ``jax.device_get`` alone raises on arrays with non-addressable shards
-    (multi-host fsdp/tp): first all-gather to a fully-replicated layout via a
-    jitted identity with replicated out_shardings, then fetch.  Used for
-    rank-agnostic checkpoints that must survive an elastic width change.
-    """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    if mesh is None or jax.process_count() == 1:
-        return jax.device_get(tree)
-    replicated = NamedSharding(mesh, P())
-    gather = jax.jit(lambda t: t, out_shardings=jax.tree.map(
-        lambda _: replicated, tree))
-    return jax.device_get(gather(tree))
-
-
 def throughput_line(prefix: str, steps_done: int, units_per_step: int,
                     seconds: float, unit: str = "tokens") -> str:
     rate = steps_done * units_per_step / max(seconds, 1e-9)
     return f"{prefix} steps={steps_done} {unit}/s={rate:.0f}"
-
-
-def reshard_restored(host_params: Any, host_opt: Any, rules, mesh,
-                     opt_state_like: Any):
-    """Re-shard host (numpy) checkpoint copies onto the CURRENT mesh.
-
-    The elastic contract: checkpoints are rank- and width-agnostic host
-    trees; after a resize the same checkpoint lands on a different mesh
-    shape.  Params follow the model's sharding rules; the optimizer tree is
-    rebuilt into the live (possibly NamedTuple) structure -- orbax round-trips
-    containers as lists -- with scalar leaves going mesh-replicated.
-    """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from trainingjob_operator_tpu.parallel.sharding import sharding_pytree
-
-    params = jax.device_put(host_params,
-                            sharding_pytree(host_params, rules, mesh))
-    host_opt = jax.tree.unflatten(jax.tree.structure(opt_state_like),
-                                  jax.tree.leaves(host_opt))
-
-    def put(host, like):
-        sharding = like.sharding if isinstance(like.sharding, NamedSharding) \
-            else NamedSharding(mesh, P())
-        return jax.device_put(host, sharding)
-
-    opt_state = jax.tree.map(put, host_opt, opt_state_like)
-    return params, opt_state
