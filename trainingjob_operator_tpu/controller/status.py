@@ -343,10 +343,40 @@ class StatusManager:
                 job.status.end_time = time.time()
             return
         job.metadata.annotations[ending_phase] = message
+        # The stash is METADATA: on a real apiserver the status-subresource
+        # write below ignores it, so it must land through a full update or
+        # the deferred ending is lost and the job loops forever (caught by
+        # the fake-apiserver e2e; the in-memory tracker masked this).
+        self.persist_job_metadata(job)
         self.delete_pods_and_services(job, pods, services)
         update_job_conditions(job, TrainingJobPhase.TERMINATING,
                               PHASE_REASON[TrainingJobPhase.TERMINATING],
                               f"{message}; deleting pods")
+
+    def persist_job_metadata(self, job: TPUTrainingJob) -> None:
+        """Write job metadata (the ending-phase annotation stash) through the
+        main resource, merging our annotations over fresh state on conflict."""
+        work = job
+        for _ in range(5):
+            try:
+                updated = self.clientset.trainingjobs.update(work)
+                job.metadata.resource_version = updated.metadata.resource_version
+                return
+            except ConflictError:
+                try:
+                    # Live read (not the lister): the informer cache lags the
+                    # conflict-winning write on a real apiserver.
+                    fresh = self.clientset.trainingjobs.get(job.namespace,
+                                                            job.name)
+                except KeyError:
+                    return  # job deleted under us
+                fresh.metadata.annotations = {**fresh.metadata.annotations,
+                                              **job.metadata.annotations}
+                work = fresh
+            except KeyError:
+                return  # job deleted under us
+        log.error("persisting %s/%s metadata failed after retries",
+                  job.namespace, job.name)
 
     def delete_pods_and_services(self, job: TPUTrainingJob, pods: List[Pod],
                                  services: List[Service]) -> None:

@@ -43,7 +43,14 @@ def from_iso(s: Optional[str]) -> Optional[float]:
         return None
     if isinstance(s, (int, float)):
         return float(s)
-    return _dt.datetime.fromisoformat(s).timestamp()
+    # Real apiservers emit RFC3339 with a 'Z' suffix; fromisoformat only
+    # learned 'Z' in Python 3.11, and 3.10 is supported (pyproject).
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    ts = _dt.datetime.fromisoformat(s)
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=_dt.timezone.utc)
+    return ts.timestamp()
 
 
 # ---------------------------------------------------------------------------

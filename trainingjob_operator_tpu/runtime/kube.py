@@ -1,16 +1,18 @@
-"""Real-Kubernetes backend (gated on the ``kubernetes`` package).
+"""Real-Kubernetes backend glue: CRD manifest + runtime adapter.
 
 Reference: the reference operator talks to a real apiserver through generated
-clients (pkg/client/) and self-creates its CRD (controller.go:210-234).  This
-module provides:
+clients (pkg/client/, cmd/app/server.go:111-151) and self-creates its CRD
+(controller.go:210-234).  This module provides:
 
 - ``crd_manifest()`` -- a structural-schema CRD manifest (the modern form of
-  the reference's schema-less v1beta1 self-creation, SURVEY.md §8), always
-  available for ``kubectl apply``.
-- ``KubeClientset`` -- an adapter with the same surface as
-  ``client.Clientset``, backed by the kubernetes Python client.  Importing it
-  without the package installed raises a clear error; the rest of the
-  framework never imports this module unless ``--backend kube`` is requested.
+  the reference's schema-less v1beta1 self-creation, SURVEY.md §8), applied
+  by ``KubeClientset.ensure_crd`` at startup or via ``kubectl apply``.
+- ``KubeRuntime`` -- the runtime-shaped adapter for the kube backend: there
+  is no local kubelet to run (the cluster runs pods); start/stop manage the
+  CRD bootstrap and the reflector threads feeding the informer cache.
+
+The transport is the stdlib REST client (client/rest.py) + typed adapters
+(client/kube.py) -- no ``kubernetes`` package required.
 """
 
 from __future__ import annotations
@@ -18,15 +20,6 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from trainingjob_operator_tpu.api import constants
-
-
-def kubernetes_available() -> bool:
-    try:
-        import kubernetes  # noqa: F401
-
-        return True
-    except ImportError:
-        return False
 
 
 def crd_manifest() -> Dict[str, Any]:
@@ -64,20 +57,30 @@ def crd_manifest() -> Dict[str, Any]:
     }
 
 
-class KubeClientset:
-    """Clientset-compatible adapter over the kubernetes Python client.
+class KubeRuntime:
+    """Runtime-shaped lifecycle for the kube backend.
 
-    Objects cross the boundary as dicts via the dataclasses' to_dict/from_dict,
-    so the controller code is identical against sim and real clusters.
+    The other backends' runtimes ARE the cluster (sim kubelet, local
+    processes); on a real cluster the kubelet/scheduler already exist, so
+    ``start`` only has to (a) self-create the CRD like the reference
+    (controller.go:210-234) and (b) start the reflectors that feed the
+    informer cache, blocking until the initial LISTs land
+    (WaitForCacheSync, controller.go:195).
     """
 
-    def __init__(self, kubeconfig: Optional[str] = None, master_url: str = "",
-                 in_cluster: bool = False):
-        if not kubernetes_available():
-            raise ImportError(
-                "the 'kubernetes' package is not installed; the kube backend "
-                "is unavailable in this environment (use --backend sim or "
-                "localproc, or export manifests via runtime.kube.crd_manifest)")
-        raise NotImplementedError(
-            "KubeClientset CRUD adapters land with the kube backend milestone; "
-            "this build targets the sim and localproc backends")
+    def __init__(self, clientset: Any, apply_crd: bool = True):
+        self._cs = clientset
+        self._apply_crd = apply_crd
+
+    def start(self) -> None:
+        if self._apply_crd:
+            if self._cs.ensure_crd():
+                import logging
+
+                logging.getLogger("trainingjob.kube").info(
+                    "created CRD %s.%s", constants.KIND_PLURAL,
+                    constants.GROUP_NAME)
+        self._cs.start(wait_synced=True)
+
+    def stop(self) -> None:
+        self._cs.stop()

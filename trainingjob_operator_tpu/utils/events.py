@@ -13,7 +13,7 @@ import logging
 from collections import deque
 from typing import Any
 
-from trainingjob_operator_tpu.core.objects import Event, ObjectMeta, now
+from trainingjob_operator_tpu.core.objects import Event, ObjectMeta, new_uid, now
 
 log = logging.getLogger("trainingjob.events")
 
@@ -37,7 +37,11 @@ class EventRecorder:
         meta = obj.metadata
         ev = Event(
             metadata=ObjectMeta(
-                name=f"{meta.name}.{next(_seq):06d}",
+                # Unique across operator restarts: on a persistent backend a
+                # process-local counter would collide with a previous run's
+                # events (409) and drop them; the uid suffix never collides,
+                # the counter keeps same-moment events ordered in listings.
+                name=f"{meta.name}.{next(_seq):06d}.{new_uid()[:8]}",
                 namespace=meta.namespace or "default",
             ),
             involved_kind=obj.KIND,

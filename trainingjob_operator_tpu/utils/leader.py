@@ -75,3 +75,181 @@ class LeaderElector:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class KubeLeaderElector:
+    """Cluster-wide leader election over a coordination.k8s.io/v1 Lease.
+
+    Reference: leaderelection.RunOrDie over an endpoints lock in kube-system
+    (cmd/app/server.go:85-106), modernized to the Lease resource (the
+    endpoints lock is deprecated upstream).  Semantics: the holder renews
+    every ``retry_period``; a candidate takes over when
+    ``renewTime + lease_duration`` has passed; optimistic-concurrency
+    conflicts mean someone else moved first -- back off and re-observe.
+    """
+
+    LEASE_PREFIX = "/apis/coordination.k8s.io/v1"
+
+    def __init__(self, rest: "object", config: LeaderElectionConfig,
+                 identity: str = "", namespace: str = "kube-system",
+                 name: str = "tpu-trainingjob-operator"):
+        self._rest = rest
+        self._config = config
+        self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
+        self._path = (f"{self.LEASE_PREFIX}/namespaces/{namespace}"
+                      f"/leases/{name}")
+        self._create_path = f"{self.LEASE_PREFIX}/namespaces/{namespace}/leases"
+        self._name = name
+        self._namespace = namespace
+        self._stop = threading.Event()
+        self.lost = threading.Event()
+        self._renewer: "Optional[threading.Thread]" = None
+        self._on_lost = None
+
+    # -- lease object plumbing ----------------------------------------------
+
+    def _lease_body(self, lease: Optional[dict], transitions: int) -> dict:
+        now = time.time()
+        spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self._config.lease_duration),
+            "renewTime": _micro_ts(now),
+            "leaseTransitions": transitions,
+        }
+        if lease is None or (lease.get("spec") or {}).get(
+                "holderIdentity") != self.identity:
+            spec["acquireTime"] = _micro_ts(now)
+        else:
+            spec["acquireTime"] = (lease.get("spec") or {}).get(
+                "acquireTime", _micro_ts(now))
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self._name, "namespace": self._namespace},
+            "spec": spec,
+        }
+        if lease is not None:
+            body["metadata"]["resourceVersion"] = (
+                lease.get("metadata") or {}).get("resourceVersion", "")
+        return body
+
+    def _try_acquire_or_renew(self) -> bool:
+        from trainingjob_operator_tpu.client.rest import ApiError
+        from trainingjob_operator_tpu.client.tracker import (
+            AlreadyExistsError,
+            ConflictError,
+            NotFoundError,
+        )
+
+        try:
+            try:
+                lease = self._rest.request("GET", self._path)
+            except NotFoundError:
+                self._rest.request("POST", self._create_path,
+                                   body=self._lease_body(None, 0))
+                log.info("%s acquired new lease %s", self.identity, self._name)
+                return True
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity", "")
+            if holder and holder != self.identity:
+                renew = _parse_micro_ts(spec.get("renewTime"))
+                duration = float(spec.get("leaseDurationSeconds")
+                                 or self._config.lease_duration)
+                if renew is not None and time.time() - renew < duration:
+                    return False  # current holder is alive
+                log.info("%s taking over expired lease from %s",
+                         self.identity, holder)
+            transitions = int(spec.get("leaseTransitions") or 0)
+            if holder != self.identity:
+                transitions += 1
+            self._rest.request("PUT", self._path,
+                               body=self._lease_body(lease, transitions))
+            return True
+        except (ConflictError, AlreadyExistsError):
+            return False  # raced another candidate; re-observe next period
+        except ApiError as exc:
+            log.warning("lease %s: apiserver error %s", self._name, exc)
+            return False
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, on_started_leading, stop: Optional[threading.Event] = None,
+            on_lost=None) -> None:
+        """Block until the lease is held, then renew in the background while
+        invoking the callback (leaderelection.RunOrDie -> OnStartedLeading).
+
+        On renewal failing past the renew deadline, ``lost`` is set and
+        ``on_lost`` fires (OnStoppedLeading) -- wire it to the process stop
+        event so a deposed leader halts reconciling instead of running split-
+        brain against the new leader.
+        """
+        self._on_lost = on_lost
+        retry = max(self._config.retry_period, 0.1)
+        while not self._stop.is_set() and (stop is None or not stop.is_set()):
+            if self._try_acquire_or_renew():
+                self._renewer = threading.Thread(
+                    target=self._renew_loop, daemon=True, name="lease-renew")
+                self._renewer.start()
+                try:
+                    on_started_leading()
+                finally:
+                    self.release()
+                return
+            self._stop.wait(retry)
+
+    def _renew_loop(self) -> None:
+        # Self-demotion after renew_deadline, NOT lease_duration: the old
+        # leader must consider itself deposed strictly BEFORE a candidate may
+        # take the lease at renewTime + lease_duration (client-go semantics;
+        # the gap absorbs clock skew and a late last renew attempt).
+        retry = max(self._config.retry_period, 0.1)
+        last_renewed = time.time()
+        while not self._stop.wait(retry):
+            if self._try_acquire_or_renew():
+                last_renewed = time.time()
+            elif time.time() - last_renewed > self._config.renew_deadline:
+                log.error("%s lost lease %s (renewal failed past the renew "
+                          "deadline)", self.identity, self._name)
+                self.lost.set()
+                if self._on_lost is not None:
+                    self._on_lost()
+                return
+
+    def is_leader(self) -> bool:
+        return self._renewer is not None and not self.lost.is_set()
+
+    def release(self) -> None:
+        """Stop renewing and clear the holder so a successor acquires
+        immediately rather than waiting out the lease."""
+        self._stop.set()
+        from trainingjob_operator_tpu.client.tracker import NotFoundError
+
+        try:
+            lease = self._rest.request("GET", self._path)
+            if (lease.get("spec") or {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                self._rest.request("PUT", self._path, body=lease)
+        except Exception:  # NotFound, conflict, connection loss: best effort
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _micro_ts(ts: float) -> str:
+    """RFC3339 with microseconds (the Lease renewTime format)."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_micro_ts(s: Optional[str]) -> Optional[float]:
+    from trainingjob_operator_tpu.core.objects import from_iso
+
+    if not s:
+        return None
+    try:
+        return from_iso(s)
+    except ValueError:
+        return None
