@@ -32,6 +32,9 @@ from typing import Optional
 import jax
 
 NEG_INF = -1e30
+#: TPU lane width: per-row softmax stats cross the kernel boundary lane-
+#: replicated as [..., T, LANE] because Mosaic tiles the last two block dims.
+LANE = 128
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
@@ -84,8 +87,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Log-sum-exp per query row: the only softmax statistic the backward
-    # kernels need to recompute probabilities exactly.
-    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    # kernels need to recompute probabilities exactly.  Lane-replicated to
+    # [BQ, 128] -- Mosaic requires the last two block dims tiled (8, 128),
+    # which a [.., BQ] vector layout cannot satisfy.
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(jnp.maximum(l, 1e-30)),
+                                     (m.shape[0], LANE))
 
 
 def _pad_seq(x, padded: int):
@@ -142,17 +148,15 @@ def _flash_forward(q, k, v, *, scale: float, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, LANE), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, padded), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, padded, LANE), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    if padded != T:
-        out, lse = out[:, :, :T, :], lse[:, :, :T]
-    return out, lse
+    return out[:, :, :T, :], lse[:, :, :T, 0]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -165,8 +169,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)          # [BQ, D]
     do = do_ref[0, 0].astype(jnp.float32)        # [BQ, D]
-    lse = lse_ref[0, 0][:, None]                 # [BQ, 1] f32
-    delta = delta_ref[0, 0][:, None]             # [BQ, 1] f32
+    lse = lse_ref[0, 0][:, 0:1]                  # [BQ, 1] f32 (lane 0)
+    delta = delta_ref[0, 0][:, 0:1]              # [BQ, 1] f32
     bq, d = q.shape
 
     if causal:
@@ -233,8 +237,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.float32)
             do = do_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(
                 jnp.float32)
-            lse = lse_ref[0, g, pl.ds(qb * block_q, block_q)][:, None]
-            delta = delta_ref[0, g, pl.ds(qb * block_q, block_q)][:, None]
+            lse = lse_ref[0, g, pl.ds(qb * block_q, block_q), 0:1]
+            delta = delta_ref[0, g, pl.ds(qb * block_q, block_q), 0:1]
             z = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [BQ, BK]
@@ -273,9 +277,12 @@ def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
 
     qp, kp, vp, gp = (_pad_seq(x, padded) for x in (q, k, v, g))
     # Padded rows carry lse=0/delta=0 and zero dO, so every gradient
-    # contribution from them vanishes (p*0 or 0@...).
-    lsep = _pad_seq(lse[..., None], padded)[..., 0]
-    deltap = _pad_seq(delta[..., None], padded)[..., 0]
+    # contribution from them vanishes (p*0 or 0@...).  Stats are lane-
+    # replicated to [.., T, 128] at the kernel boundary (Mosaic tiling).
+    lsep = jnp.broadcast_to(_pad_seq(lse[..., None], padded),
+                            (B, H, padded, LANE))
+    deltap = jnp.broadcast_to(_pad_seq(delta[..., None], padded),
+                              (B, H, padded, LANE))
 
     common = dict(block_q=block_q, block_k=block_k, padded_len=padded,
                   kv_len=T, scale=scale, causal=causal)
@@ -283,7 +290,8 @@ def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
     q_blocked = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
     kv_full = pl.BlockSpec((1, 1, padded, D),
                            lambda b, h, i: (b, h // group, 0, 0))
-    stat_blocked = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+    stat_blocked = pl.BlockSpec((1, 1, block_q, LANE),
+                                lambda b, h, i: (b, h, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -301,7 +309,8 @@ def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
     qgrp_full = pl.BlockSpec((1, group, padded, D),
                              lambda b, h, i: (b, h, 0, 0))
     kv_blocked = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0))
-    statgrp_full = pl.BlockSpec((1, group, padded), lambda b, h, i: (b, h, 0))
+    statgrp_full = pl.BlockSpec((1, group, padded, LANE),
+                                lambda b, h, i: (b, h, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common, group=group),
         grid=(B, Hkv, padded // block_k),

@@ -89,13 +89,19 @@ class LocalProcRuntime(PodStateRuntime):
 
     def __init__(self, clientset: Clientset, nodes: int = 1,
                  log_dir: Optional[str] = None, tick: float = 0.02,
-                 termination_grace: float = 2.0):
+                 termination_grace: float = 2.0,
+                 pods_per_node: Optional[int] = None):
         super().__init__(clientset, tick)
         self._grace = termination_grace
         self._log_dir = Path(log_dir or "/tmp/tpu-trainingjob-logs")
         self._log_dir.mkdir(parents=True, exist_ok=True)
         self._port_map: Dict[Tuple[str, str], int] = {}
         self._node_names = [f"local-{i}" for i in range(nodes)]
+        #: None = unbounded (every pending pod launches).  Set to bound node
+        #: capacity like a real cluster: pods beyond it go Unschedulable --
+        #: what the controller's elastic starvation shrink keys on, letting
+        #: node loss exercise the true resize path with real processes.
+        self._pods_per_node = pods_per_node
 
     def _new_state(self, uid: str) -> _Proc:
         return _Proc(uid=uid)
@@ -150,6 +156,37 @@ class LocalProcRuntime(PodStateRuntime):
     def recover_node(self, node: str) -> None:
         set_node_readiness(self._cs, node, True)
 
+    def _pick_node(self, pod: Pod, ready_nodes) -> Optional[str]:
+        """Capacity-aware placement (None = none fits); unbounded when
+        pods_per_node is unset (hash spread, the historical behavior)."""
+        if self._pods_per_node is None:
+            return ready_nodes[hash(pod.name) % len(ready_nodes)]
+        with self._lock:
+            load: Dict[str, int] = {}
+            for proc in self._state.values():
+                if proc.popen is not None and proc.popen.poll() is None:
+                    load[proc.node] = load.get(proc.node, 0) + 1
+        for node in ready_nodes:
+            if load.get(node, 0) < self._pods_per_node:
+                return node
+        return None
+
+    def _mark_unschedulable(self, pod: Pod) -> None:
+        """Same shape the sim scheduler reports (and kube-scheduler would):
+        PodScheduled=False/Unschedulable -- the controller's elastic
+        starvation shrink keys on it."""
+        msg = "0/? nodes available: insufficient capacity"
+        for cond in pod.status.conditions:
+            if (cond.type == PodConditionType.SCHEDULED
+                    and cond.status == ConditionStatus.FALSE
+                    and cond.message == msg):
+                return
+        pod.status.conditions = [Condition(
+            type=PodConditionType.SCHEDULED, status=ConditionStatus.FALSE,
+            reason="Unschedulable", message=msg,
+            last_transition_time=time.time())]
+        self._try_update_pod(pod)
+
     def local_address(self, service_name: str, namespace: str, port: int) -> str:
         """The localhost address a cluster DNS name maps to (for tests)."""
         return f"127.0.0.1:{self._mapped_port(f'{service_name}.{namespace}', str(port))}"
@@ -186,7 +223,10 @@ class LocalProcRuntime(PodStateRuntime):
             if pod.status.phase == PodPhase.PENDING and proc.popen is None:
                 if not ready_nodes:
                     continue
-                node = ready_nodes[hash(pod.name) % len(ready_nodes)]
+                node = self._pick_node(pod, ready_nodes)
+                if node is None:
+                    self._mark_unschedulable(pod)
+                    continue
                 self._launch(pod, proc, node)
                 continue
 
