@@ -52,7 +52,7 @@ def train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 6.0 * n * batch * seq + 6.0 * cfg.n_layers * batch * seq * seq * cfg.dim
 
 
-def _timed_steps(cfg, batch, seq, steps, donate=True):
+def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0):
     import jax
     import optax
 
@@ -76,12 +76,38 @@ def _timed_steps(cfg, batch, seq, steps, donate=True):
     params, opt, l = step(params, opt, tokens)  # compile
     for _ in range(2):                          # warmup
         params, opt, l = step(params, opt, tokens)
-    jax.block_until_ready(l)
-    t0 = time.time()
-    for _ in range(steps):
-        params, opt, l = step(params, opt, tokens)
-    jax.block_until_ready(l)
-    return (time.time() - t0) / steps
+    # NOTE: jax.block_until_ready does NOT wait for device execution on the
+    # axon PJRT runtime (tools/repro_block_until_ready.py: 0.024 ms/step
+    # "measured" vs ~70-90 ms real).  A device-to-host transfer of the loss
+    # scalar is the only reliable fence: it cannot complete before every
+    # step it depends on has executed.
+    float(l)
+
+    def timed(n):
+        nonlocal params, opt, l
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt, l = step(params, opt, tokens)
+        float(l)  # forced sync; see note above
+        return (time.perf_counter() - t0) / n
+
+    # Scaling cross-check: per-step time from N and 3N steps must agree,
+    # else the harness is measuring dispatch, not execution.
+    t_a = timed(steps)
+    t_b = timed(steps * 3)
+    if not (0.5 < t_a / t_b < 2.0):
+        raise RuntimeError(
+            f"timing does not scale with step count "
+            f"({t_a * 1e3:.2f} ms/step at {steps} steps vs "
+            f"{t_b * 1e3:.2f} at {steps * 3}): harness is broken")
+    if t_b < min_plausible_s:
+        # Absolute floor (= model FLOPs at 100% of chip peak): catches a
+        # fence that silently stops synchronizing, which the relative
+        # scaling check alone cannot (both runs would measure dispatch).
+        raise RuntimeError(
+            f"step time {t_b * 1e3:.3f} ms below the physical floor "
+            f"{min_plausible_s * 1e3:.3f} ms: harness is not synchronizing")
+    return t_b  # longer run: better amortization of host overhead
 
 
 def bench_train():
@@ -104,8 +130,16 @@ def bench_train():
         batch, seq, steps, ab_batch, peak = 2, 128, 3, 2, None
 
     os.environ["TRAININGJOB_PALLAS"] = "auto"
-    t_step = _timed_steps(cfg, batch, seq, steps)
     flops = train_flops_per_step(cfg, batch, seq)
+    floor = flops / peak if peak else 0.0
+    t_step = _timed_steps(cfg, batch, seq, steps, min_plausible_s=floor)
+    mfu = flops / t_step / peak * 100 if peak else None
+    if mfu is not None and not (0.0 < mfu < 100.0):
+        # A physically impossible number must fail loudly, never be the
+        # headline metric (VERDICT r3).
+        raise RuntimeError(
+            f"implausible MFU {mfu:.1f}% (step {t_step * 1e3:.3f} ms): "
+            f"timing harness is not synchronizing")
     result = {
         "platform": jax.devices()[0].device_kind,
         "params_m": round(llama.num_params(cfg) / 1e6, 1),
@@ -113,15 +147,19 @@ def bench_train():
         "step_ms": round(t_step * 1e3, 1),
         "tokens_per_s": round(batch * seq / t_step),
         "model_tflops_per_step": round(flops / 1e12, 1),
-        "mfu_pct": round(flops / t_step / peak * 100, 1) if peak else None,
+        "mfu_pct": round(mfu, 1) if mfu is not None else None,
     }
 
     # Pallas vs XLA attention A/B at a size both fit.
+    ab_floor = (train_flops_per_step(cfg, ab_batch, seq) / peak
+                if peak else 0.0)
     os.environ["TRAININGJOB_PALLAS"] = "auto"
-    t_pallas = _timed_steps(cfg, ab_batch, seq, steps)
+    t_pallas = _timed_steps(cfg, ab_batch, seq, steps,
+                            min_plausible_s=ab_floor)
     os.environ["TRAININGJOB_PALLAS"] = "off"
     try:
-        t_xla = _timed_steps(cfg, ab_batch, seq, steps)
+        t_xla = _timed_steps(cfg, ab_batch, seq, steps,
+                             min_plausible_s=ab_floor)
     except Exception as exc:  # XLA path OOMs even at the A/B size
         t_xla = None
         result["xla_attention_error"] = type(exc).__name__
@@ -239,13 +277,16 @@ def bench_recovery_full(trials=3):
     from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
 
     samples = []
+    trial_notes = []
     for trial in range(trials):
         ckpt_dir = tempfile.mkdtemp(prefix="bench-ckpt-")
         log_dir = tempfile.mkdtemp(prefix="bench-logs-")
         cs = Clientset()
         tc = TrainingJobController(
             cs, options=OperatorOptions(resync_period=0.05))
-        rt = LocalProcRuntime(cs, nodes=2, termination_grace=1.0,
+        # Grace is a ceiling, not a wait: survivors exit as soon as their
+        # SIGTERM preemption checkpoint commits (train.GracefulShutdown).
+        rt = LocalProcRuntime(cs, nodes=2, termination_grace=10.0,
                               log_dir=log_dir, pods_per_node=1)
         rt.start()
         tc.run(workers=2)
@@ -264,35 +305,53 @@ def bench_recovery_full(trials=3):
                          EnvVar("LLAMA_CKPT_EVERY", "5"),
                          EnvVar("LLAMA_BATCH", "8"),
                          EnvVar("LLAMA_SEQ", "64"),
-                         EnvVar("JAX_PLATFORMS", "cpu"),
+                         # The honored platform knob: a site hook pins the
+                         # axon TPU platform at interpreter start, so a bare
+                         # JAX_PLATFORMS env var is ignored;
+                         # apply_platform_override's config update wins.
+                         EnvVar("TRAININGJOB_JAX_PLATFORM", "cpu"),
                          EnvVar("TRAININGJOB_CHECKPOINT_DIR", ckpt_dir)],
                     ports=[ContainerPort(name="aitj-7900",
                                          container_port=7900)])])))
             job.spec.restarting_exit_code = "137,143"
             cs.trainingjobs.create(job)
 
-            def worker_log(idx):
-                import glob
+            import glob
 
-                paths = sorted(glob.glob(
-                    os.path.join(log_dir, f"*full-worker-{idx}*.log")))
-                return "".join(open(p).read() for p in paths)
+            def log_files():
+                return sorted(glob.glob(
+                    os.path.join(log_dir, "*full-worker-*.log")))
+
+            def read_after(offsets):
+                # Only bytes appended after the recorded offsets: a restart
+                # that happened BEFORE the preemption must not satisfy the
+                # recovery predicate (VERDICT r3 Weak #2 -- the 6 ms sample).
+                out = []
+                for p in log_files():
+                    with open(p) as f:
+                        f.seek(offsets.get(p, 0))
+                        out.append(f.read())
+                return "".join(out)
 
             # Wait until training made progress (a checkpoint exists).
-            if not _wait(lambda: re.search(r"step \d+/", worker_log(0)),
+            if not _wait(lambda: re.search(r"step \d+/", read_after({})),
                          timeout=120):
                 samples.append(None)
                 continue
             time.sleep(1.0)  # let a checkpoint land
 
+            pre_restarts = len(re.findall(r"restart_count=|resumed at step",
+                                          read_after({})))
+
             # Preempt: kill node 1 (its worker dies; elastic shrink to 1).
+            offsets = {p: os.path.getsize(p) for p in log_files()}
             t0 = time.time()
             nodes = sorted({p.spec.node_name
                             for p in cs.pods.list("default")})
             rt.fail_node(nodes[-1])
 
             def resumed_and_stepped():
-                log = worker_log(0) + worker_log(1)
+                log = read_after(offsets)
                 m = re.search(r"resumed at step (\d+)", log)
                 if not m:
                     return False
@@ -305,17 +364,25 @@ def bench_recovery_full(trials=3):
                 samples.append(round(time.time() - t0, 3))
             else:
                 samples.append(None)
+            if pre_restarts:
+                # Surface unexpected pre-preemption churn instead of letting
+                # it silently corrupt the measurement.
+                trial_notes.append(
+                    f"trial {trial}: {pre_restarts} restart marker(s) "
+                    f"before preemption")
         finally:
             tc.stop()
             rt.stop()
     ok = [s for s in samples if s is not None]
     if not ok:
         return {"error": "no successful full-recovery trials",
-                "samples": samples}
+                "samples": samples, "trial_notes": trial_notes}
     return {"p50_s": statistics.median(ok), "samples": samples,
+            "trial_notes": trial_notes,
             "note": "preempt -> llama step completes at new width "
                     "(restart + JAX re-init + mesh rebuild + orbax restore), "
-                    "CPU localproc"}
+                    "CPU localproc; predicate matches only post-preemption "
+                    "log bytes"}
 
 
 def _wait(pred, timeout=60.0, interval=0.02):

@@ -42,6 +42,12 @@ class FakeApiServer:
         self._watch_log_limit = watch_log_limit
         self.required_token = required_token
         self.request_count = 0
+        #: Fault injection: when set (e.g. 500), every request is answered
+        #: with this status -- models a persistently erroring apiserver.
+        self.fail_with: Optional[int] = None
+        #: Like fail_with, but only for watch requests (watch cache down,
+        #: lists still served).
+        self.fail_watch_with: Optional[int] = None
 
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
@@ -68,6 +74,9 @@ class FakeApiServer:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_stopped", False):
+            return  # idempotent: tests may stop mid-test to inject failure
+        self._stopped = True
         self._kubelet_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -197,6 +206,10 @@ class FakeApiServer:
                 self.wfile.write(body)
 
             def _auth_ok(self) -> bool:
+                if server.fail_with is not None:
+                    self._status(server.fail_with, "InternalError",
+                                 "injected fault")
+                    return False
                 if not server.required_token:
                     return True
                 got = self.headers.get("Authorization", "")
@@ -250,6 +263,10 @@ class FakeApiServer:
                     self._json(200, obj)
                     return
                 if query.get("watch") == "true":
+                    if server.fail_watch_with is not None:
+                        self._status(server.fail_watch_with, "InternalError",
+                                     "injected watch fault")
+                        return
                     self._watch(plural, ns, query)
                     return
                 selector = {}

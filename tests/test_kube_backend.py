@@ -206,6 +206,83 @@ class TestReflector:
         finally:
             cs.stop()
 
+    def test_watch_times_out_on_half_open_connection(self):
+        """ADVICE r2 medium: a half-open watch (server never closes, never
+        sends) must hit the client-side socket deadline instead of blocking
+        readline() forever with a silently stale reflector cache."""
+        import socket
+        import threading
+
+        def half_open_server(sock):
+            conn, _ = sock.accept()
+            conn.recv(65536)  # swallow the request...
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n\r\n")
+            # ...then go silent forever: no events, no close (NAT drop /
+            # crashed apiserver behind a dead conntrack entry).
+            threading.Event().wait(30)
+            conn.close()
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        threading.Thread(target=half_open_server, args=(sock,),
+                         daemon=True).start()
+        host, port = sock.getsockname()
+        rest = RestClient(ClusterConfig(server=f"http://{host}:{port}"))
+        t0 = time.time()
+        with pytest.raises(OSError):  # socket timeout (TimeoutError)
+            # server_timeout=1 -> socket deadline 1 + max(5, .25) = 6 s.
+            for _ in rest.watch("/api/v1/pods", timeout_seconds=1):
+                pass
+        assert time.time() - t0 < 15, "watch did not time out client-side"
+
+    def test_reflector_backs_off_on_persistent_5xx(self, server):
+        """ADVICE r2 low: persistent 5xx must re-list with backoff, not in a
+        tight loop hammering a struggling apiserver."""
+        cs = cs_for(server)
+        cs.start()
+        try:
+            assert wait_for(lambda: all(r.wait_synced(5)
+                                        for r in cs.reflectors), 10)
+            server.fail_with = 500
+            start = server.request_count
+            time.sleep(2.0)
+            failed_requests = server.request_count - start
+            # 4 reflectors x a tight loop would be thousands of requests in
+            # 2 s; backoff (0.5, 1.0, ...) keeps it to a handful each.
+            assert failed_requests < 40, (
+                f"{failed_requests} requests in 2 s: reflectors are "
+                f"tight-looping on 5xx")
+            server.fail_with = None
+            cs.pods.create(make_pod("after-recovery"))
+            assert wait_for(lambda: cs.tracker.count(Pod.KIND) == 1, 15)
+        finally:
+            cs.stop()
+
+    def test_reflector_backs_off_on_watch_only_5xx(self, server):
+        """Backoff must also grow when LIST succeeds but WATCH persistently
+        5xxs (watch cache down): resetting after a mere successful list
+        would re-list in a tight 0.5 s loop forever."""
+        cs = cs_for(server)
+        cs.start()
+        try:
+            assert wait_for(lambda: all(r.wait_synced(5)
+                                        for r in cs.reflectors), 10)
+            server.fail_watch_with = 500
+            time.sleep(1.0)  # let each reflector hit the fault at least once
+            start = server.request_count
+            time.sleep(2.0)
+            requests = server.request_count - start
+            # 4 reflectors tight-looping would be thousands (list+watch pairs)
+            # in 2 s; growing backoff keeps it to a handful each.
+            assert requests < 40, (
+                f"{requests} requests in 2 s: reflectors tight-loop when "
+                f"only the watch fails")
+        finally:
+            server.fail_watch_with = None
+            cs.stop()
+
     def test_mirror_prunes_deleted_during_downtime(self, server):
         # Objects deleted while no watch is running disappear on re-list.
         server.seed("pods", make_pod("gone").to_dict())
@@ -291,6 +368,23 @@ class TestKubeLeaderElection:
             lease["spec"]["renewTime"] = _micro_ts(time.time() + 3600)
             server.seed("leases", lease)  # bumps rv: conflicts our renews
             assert stop.wait(5), "on_lost never fired"
+
+        elector.run(lead, on_lost=stop.set)
+        assert elector.lost.is_set()
+
+    def test_lost_lease_on_transport_error(self, server):
+        """ADVICE r2 high: a ConnectionError during renew (apiserver gone)
+        must demote the leader via on_lost, not kill the renew thread and
+        leave a deposed leader reconciling split-brain."""
+        import threading
+
+        rest = RestClient(ClusterConfig(server=server.url))
+        elector = KubeLeaderElector(rest, self.CFG, identity="op-1")
+        stop = threading.Event()
+
+        def lead():
+            server.stop()  # every subsequent renew raises ConnectionError
+            assert stop.wait(5), "on_lost never fired after transport loss"
 
         elector.run(lead, on_lost=stop.set)
         assert elector.lost.is_set()

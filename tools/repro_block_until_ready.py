@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Repro: on the axon PJRT runtime, ``jax.block_until_ready`` may return
+without waiting for device execution, making naive timed loops measure
+dispatch overhead instead of step time (VERDICT r3, Missing #1).
+
+Times the same jitted matmul chain three ways:
+  1. loop + block_until_ready        (the broken r1-r3 bench pattern)
+  2. loop + float(x) device-to-host  (forces a real sync)
+  3. per-iteration float(x)          (fully synchronous lower bound)
+
+If (1) << (2), block_until_ready is not synchronizing on this runtime.
+Prints one JSON line with all three per-step times.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    n, steps = 4096, 20
+
+    @jax.jit
+    def f(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ x)
+        return x
+
+    x = jnp.ones((n, n), jnp.bfloat16)
+    f(x).block_until_ready()  # compile
+
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(steps):
+        y = f(y)
+    jax.block_until_ready(y)
+    t_bur = (time.perf_counter() - t0) / steps
+
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(steps):
+        y = f(y)
+    float(y[0, 0])  # device-to-host transfer: cannot complete early
+    t_d2h = (time.perf_counter() - t0) / steps
+
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(steps):
+        y = f(y)
+        float(y[0, 0])
+    t_sync = (time.perf_counter() - t0) / steps
+
+    print(json.dumps({
+        "platform": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "step_ms_block_until_ready": round(t_bur * 1e3, 3),
+        "step_ms_loop_then_d2h": round(t_d2h * 1e3, 3),
+        "step_ms_per_iter_d2h": round(t_sync * 1e3, 3),
+        "block_until_ready_broken": t_bur < 0.5 * t_d2h,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,19 @@ ELASTIC_REPLICAS_ENV = "TRAININGJOB_ELASTIC_REPLICAS"
 # canary), not join the (full) rendezvous -- it is restarted with a real rank
 # once the resize commits.
 RESERVATION_ENV = "TRAININGJOB_RESERVATION"
+# Seconds before an orphaned reservation canary self-expires (exit 143) so a
+# dead controller's probe cannot pin a TPU host forever.
+RESERVATION_TTL_ENV = "TRAININGJOB_RESERVATION_TTL"
+# Persistent XLA compilation cache dir ("off" disables).  Defaults to a
+# subdir of the checkpoint dir so a restarted worker skips recompilation --
+# the dominant term in elastic-recovery latency.
+COMPILE_CACHE_ENV = "TRAININGJOB_COMPILE_CACHE"
+# Workload-side profiler (SURVEY.md §5.1): directory to write a
+# jax.profiler trace into, and the "start:stop" step range to trace.
+PROFILE_DIR_ENV = "TRAININGJOB_PROFILE_DIR"
+PROFILE_STEPS_ENV = "TRAININGJOB_PROFILE_STEPS"
+# "1" -> log per-step wall time (diagnosable throughput, not one scalar).
+STEP_TIMES_ENV = "TRAININGJOB_STEP_TIMES"
 
 # --- GKE TPU node selectors / resources (north star: BASELINE.json) ---------
 GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"

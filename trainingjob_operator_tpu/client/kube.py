@@ -204,6 +204,7 @@ class Reflector:
 
     def run(self) -> None:
         rv = ""
+        backoff = 0.0  # grows on consecutive failures, resets on progress
         while not self._stop.is_set():
             try:
                 if not rv:
@@ -216,14 +217,28 @@ class Reflector:
                     new_rv = self._apply(etype, obj)
                     if new_rv:
                         rv = new_rv
-                # Clean server-side stream end: resume from last rv.
+                        # Watch PROGRESS (an event made it through) resets
+                        # the failure backoff: environments whose LBs RST
+                        # long watches instead of closing them cleanly must
+                        # not ratchet to the cap while healthy.  A mere
+                        # successful list does NOT reset it -- a watch-only
+                        # 5xx would then re-list in a tight 0.5 s loop.
+                        backoff = 0.0
+                # Clean server-side stream end: provably healthy.
+                backoff = 0.0
             except ApiError as exc:
                 if exc.status == 410:  # Gone: rv outside the server's window
                     log.info("%s watch expired (410); re-listing",
                              self._info.kind)
-                else:
-                    log.warning("%s watch error: %s", self._info.kind, exc)
+                    rv = ""
+                    continue  # 410 is normal aging, not a server fault
+                log.warning("%s watch error: %s", self._info.kind, exc)
                 rv = ""
+                # Exponential backoff: a persistent 5xx (overloaded or
+                # crash-looping apiserver) must not be hammered with
+                # full re-lists in a tight loop.
+                backoff = min(backoff * 2 or 0.5, 30.0)
+                self._stop.wait(backoff)
             except NotFoundError:
                 # CRD not applied yet; retry after a beat.
                 rv = ""
@@ -234,7 +249,8 @@ class Reflector:
                 log.warning("%s watch connection lost (%s); re-syncing",
                             self._info.kind, exc)
                 rv = ""
-                self._stop.wait(0.2)
+                backoff = min(backoff * 2 or 0.2, 30.0)
+                self._stop.wait(backoff)
 
     def start(self) -> None:
         self._thread = threading.Thread(

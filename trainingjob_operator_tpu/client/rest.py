@@ -223,10 +223,16 @@ class RestClient:
         # half-open connection (apiserver crash, NAT drop without FIN) would
         # otherwise hang readline() forever.  The server closes cleanly at
         # timeoutSeconds and the reflector re-lists/re-watches.
-        query["timeoutSeconds"] = str(timeout_seconds or 300)
-        # No socket timeout: a healthy watch may be silent far longer than any
-        # keep-alive interval; lifetime is bounded by timeoutSeconds above.
-        conn = self._connection(fresh=True, timeout=None)
+        server_timeout = timeout_seconds or 300
+        query["timeoutSeconds"] = str(server_timeout)
+        # Socket timeout strictly ABOVE the server-side bound: on a healthy
+        # connection the server always closes first (at timeoutSeconds), so
+        # the socket deadline only fires on a half-open connection (apiserver
+        # crash, NAT drop without FIN) -- where no server close ever arrives
+        # and readline() would otherwise block forever with a silently stale
+        # reflector cache.  The margin absorbs scheduling/RTT slop.
+        margin = max(5.0, 0.25 * server_timeout)
+        conn = self._connection(fresh=True, timeout=server_timeout + margin)
         conn.request("GET", f"{path}?{urlencode(query)}",
                      headers=self._headers())
         resp = conn.getresponse()

@@ -170,6 +170,16 @@ class KubeLeaderElector:
         except ApiError as exc:
             log.warning("lease %s: apiserver error %s", self._name, exc)
             return False
+        except Exception as exc:
+            # Transport failure (ConnectionError / SSLError / timeout /
+            # OSError from the socket layer).  MUST be a failed-renew, not an
+            # unhandled exception: letting it propagate kills _renew_loop
+            # without setting ``lost`` or firing on_lost, so a deposed leader
+            # would keep reconciling while a candidate takes the lease
+            # (split-brain; client-go treats any renew error uniformly).
+            log.warning("lease %s: transport error %s: %s", self._name,
+                        type(exc).__name__, exc)
+            return False
 
     # -- run loop ------------------------------------------------------------
 

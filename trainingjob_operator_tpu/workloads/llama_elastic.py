@@ -104,21 +104,34 @@ def main() -> int:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
-    def save(i):
+    def save(i, wait=False):
         # Collective: every process calls save; the write is sharded and
         # asynchronous (the step loop does not block on I/O).
-        state.save({"params": params, "opt_state": opt_state, "step": i})
+        state.save({"params": params, "opt_state": opt_state, "step": i},
+                   wait=wait)
 
+    shutdown = train.GracefulShutdown().install()
+    profiler = train.StepProfiler()
     loss = None
     t_start = None
     for i in range(start_step, steps):
+        profiler.step_start(i)
         params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
         if i == start_step:
             jax.block_until_ready(loss)
             t_start = time.time()
+            if start_step > 0:
+                # First completed step at the new width: the elastic-recovery
+                # endpoint (bench_recovery_full keys on a step > resume step).
+                print(f"step {i+1}/{steps} loss {float(loss):.4f} "
+                      f"(first after resume)", flush=True)
+        profiler.step_end(i, sync=loss)
+        if shutdown.requested:
+            shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
         if (i + 1) % ckpt_every == 0 or i == steps - 1:
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
             save(i + 1)
+    profiler.close()
     jax.block_until_ready(loss)
     state.finalize()  # commit any in-flight background save before exit
     dt = max(time.time() - (t_start or time.time()), 1e-9)
