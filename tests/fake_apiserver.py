@@ -48,6 +48,9 @@ class FakeApiServer:
         #: Like fail_with, but only for watch requests (watch cache down,
         #: lists still served).
         self.fail_watch_with: Optional[int] = None
+        #: Pod names the toy kubelet refuses to schedule: they stay Pending
+        #: with an Unschedulable condition (gang-atomicity scenarios).
+        self.unschedulable_names: set = set()
 
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
@@ -147,6 +150,18 @@ class FakeApiServer:
                     uid = pod["metadata"].get("uid", "")
                     phase = (pod.get("status") or {}).get("phase", "Pending")
                     ann = pod["metadata"].get("annotations") or {}
+                    if phase == "Pending" and (pod["metadata"].get("name")
+                                               in self.unschedulable_names):
+                        conds = (pod.get("status") or {}).get("conditions") or []
+                        if not conds:
+                            pod.setdefault("status", {})["phase"] = "Pending"
+                            pod["status"]["conditions"] = [{
+                                "type": "PodScheduled", "status": "False",
+                                "reason": "Unschedulable",
+                                "message": "0/1 nodes available: "
+                                           "insufficient google.com/tpu"}]
+                            self._commit(key, pod, "MODIFIED")
+                        continue
                     if phase == "Pending":
                         pod.setdefault("spec", {})["nodeName"] = node_name
                         pod["status"] = {

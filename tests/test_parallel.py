@@ -15,6 +15,7 @@ apply_jax_platform_override()
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.parallel.mesh import MeshSpec, make_mesh
 from trainingjob_operator_tpu.parallel.ringattention import (
     reference_attention,
@@ -220,3 +221,76 @@ class TestFitSpec:
         mesh = make_mesh(MeshSpec.of(fsdp=4, tp=2))
         fitted = fit_spec(P(None, "fsdp", "tp"), (2, 6, 8), mesh)
         assert fitted == P(None, None, "tp")
+
+
+class TestVirtualMultislice:
+    """Multislice end-to-end on the virtual CPU mesh (VERDICT r3 item 7):
+    megascale env -> rendezvous -> mesh_from_rendezvous -> DCN-aware
+    collectives, with REAL device/mesh objects, not mocks."""
+
+    @pytest.fixture
+    def two_slices(self, monkeypatch):
+        import jax
+
+        monkeypatch.setenv(constants.VIRTUAL_DEVICES_PER_SLICE_ENV,
+                           str(jax.device_count() // 2))
+
+    def test_mesh_from_megascale_env_puts_dp_on_dcn(self, two_slices):
+        import jax
+
+        from trainingjob_operator_tpu.parallel import collectives
+        from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+        from trainingjob_operator_tpu.workloads import rendezvous
+
+        rdv = rendezvous.from_env({"MEGASCALE_NUM_SLICES": "2",
+                                   "MEGASCALE_SLICE_ID": "0"})
+        assert rdv.num_slices == 2
+        mesh = mesh_from_rendezvous(rdv, model_parallel=2)
+        assert mesh.shape["dp"] == 2
+        assert collectives.axis_crosses_dcn(mesh, "dp")
+        for axis in mesh.axis_names:
+            if axis != "dp" and mesh.shape[axis] > 1:
+                assert collectives.require_ici_axis(mesh, axis) > 1
+        # fsdp spanning slices is the classic multislice perf bug: forbidden.
+        assert not collectives.axis_crosses_dcn(mesh, "fsdp")
+        assert jax.device_count() == mesh.size
+
+    def test_hierarchical_psum_executes_on_two_slice_mesh(self, two_slices):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec
+
+        from trainingjob_operator_tpu.parallel import collectives
+        from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+        from trainingjob_operator_tpu.workloads import rendezvous
+
+        rdv = rendezvous.from_env({"MEGASCALE_NUM_SLICES": "2",
+                                   "MEGASCALE_SLICE_ID": "0"})
+        mesh = mesh_from_rendezvous(rdv)
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
+        axes = tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+        inner = tuple(a for a in ("fsdp", "tp", "sp") if a in mesh.axis_names)
+        x = jnp.arange(mesh.size, dtype=jnp.float32).reshape(
+            mesh.shape["dp"], -1)
+        reduced = shard_map(
+            lambda v: collectives.hierarchical_psum(v, mesh, axes),
+            mesh=mesh, in_specs=PartitionSpec("dp", inner),
+            out_specs=PartitionSpec("dp", inner))(x)
+        assert np.allclose(np.asarray(reduced),
+                           float(np.arange(mesh.size).sum()))
+
+    def test_ici_first_ordering(self, two_slices):
+        from trainingjob_operator_tpu.parallel import collectives
+        from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+        from trainingjob_operator_tpu.workloads import rendezvous
+
+        rdv = rendezvous.from_env({"MEGASCALE_NUM_SLICES": "2",
+                                   "MEGASCALE_SLICE_ID": "0"})
+        mesh = mesh_from_rendezvous(rdv)
+        # hierarchical_psum sorts ICI axes first; dp (DCN) must come last.
+        axes = sorted(("dp", "fsdp"),
+                      key=lambda a: collectives.axis_crosses_dcn(mesh, a))
+        assert axes[-1] == "dp"

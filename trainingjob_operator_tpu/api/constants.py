@@ -70,6 +70,10 @@ PROFILE_DIR_ENV = "TRAININGJOB_PROFILE_DIR"
 PROFILE_STEPS_ENV = "TRAININGJOB_PROFILE_STEPS"
 # "1" -> log per-step wall time (diagnosable throughput, not one scalar).
 STEP_TIMES_ENV = "TRAININGJOB_STEP_TIMES"
+# Virtual multislice geometry for platforms without a slice notion (CPU test
+# meshes): device.id // k becomes the slice id, letting the DCN-aware paths
+# run end-to-end on a forced-host-device mesh.
+VIRTUAL_DEVICES_PER_SLICE_ENV = "TRAININGJOB_VIRTUAL_DEVICES_PER_SLICE"
 
 # --- GKE TPU node selectors / resources (north star: BASELINE.json) ---------
 GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"

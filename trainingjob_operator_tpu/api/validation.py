@@ -112,6 +112,16 @@ def validate_job(job: TPUTrainingJob, require_image: bool = False) -> List[str]:
                         f"{prefix}.replicas: {rspec.replicas} does not match the "
                         f"TPU geometry (topology {tpu.topology} x "
                         f"{tpu.slice_count} slice(s) = {want} hosts)")
+                # Elastic bounds resize in whole slices (the runnable unit).
+                from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
+
+                hosts = resolve_slice_shape(tpu).hosts
+                for field_name, val in (("minReplicas", rspec.min_replicas),
+                                        ("maxReplicas", rspec.max_replicas)):
+                    if val is not None and hosts > 1 and val % hosts != 0:
+                        errs.append(
+                            f"{prefix}.{field_name}: {val} is not a whole "
+                            f"number of slices (hosts per slice = {hosts})")
     return errs
 
 

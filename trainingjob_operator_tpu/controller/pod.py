@@ -31,11 +31,13 @@ from trainingjob_operator_tpu.controller.naming import (
     filter_for_replica_type,
     gen_general_name,
     gen_labels,
+    gang_size,
     get_slices,
     full_width,
     is_retryable_exit_code,
     pod_index,
     pods_below_width,
+    round_to_gang,
 )
 from trainingjob_operator_tpu.controller.service import get_ports_from_container, get_ports_from_job
 from trainingjob_operator_tpu.core.objects import (
@@ -150,7 +152,7 @@ class PodReconciler:
         failed_phase = TrainingJobPhase.FAILED
         creating_msgs: Dict[str, List[str]] = {}
         now = time.time()
-        unschedulable = 0
+        stuck_indices: List[int] = []
         probe_failed = False
 
         for index, pod_slice in enumerate(pod_slices):
@@ -180,7 +182,7 @@ class PodReconciler:
                 created = pod.metadata.creation_timestamp
                 if (created is not None
                         and now - created > self.options.scale_pending_time):
-                    unschedulable += 1
+                    stuck_indices.append(index)
             phase, is_restart, cmsg = self.reconcile_containers(job, pod, rtype, node_ready)
             if cmsg:
                 failed_reasons.append(cmsg)
@@ -260,16 +262,38 @@ class PodReconciler:
         # grace window give their slots back (shrink to scheduled capacity,
         # floor min_replicas).  Covers initial admission onto a partial
         # cluster.  Never fires once part of the group has succeeded -- a
-        # resize would discard and re-run the finished work.
-        if (unschedulable and spec.edl_policy == EdlPolicy.AUTO
-                and rs.succeeded == 0):
-            new_width = max(replicas - unschedulable, self._min_width(spec))
+        # resize would discard and re-run the finished work.  Multi-host TPU
+        # groups shrink in whole slices; when shrink is unavailable, a
+        # partially placed slice is torn down whole so its hosts are not
+        # held hostage by an unschedulable sibling (gang atomicity --
+        # improves on pod.go:186-193's per-index gap fill).
+        gang = gang_size(spec)
+        if stuck_indices and spec.edl_policy == EdlPolicy.AUTO \
+                and rs.succeeded == 0:
+            if gang > 1:
+                stuck = len({i // gang for i in stuck_indices}) * gang
+            else:
+                stuck = len(stuck_indices)
+            new_width = max(replicas - stuck, self._min_width(spec))
             if new_width < replicas:
                 return self._elastic_resize(
                     job, rtype, rt, new_width, pods, replica_pods, force=False,
-                    msg=f"{unschedulable} {rt} pods unschedulable for "
+                    msg=f"{len(stuck_indices)} {rt} pods unschedulable for "
                         f">{self.options.scale_pending_time:.0f}s; shrinking "
                         f"{replicas}->{new_width}")
+        if gang > 1 and stuck_indices and rs.succeeded == 0:
+            # Succeeded guard: releasing a gang that contains finished pods
+            # would discard and re-run completed work.
+            ending = self._release_partial_gangs(job, rtype, rt, gang,
+                                                 stuck_indices, replica_pods,
+                                                 now)
+            if ending:
+                return ending
+        elif not stuck_indices:
+            # Healthy again: a future starvation episode starts its release
+            # backoff from scratch.
+            getattr(self, "_gang_release_backoff", {}).pop(
+                f"{meta_namespace_key(job)}/{rtype}", None)
 
         # Elastic re-expand: a degraded group that is stably running starts a
         # non-destructive capacity probe after a (backed-off) delay.
@@ -286,10 +310,15 @@ class PodReconciler:
     @staticmethod
     def _min_width(spec: Any) -> int:
         """Shrink floor: never below 1 -- a group elastically resized to zero
-        could neither probe back up nor distinguish itself from completion."""
+        could neither probe back up nor distinguish itself from completion.
+        For multi-host TPU groups the floor is a whole slice (rounded UP):
+        a sub-slice of hosts is not a runnable unit."""
         desired = spec.replicas if spec.replicas is not None else 1
-        lo = spec.min_replicas if spec.min_replicas is not None else desired
-        return max(lo, 1)
+        lo = max(spec.min_replicas if spec.min_replicas is not None else desired, 1)
+        gang = gang_size(spec)
+        if gang > 1:
+            lo = max(round_to_gang(lo, gang, up=True), gang)
+        return lo
 
     @staticmethod
     def _full_width(spec: Any) -> int:
@@ -306,15 +335,77 @@ class PodReconciler:
         base_pods = pods_below_width(replica_pods, replicas)
         if any(p.status.phase == PodPhase.SUCCEEDED for p in base_pods):
             return None  # resizing would discard finished work
-        lost = sum(1 for p in base_pods
-                   if p.spec.node_name and p.spec.node_name not in node_ready)
+        gang = gang_size(spec)
+        lost_pods = [p for p in base_pods
+                     if p.spec.node_name and p.spec.node_name not in node_ready]
+        if gang > 1:
+            # Slice-granular loss: losing ANY host of a slice loses the whole
+            # slice -- its survivors keep a nodeSelector demanding the full
+            # slice topology, which JAX/ICI cannot initialize below full host
+            # count.  The unit of account is the slice (VERDICT r3 item 3).
+            lost_gangs = {idx // gang for p in lost_pods
+                          if (idx := pod_index(p)) is not None}
+            lost = len(lost_gangs) * gang
+            unit = f"{len(lost_gangs)} {rt} slice(s)"
+        else:
+            lost = len(lost_pods)
+            unit = f"{lost} {rt} pods"
         new_width = max(replicas - lost, self._min_width(spec))
         if lost == 0 or new_width >= replicas:
             return None  # nothing lost, or already at the floor -> restart path
         return self._elastic_resize(
             job, rtype, rt, new_width, all_pods, replica_pods, force=True,
-            msg=f"{lost} {rt} pods lost their node ({msg}); shrinking "
+            msg=f"{unit} lost their node ({msg}); shrinking "
                 f"{replicas}->{new_width}", node_ready=node_ready)
+
+    def _release_partial_gangs(self, job: TPUTrainingJob, rtype: str,
+                               rt: str, gang: int,
+                               stuck_indices: List[int],
+                               replica_pods: List[Pod], now: float,
+                               ) -> Optional[Tuple[str, str]]:
+        """Gang atomicity for multi-host slices (SURVEY §7 hard-part (a)).
+
+        A slice with one member stuck Unschedulable past the grace window
+        while siblings already hold TPU hosts is deadlock-shaped: the placed
+        members pin capacity the scheduler may need to place the gang
+        elsewhere, and the slice can never run partial.  Tear the whole
+        slice down (all-or-nothing) and let the next sync recreate it
+        atomically.  Fully-unplaced stuck slices hold nothing and stay
+        pending.  Used when elastic shrink is unavailable (non-Auto policy
+        or already at the width floor).
+
+        Releases back off exponentially per replica group (in controller
+        memory): a cluster persistently one host short must not thrash
+        delete/recreate at scale_pending_time period forever."""
+        backoffs = getattr(self, "_gang_release_backoff", None)
+        if backoffs is None:
+            backoffs = self._gang_release_backoff = {}
+        key = f"{meta_namespace_key(job)}/{rtype}"
+        last, attempts = backoffs.get(key, (0.0, 0))
+        delay = self.options.scale_pending_time * (2 ** attempts)
+        if now - last < min(delay, 900.0):
+            self.enqueue_job(job, delay=max(delay - (now - last), 1.0))
+            return None
+        released = []
+        for g in sorted({i // gang for i in stuck_indices}):
+            members = [p for p in replica_pods
+                       if (idx := pod_index(p)) is not None
+                       and g * gang <= idx < (g + 1) * gang]
+            if not any(p.spec.node_name for p in members):
+                continue  # nothing placed: the gang holds no capacity
+            for p in members:
+                self.pod_control.delete_pod(p.namespace, p.name, job)
+            released.append(g)
+        if not released:
+            return None
+        backoffs[key] = (now, min(attempts + 1, 10))
+        msg = (f"slice(s) {released} of {rt} partially scheduled for "
+               f">{self.options.scale_pending_time:.0f}s; releasing for "
+               f"atomic retry (attempt {attempts + 1})")
+        self.recorder.event(job, EventRecorder.NORMAL,
+                            constants.SCALING_REASON, msg)
+        log.info("gang release %s/%s: %s", job.namespace, job.name, msg)
+        return TrainingJobPhase.NONE, msg
 
     def _maybe_start_expand_probe(self, job: TPUTrainingJob, rtype: str,
                                   rt: str, spec: Any, replicas: int,
@@ -374,16 +465,20 @@ class PodReconciler:
                 msg=f"capacity confirmed; re-expanding {rt} "
                     f"{replicas}->{probe_target}")
         if probe_failed:
-            if landed:
+            spec = job.spec.replica_specs[rtype]
+            committable = round_to_gang(len(landed), gang_size(spec))
+            if committable:
                 # Partial capacity: commit what actually landed rather than
                 # training below available capacity forever (the remaining
-                # gap re-probes with backoff from the new width).
+                # gap re-probes with backoff from the new width).  Multi-host
+                # groups commit whole slices only -- a partial slice of
+                # landed reservations is not runnable.
                 job.status.scale_probes.pop(rtype, None)
                 return self._elastic_resize(
-                    job, rtype, rt, replicas + len(landed), all_pods,
+                    job, rtype, rt, replicas + committable, all_pods,
                     replica_pods, force=False,
                     msg=f"partial capacity; re-expanding {rt} "
-                        f"{replicas}->{replicas + len(landed)} "
+                        f"{replicas}->{replicas + committable} "
                         f"(wanted {probe_target})")
             for p in probe_pods:
                 self.pod_control.delete_pod(p.namespace, p.name, job)
@@ -769,12 +864,16 @@ class PodReconciler:
                 EnvVar(constants.TPU_ACCELERATOR_ENV, shape.accelerator),
                 EnvVar(constants.TPU_TOPOLOGY_ENV, shape.topology),
             ]
+            # EFFECTIVE slice count: elastic width n is a whole number of
+            # slices, so after a slice-granular shrink the megascale env
+            # reflects the surviving DCN-dp width, not the declared one.
+            num_slices = max(n // shape.hosts, 1) if shape.hosts else 1
             if spec.tpu.slice_count > 1:
                 # Multislice: DCN data-parallel across slices (megascale env).
                 slice_id = int(index) // shape.hosts
                 env += [
                     EnvVar(constants.SLICE_ID_ENV, str(slice_id)),
-                    EnvVar(constants.NUM_SLICES_ENV, str(spec.tpu.slice_count)),
+                    EnvVar(constants.NUM_SLICES_ENV, str(num_slices)),
                     EnvVar(constants.MEGASCALE_COORDINATOR_ENV,
                            f"{instances[0]}:{constants.DEFAULT_COORDINATOR_PORT + 1}"),
                 ]

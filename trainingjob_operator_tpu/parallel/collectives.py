@@ -10,15 +10,30 @@ reductions ICI-first so the narrow DCN hop moves pre-reduced data.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Sequence, Tuple
 
 
 # -- mesh topology introspection (host-side, outside jit) --------------------
 
 def device_slice_id(device: Any) -> int:
-    """Which TPU slice a device belongs to (0 when the platform has no
-    slice notion, e.g. CPU test meshes)."""
-    return int(getattr(device, "slice_index", 0) or 0)
+    """Which TPU slice a device belongs to.
+
+    On TPU this is the PJRT ``slice_index``.  On platforms with no slice
+    notion (CPU test meshes), ``TRAININGJOB_VIRTUAL_DEVICES_PER_SLICE=k``
+    assigns ``device.id // k`` -- the virtual-multislice geometry used by the
+    dryrun/tests to exercise the DCN-aware paths (hierarchical reduce, ICI
+    validation) end-to-end on a forced-host-device mesh, with real device
+    objects rather than mocks."""
+    sid = getattr(device, "slice_index", None)
+    if sid is not None:
+        return int(sid)
+    from trainingjob_operator_tpu.api import constants
+
+    per = os.environ.get(constants.VIRTUAL_DEVICES_PER_SLICE_ENV, "")
+    if per and per.isdigit() and int(per) > 0:
+        return int(getattr(device, "id", 0)) // int(per)
+    return 0
 
 
 def axis_crosses_dcn(mesh: Any, axis: str) -> bool:

@@ -66,11 +66,42 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
     import numpy as np
     from jax.sharding import Mesh
 
+    from trainingjob_operator_tpu.parallel.collectives import device_slice_id
+
     devs = list(devices if devices is not None else jax.devices())
     want = spec.size()
     if want != len(devs):
         raise ValueError(
             f"mesh {dict(spec.axes)} needs {want} devices, have {len(devs)}")
+    slice_ids = {device_slice_id(d) for d in devs}
+    if len(slice_ids) > 1:
+        # Multislice: the LEADING axis must stride across slices and every
+        # trailing axis stay inside one slice -- dp carries the DCN hop,
+        # fsdp/tp/sp ride ICI (the layout axis_crosses_dcn/require_ici_axis
+        # enforce).
+        if all(getattr(d, "slice_index", None) is not None for d in devs):
+            # Real TPU multislice: let mesh_utils order within-slice devices
+            # along the ICI torus (neighbor collectives), with the DCN
+            # product on the leading axis.
+            try:
+                from jax.experimental import mesh_utils
+
+                n_slices = len(slice_ids)
+                dcn_shape = [1] * len(spec.shape)
+                per_slice = list(spec.shape)
+                dcn_shape[0] = n_slices
+                per_slice[0] = spec.shape[0] // n_slices
+                arr = mesh_utils.create_hybrid_device_mesh(
+                    per_slice, dcn_shape, devices=devs)
+                return Mesh(arr, spec.names)
+            except Exception:
+                pass  # fall through to slice-major ordering
+        # Virtual multislice (CPU test mesh): no ICI topology to read; a
+        # slice-major sort gives the correct DCN structure.
+        arr = np.array(sorted(devs, key=lambda d: (device_slice_id(d),
+                                                   getattr(d, "id", 0)))
+                       ).reshape(spec.shape)
+        return Mesh(arr, spec.names)
     try:
         from jax.experimental import mesh_utils
 
