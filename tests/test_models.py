@@ -198,3 +198,136 @@ class TestGQARing:
                              sequence_parallel=True)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
                                    rtol=3e-2, atol=3e-2)
+
+
+class TestMoE:
+    """Mixtral-style MoE (models/moe.py): routing correctness vs a naive
+    per-token mixture, capacity drops, ep-sharded training."""
+
+    def _layer(self, cfg, key):
+        import jax
+
+        from trainingjob_operator_tpu.models import moe
+
+        params = moe.init_params(cfg, key)
+        # Unstack layer 0 for direct _moe_mlp calls.
+        return jax.tree.map(lambda x: x[0], params["layers"])
+
+    def test_forward_shape_and_finite_loss(self):
+        import jax
+        import jax.numpy as jnp
+
+        from trainingjob_operator_tpu.models import moe
+
+        cfg = moe.MoEConfig.tiny()
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+        logits, aux = moe.forward(params, tokens[:, :-1], cfg)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert bool(jnp.isfinite(aux)) and float(aux) > 0
+        loss = moe.loss_fn(params, {"tokens": tokens}, cfg)
+        assert bool(jnp.isfinite(loss))
+
+    def test_routing_matches_naive_mixture_with_ample_capacity(self):
+        # With capacity >= T*k no token drops: the dense-dispatch einsum
+        # formulation must equal the obvious per-token top-k mixture.
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from trainingjob_operator_tpu.models import moe
+
+        cfg = moe.MoEConfig.tiny(dim=16, ffn_dim=32, n_experts=4,
+                                 experts_per_token=2)
+        cfg = moe.MoEConfig(**{**cfg.__dict__, "capacity_factor": 100.0,
+                               "dtype": "float32"})
+        layer = self._layer(cfg, jax.random.PRNGKey(0))
+        h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        y, _ = moe._moe_mlp(h, layer, cfg, jnp.float32)
+
+        # Naive reference: loop tokens in numpy.
+        w = {k: np.asarray(v) for k, v in layer["moe"].items()}
+        hn = np.asarray(h, np.float32)
+        expect = np.zeros_like(hn)
+        for b in range(hn.shape[0]):
+            for t in range(hn.shape[1]):
+                x = hn[b, t]
+                logits = x @ w["router"]
+                probs = np.exp(logits - logits.max())
+                probs /= probs.sum()
+                top = np.argsort(-probs)[:cfg.experts_per_token]
+                gates = probs[top] / probs[top].sum()
+                for g, e in zip(gates, top):
+                    gate = x @ w["w_gate"][e]
+                    act = gate / (1 + np.exp(-gate)) * (x @ w["w_up"][e])
+                    expect[b, t] += g * (act @ w["w_down"][e])
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_capacity_drops_lowest_priority_tokens(self):
+        import jax
+        import jax.numpy as jnp
+
+        from trainingjob_operator_tpu.models import moe
+
+        # All probability mass on expert 0 -> with capacity C only C tokens
+        # get dispatched per row.
+        B, T, E, C = 1, 6, 4, 2
+        probs = jnp.zeros((B, T, E)).at[:, :, 0].set(1.0)
+        dispatch, combine = moe._dispatch_combine(probs, k=1, capacity=C)
+        assert float(dispatch.sum()) == B * C
+        # The first C tokens won the slots (priority order is token order).
+        assert float(dispatch[0, :C].sum()) == C
+        assert float(combine[0, C:].sum()) == 0.0
+
+    def test_ep_sharded_train_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import NamedSharding
+
+        from trainingjob_operator_tpu.models import moe
+        from trainingjob_operator_tpu.parallel.mesh import MeshSpec, make_mesh
+        from trainingjob_operator_tpu.parallel.sharding import (
+            batch_spec,
+            shard_pytree,
+        )
+
+        cfg = moe.MoEConfig.tiny()
+        spec = MeshSpec.of(fsdp=2, tp=2, ep=2)
+        mesh = make_mesh(spec)
+        params = shard_pytree(moe.init_params(cfg, jax.random.PRNGKey(0)),
+                              moe.SHARDING_RULES, mesh)
+        # Expert weights actually carry the ep axis.
+        w_gate = params["layers"]["moe"]["w_gate"]
+        assert "ep" in str(w_gate.sharding.spec)
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                    cfg.vocab_size)
+        tokens = jax.device_put(tokens, NamedSharding(mesh, batch_spec(mesh)))
+
+        @jax.jit
+        def step(p, o, t):
+            l, g = jax.value_and_grad(
+                lambda pp: moe.loss_fn(pp, {"tokens": t}, cfg, mesh=mesh))(p)
+            u, o2 = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o2, l
+
+        losses = []
+        for _ in range(8):
+            params, opt, loss = step(params, opt, tokens)
+            losses.append(float(loss))
+        assert all(jnp.isfinite(jnp.asarray(losses)))
+        assert losses[-1] < losses[0]  # memorizes the fixed batch
+
+    def test_param_counts(self):
+        from trainingjob_operator_tpu.models import moe
+
+        cfg = moe.MoEConfig.mixtral_8x7b()
+        total = moe.num_params(cfg)
+        active = moe.active_params(cfg)
+        assert 45e9 < total < 50e9       # Mixtral-8x7B ~46.7B
+        assert 12e9 < active < 14e9      # ~12.9B active per token
+        assert active < total

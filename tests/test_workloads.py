@@ -163,3 +163,28 @@ class TestJaxWorkloads:
         assert resnet_dp.main() == 0
         out = capsys.readouterr().out
         assert "steps=1 " in out or "imgs/s" in out
+
+
+class TestMoEWorkload:
+    def test_moe_pretrain_smoke_ep2_and_resume(self, monkeypatch, tmp_path,
+                                               capsys):
+        """MoE pretrain over fsdp x ep, checkpoint, resume -- the expert-
+        parallel sibling of the llama elastic flow."""
+        from trainingjob_operator_tpu.workloads import moe_pretrain
+
+        monkeypatch.setenv("MOE_STEPS", "4")
+        monkeypatch.setenv("MOE_CKPT_EVERY", "2")
+        monkeypatch.setenv("MOE_BATCH", "8")
+        monkeypatch.setenv("MOE_SEQ", "32")
+        monkeypatch.setenv("MOE_EP", "2")
+        monkeypatch.setenv("MOE_TP", "2")
+        monkeypatch.setenv("TRAININGJOB_CHECKPOINT_DIR", str(tmp_path))
+        assert moe_pretrain.main() == 0
+        out = capsys.readouterr().out
+        assert "'ep': 2" in out and "active" in out
+
+        monkeypatch.setenv("MOE_STEPS", "6")
+        monkeypatch.setenv("TRAININGJOB_REPLICA_RESTARTCOUNT", "1")
+        assert moe_pretrain.main() == 0
+        out = capsys.readouterr().out
+        assert "resumed at step 4" in out

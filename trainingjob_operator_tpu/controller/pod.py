@@ -289,9 +289,12 @@ class PodReconciler:
                                                  now)
             if ending:
                 return ending
-        elif not stuck_indices:
-            # Healthy again: a future starvation episode starts its release
-            # backoff from scratch.
+        elif not stuck_indices and rs.active == replicas:
+            # Reset the release backoff only once the group actually RUNS at
+            # full width -- "no stuck pods this sync" also describes freshly
+            # recreated pods that have not aged past the grace window yet,
+            # and resetting there would let the release loop thrash at
+            # scale_pending_time period forever.
             getattr(self, "_gang_release_backoff", {}).pop(
                 f"{meta_namespace_key(job)}/{rtype}", None)
 

@@ -5,6 +5,8 @@
 - ``bert``: BERT-base encoder (config 4, v5e-16 multi-host).
 - ``llama``: Llama-2 decoder family (config 5, elastic pretrain) with
   dp/fsdp/tp/sp sharding rules and ring attention for long context.
+- ``moe``: Mixtral-style sparse-MoE decoder exercising the ``ep`` mesh axis
+  (GShard dense-dispatch routing: static shapes, einsum all-to-all).
 
 All models are plain-JAX pytrees (init_fn/apply_fn pairs): explicit param
 trees keep sharding rules trivially addressable by path

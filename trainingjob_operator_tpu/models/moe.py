@@ -1,0 +1,279 @@
+"""Mixtral-style sparse-MoE decoder, TPU-first expert parallelism.
+
+Same idiom as models/llama.py (plain pytree, stacked layers under
+``lax.scan``), with the dense MLP replaced by a top-k routed mixture of
+experts.  The reference operator has no model layer at all (it delegates to
+in-container frameworks, SURVEY.md §2.7); this module exists because the TPU
+build owns the workload layer, and MoE is the model family that exercises the
+``ep`` mesh axis (parallel/mesh.py AXIS_ORDER) end-to-end.
+
+TPU mapping:
+- Routing is the GShard/Switch dense-dispatch formulation: static-shape
+  one-hot dispatch/combine tensors and einsums, NO dynamic gather/scatter --
+  data-dependent shapes would break XLA tiling; the MXU sees batched matmuls.
+- Expert weights carry a leading expert dim sharded on ``ep``
+  (SHARDING_RULES); the dispatch einsum's [tokens x experts] contraction is
+  where GSPMD inserts the all-to-all when ep > 1.
+- Expert capacity bounds per-expert work (static): tokens over capacity are
+  dropped (their combine weight is zero), the standard trade for fixed
+  shapes.  The auxiliary load-balancing loss keeps the router near-uniform
+  so drops stay rare.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from trainingjob_operator_tpu.models import llama as _llama
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "MoEConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256, dim: int = 64, n_layers: int = 2,
+             n_heads: int = 4, n_kv_heads: int = 2, ffn_dim: int = 128,
+             n_experts: int = 4, experts_per_token: int = 2) -> "MoEConfig":
+        return cls(vocab_size=vocab_size, dim=dim, n_layers=n_layers,
+                   n_heads=n_heads, n_kv_heads=n_kv_heads, ffn_dim=ffn_dim,
+                   n_experts=n_experts, experts_per_token=experts_per_token,
+                   max_seq_len=128)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+#: Expert dim rides ``ep``; within an expert the matmul dims keep the
+#: Megatron fsdp/tp layout.  Attention/embedding rules match llama's.
+SHARDING_RULES = [
+    (r"tok_embed", ("tp", "fsdp")),
+    (r"lm_head", ("fsdp", "tp")),
+    (r"attn/w[qkv]$", (None, "fsdp", "tp")),
+    (r"attn/wo$", (None, "tp", "fsdp")),
+    (r"moe/router$", (None, "fsdp", None)),
+    (r"moe/w_(gate|up)$", (None, "ep", "fsdp", "tp")),
+    (r"moe/w_down$", (None, "ep", "tp", "fsdp")),
+    (r"norm", (None,)),
+]
+
+
+def init_params(config: MoEConfig, key) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    kv_dim = c.n_kv_heads * c.head_dim
+    keys = jax.random.split(k_layers, 8)
+
+    def stacked(k, shape, scale=None):
+        return dense(k, (c.n_layers,) + shape, scale)
+
+    return {
+        "tok_embed": dense(k_emb, (c.vocab_size, c.dim), 0.02),
+        "layers": {
+            "attn": {
+                "wq": stacked(keys[0], (c.dim, c.dim)),
+                "wk": stacked(keys[1], (c.dim, kv_dim)),
+                "wv": stacked(keys[2], (c.dim, kv_dim)),
+                "wo": stacked(keys[3], (c.dim, c.dim)),
+            },
+            "moe": {
+                "router": stacked(keys[4], (c.dim, c.n_experts)),
+                "w_gate": stacked(keys[5], (c.n_experts, c.dim, c.ffn_dim)),
+                "w_up": stacked(keys[6], (c.n_experts, c.dim, c.ffn_dim)),
+                "w_down": stacked(keys[7], (c.n_experts, c.ffn_dim, c.dim)),
+            },
+            "attn_norm": jnp.ones((c.n_layers, c.dim), jnp.float32),
+            "moe_norm": jnp.ones((c.n_layers, c.dim), jnp.float32),
+        },
+        "final_norm": jnp.ones((c.dim,), jnp.float32),
+        "lm_head": dense(k_head, (c.dim, c.vocab_size), 0.02),
+    }
+
+
+def expert_capacity(config: MoEConfig, seq_len: int) -> int:
+    """Static per-expert token budget for one [T] row."""
+    c = config
+    cap = int(c.capacity_factor * c.experts_per_token * seq_len
+              / c.n_experts)
+    return max(cap, 1)
+
+
+def _dispatch_combine(probs, k: int, capacity: int):
+    """GShard-style routing tensors from router probabilities.
+
+    probs: [B, T, E] float32.  Returns (dispatch [B,T,E,C] bool-ish,
+    combine [B,T,E,C] float32): ``combine`` carries the renormalized top-k
+    gate for each (token, expert, capacity-slot) assignment, ``dispatch`` its
+    0/1 mask.  Assignment order is choice-rank-major then token-major, so
+    when an expert overflows its capacity the lowest-priority tokens drop
+    (combine weight 0) -- static shapes, no data-dependent control flow.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, T, E = probs.shape
+    gates, idx = jax.lax.top_k(probs, k)                   # [B,T,k]
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((B, T, E, capacity), probs.dtype)
+    combine = jnp.zeros((B, T, E, capacity), probs.dtype)
+    used = jnp.zeros((B, E), probs.dtype)                  # slots taken
+    for choice in range(k):
+        onehot = jax.nn.one_hot(idx[:, :, choice], E,
+                                dtype=probs.dtype)         # [B,T,E]
+        # Position of each token within its chosen expert's capacity:
+        # tokens already assigned by earlier choices + earlier tokens of
+        # this choice.
+        pos = (jnp.cumsum(onehot, axis=1) - onehot
+               + used[:, None, :]) * onehot                # [B,T,E]
+        within = (pos < capacity) * onehot
+        slot = jax.nn.one_hot(pos.sum(-1), capacity,
+                              dtype=probs.dtype)           # [B,T,C]
+        assign = within[..., None] * slot[:, :, None, :]   # [B,T,E,C]
+        dispatch = dispatch + assign
+        combine = combine + assign * gates[:, :, choice, None, None]
+        used = used + within.sum(axis=1)
+    return dispatch, combine
+
+
+def _moe_mlp(h, layer, config: MoEConfig, compute):
+    """Routed expert MLP for h [B, T, D] -> ([B, T, D], aux_loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    B, T, _ = h.shape
+    cap = expert_capacity(c, T)
+
+    # Router in float32: tiny matmul, and routing decisions are precision-
+    # sensitive (bf16 ties reorder top_k).
+    logits = h.astype(jnp.float32) @ layer["moe"]["router"]  # [B,T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = _dispatch_combine(probs, c.experts_per_token, cap)
+
+    # Switch-transformer load-balancing auxiliary loss: E * sum_e
+    # (fraction of tokens routed to e) * (mean router prob of e).
+    frac = dispatch.sum(axis=(1, 3)) / max(
+        T * c.experts_per_token / c.n_experts, 1e-9) / c.n_experts  # [B,E]
+    mean_prob = probs.mean(axis=1)                                  # [B,E]
+    aux = (frac * mean_prob).sum(-1).mean() * c.n_experts
+
+    # Dense dispatch: [B,T,D] x [B,T,E,C] -> [B,E,C,D]; the [E] dim is
+    # ep-sharded, so this contraction is where the all-to-all lands.
+    x_e = jnp.einsum("btd,btec->becd", h, dispatch.astype(compute))
+    gate = jax.nn.silu(jnp.einsum(
+        "becd,edf->becf", x_e, layer["moe"]["w_gate"].astype(compute)))
+    up = jnp.einsum("becd,edf->becf", x_e,
+                    layer["moe"]["w_up"].astype(compute))
+    y_e = jnp.einsum("becf,efd->becd", gate * up,
+                     layer["moe"]["w_down"].astype(compute))
+    y = jnp.einsum("becd,btec->btd", y_e, combine.astype(compute))
+    return y, aux.astype(jnp.float32)
+
+
+def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
+            mesh=None, remat: bool = False):
+    """Logits [B, T, vocab] plus the mean auxiliary load-balancing loss."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B, T = tokens.shape
+    h = params["tok_embed"].astype(compute)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+    def attn(h, layer):
+        q = h @ layer["attn"]["wq"].astype(compute)
+        k = h @ layer["attn"]["wk"].astype(compute)
+        v = h @ layer["attn"]["wv"].astype(compute)
+        q = q.reshape(B, T, c.n_heads, c.head_dim)
+        k = k.reshape(B, T, c.n_kv_heads, c.head_dim)
+        v = v.reshape(B, T, c.n_kv_heads, c.head_dim)
+        q = _llama._rope(q, positions, c.rope_theta)
+        k = _llama._rope(k, positions, c.rope_theta)
+        from trainingjob_operator_tpu.ops import flash_attention
+        from trainingjob_operator_tpu.ops.flash_attention import (
+            flash_attention_sharded)
+
+        if mesh is not None and mesh.devices.size > 1:
+            o = flash_attention_sharded(q, k, v, mesh, causal=True)
+        else:
+            o = flash_attention(q, k, v, causal=True)
+        return o.reshape(B, T, c.dim) @ layer["attn"]["wo"].astype(compute)
+
+    def block(carry, layer):
+        h, aux = carry
+        h = h + attn(_llama._rmsnorm(h, layer["attn_norm"], c.norm_eps),
+                     layer)
+        y, layer_aux = _moe_mlp(
+            _llama._rmsnorm(h, layer["moe_norm"], c.norm_eps), layer, c,
+            compute)
+        return (h + y, aux + layer_aux), None
+
+    if remat:
+        block = jax.checkpoint(block)
+    (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)),
+                               params["layers"])
+    h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = h @ params["lm_head"].astype(compute)
+    return logits.astype(jnp.float32), aux / c.n_layers
+
+
+def loss_fn(params, batch, config: MoEConfig, *, mesh=None,
+            remat: bool = False):
+    """Next-token cross-entropy + weighted load-balancing auxiliary."""
+    import optax
+
+    tokens = batch["tokens"]
+    logits, aux = forward(params, tokens[:, :-1], config, mesh=mesh,
+                          remat=remat)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, tokens[:, 1:]).mean()
+    return ce + config.aux_loss_weight * aux
+
+
+def num_params(config: MoEConfig) -> int:
+    c = config
+    kv_dim = c.n_kv_heads * c.head_dim
+    per_layer = (c.dim * c.dim * 2 + c.dim * kv_dim * 2
+                 + c.dim * c.n_experts
+                 + c.n_experts * c.dim * c.ffn_dim * 3 + 2 * c.dim)
+    return c.vocab_size * c.dim * 2 + c.n_layers * per_layer + c.dim
+
+
+def active_params(config: MoEConfig) -> int:
+    """Params touched per token (top-k of the experts): the FLOPs basis."""
+    c = config
+    kv_dim = c.n_kv_heads * c.head_dim
+    per_layer = (c.dim * c.dim * 2 + c.dim * kv_dim * 2
+                 + c.dim * c.n_experts
+                 + c.experts_per_token * c.dim * c.ffn_dim * 3 + 2 * c.dim)
+    return c.vocab_size * c.dim * 2 + c.n_layers * per_layer + c.dim

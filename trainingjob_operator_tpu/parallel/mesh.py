@@ -78,7 +78,15 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
         # Multislice: the LEADING axis must stride across slices and every
         # trailing axis stay inside one slice -- dp carries the DCN hop,
         # fsdp/tp/sp ride ICI (the layout axis_crosses_dcn/require_ici_axis
-        # enforce).
+        # enforce).  Validate the geometry BEFORE building anything: a
+        # leading axis that cannot absorb whole slices would silently put
+        # inner axes on DCN.
+        n_slices = len(slice_ids)
+        if spec.shape[0] % n_slices != 0 or len(devs) % n_slices != 0:
+            raise ValueError(
+                f"multislice mesh {dict(spec.axes)}: leading axis "
+                f"{spec.names[0]}={spec.shape[0]} must be a multiple of the "
+                f"{n_slices} slices (else inner axes would cross DCN)")
         if all(getattr(d, "slice_index", None) is not None for d in devs):
             # Real TPU multislice: let mesh_utils order within-slice devices
             # along the ICI torus (neighbor collectives), with the DCN
@@ -86,7 +94,6 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
             try:
                 from jax.experimental import mesh_utils
 
-                n_slices = len(slice_ids)
                 dcn_shape = [1] * len(spec.shape)
                 per_slice = list(spec.shape)
                 dcn_shape[0] = n_slices
@@ -94,10 +101,14 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
                 arr = mesh_utils.create_hybrid_device_mesh(
                     per_slice, dcn_shape, devices=devs)
                 return Mesh(arr, spec.names)
-            except Exception:
-                pass  # fall through to slice-major ordering
+            except Exception as exc:
+                import logging
+
+                logging.getLogger("trainingjob.mesh").warning(
+                    "create_hybrid_device_mesh failed (%s); falling back to "
+                    "slice-major ordering", exc)
         # Virtual multislice (CPU test mesh): no ICI topology to read; a
-        # slice-major sort gives the correct DCN structure.
+        # slice-major sort gives the correct DCN structure (validated above).
         arr = np.array(sorted(devs, key=lambda d: (device_slice_id(d),
                                                    getattr(d, "id", 0)))
                        ).reshape(spec.shape)
@@ -113,18 +124,22 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
 
 def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
                          sequence_parallel: int = 1,
+                         expert_parallel: int = 1,
                          fsdp: bool = True):
     """Derive the standard mesh for this worker's provisioned topology.
 
     Local devices x num_processes = global devices; DCN (slices) maps to the
-    leading dp axis, ICI carries fsdp/tp/sp.
+    leading dp axis, ICI carries fsdp/tp/sp/ep (``ep`` carries the MoE
+    expert all-to-all, models/moe.py -- latency-bound, so it must never
+    cross DCN).
     """
     import jax
 
     n = jax.device_count()
-    inner = model_parallel * sequence_parallel
+    inner = model_parallel * sequence_parallel * expert_parallel
     if n % inner != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp={inner}")
+        raise ValueError(f"{n} devices not divisible by "
+                         f"tp*sp*ep={inner}")
     data = n // inner
     dp = max(rdv.num_slices, 1)
     if data % dp != 0:
@@ -132,11 +147,12 @@ def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
         # ride DCN instead of ICI, the exact layout this module forbids.
         raise ValueError(
             f"data axis {data} not divisible by num_slices={dp}; choose "
-            f"tp/sp so each slice holds an equal data shard")
+            f"tp/sp/ep so each slice holds an equal data shard")
     fsdp_size = data // dp
     if fsdp:
         spec = MeshSpec.of(dp=dp, fsdp=fsdp_size, tp=model_parallel,
-                           sp=sequence_parallel)
+                           sp=sequence_parallel, ep=expert_parallel)
     else:
-        spec = MeshSpec.of(dp=data, tp=model_parallel, sp=sequence_parallel)
+        spec = MeshSpec.of(dp=data, tp=model_parallel,
+                           sp=sequence_parallel, ep=expert_parallel)
     return make_mesh(spec)

@@ -1,0 +1,129 @@
+"""Elastic MoE (Mixtral-style) pretrain over a dp x fsdp x tp x ep mesh.
+
+Same operator contract as workloads/llama_elastic.py (width from
+TRAININGJOB_* env, shared sharded checkpoint, graceful-preemption SIGTERM
+handler, profiler hooks), with the MoE model family exercising expert
+parallelism: expert weights shard on ``ep`` and the token->expert dispatch
+einsum carries the all-to-all on ICI (models/moe.py).
+
+Run: ``python -m trainingjob_operator_tpu.workloads.moe_pretrain``.
+Env: MOE_CONFIG=tiny|8x7b, MOE_TP, MOE_EP, MOE_STEPS, MOE_BATCH (global),
+MOE_SEQ, MOE_LR, MOE_CKPT_EVERY.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous, train
+
+    rdv = rendezvous.initialize_jax_distributed()
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding
+
+    from trainingjob_operator_tpu.models import moe
+    from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+    from trainingjob_operator_tpu.parallel.sharding import (
+        batch_spec,
+        shard_pytree,
+    )
+
+    cfg = (moe.MoEConfig.mixtral_8x7b()
+           if os.environ.get("MOE_CONFIG", "tiny") == "8x7b"
+           else moe.MoEConfig.tiny())
+    tp = int(os.environ.get("MOE_TP", "1"))
+    ep = int(os.environ.get("MOE_EP", "1"))
+    steps = int(os.environ.get("MOE_STEPS", "20"))
+    global_batch = int(os.environ.get("MOE_BATCH", "8"))
+    seq = int(os.environ.get("MOE_SEQ", "128"))
+    lr = float(os.environ.get("MOE_LR", "3e-4"))
+    ckpt_every = int(os.environ.get("MOE_CKPT_EVERY", "10"))
+
+    mesh = mesh_from_rendezvous(rdv, model_parallel=tp, expert_parallel=ep)
+    print(f"elastic width {rdv.elastic_replicas}, mesh "
+          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+          f"{moe.num_params(cfg)/1e6:.1f}M params "
+          f"({moe.active_params(cfg)/1e6:.1f}M active), restart "
+          f"{rdv.restart_count}", flush=True)
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+    global_batch = train.round_global_batch(global_batch, n_data)
+
+    params = shard_pytree(moe.init_params(cfg, jax.random.PRNGKey(0)),
+                          moe.SHARDING_RULES, mesh)
+    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = tx.init(params)
+    batch_sharding = NamedSharding(mesh, batch_spec(mesh))
+
+    @jax.jit
+    def step_fn(p, o, tokens):
+        def loss(pp):
+            return moe.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh)
+
+        l, grads = jax.value_and_grad(loss)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, l
+
+    local_batch = global_batch // max(jax.process_count(), 1)
+
+    def batch_at(i):
+        k = jax.random.fold_in(jax.random.PRNGKey(23 + rdv.process_id), i)
+        tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
+                                    cfg.vocab_size)
+        return train.globalize_batch(batch_sharding, tokens)
+
+    state = train.CheckpointState.restore_or_init(
+        rdv, {"params": params, "opt_state": opt_state, "step": 0},
+        subdir="moe", mesh=mesh)
+    start_step = int(state.value["step"])
+    params = state.value["params"]
+    opt_state = state.value["opt_state"]
+    if start_step > 0:
+        print(f"resumed at step {start_step} (width "
+              f"{rdv.elastic_replicas})", flush=True)
+
+    def save(i, wait=False):
+        state.save({"params": params, "opt_state": opt_state, "step": i},
+                   wait=wait)
+
+    shutdown = train.GracefulShutdown().install()
+    profiler = train.StepProfiler()
+    loss = None
+    t_start = None
+    for i in range(start_step, steps):
+        profiler.step_start(i)
+        params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
+        if i == start_step:
+            jax.block_until_ready(loss)
+            t_start = time.time()
+            if start_step > 0:
+                print(f"step {i+1}/{steps} loss {float(loss):.4f} "
+                      f"(first after resume)", flush=True)
+        profiler.step_end(i, sync=loss)
+        if shutdown.requested:
+            shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
+        if (i + 1) % ckpt_every == 0 or i == steps - 1:
+            print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
+            save(i + 1)
+    profiler.close()
+    jax.block_until_ready(loss)
+    state.finalize()
+    dt = max(time.time() - (t_start or time.time()), 1e-9)
+    done = max(steps - start_step - 1, 1)
+    print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "
+          f"width={rdv.elastic_replicas} "
+          f"final_loss={float(loss) if loss is not None else -1:.4f}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
