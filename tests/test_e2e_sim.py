@@ -158,6 +158,31 @@ class TestTPUGang:
         }, tpu_chips=4)
         assert wait_for(lambda: phase(cs) == TrainingJobPhase.SUCCEEDED, 10), phase(cs)
 
+    def test_gap_filled_member_of_running_gang_places(self, cluster):
+        """A recreated single member of an otherwise-RUNNING gang must still
+        schedule (sim counts gang membership over all live pods, not just
+        pending ones -- else gap-fill wedges forever)."""
+        cs, tc, sim = cluster
+        for i in range(2):
+            sim.add_node(f"tpu-{i}", labels={
+                constants.GKE_TPU_ACCELERATOR_SELECTOR:
+                    "tpu-v5-lite-podslice",
+                constants.GKE_TPU_TOPOLOGY_SELECTOR: "2x4",
+            }, tpu_chips=4)
+        job = sim_job(replicas=2, run_seconds="30")
+        job.spec.replica_specs["trainer"].tpu = TPUSpec(
+            accelerator="tpu-v5-lite-podslice", topology="2x4")
+        cs.trainingjobs.create(job)
+        assert wait_for(
+            lambda: phase(cs) == TrainingJobPhase.RUNNING, 10), phase(cs)
+        # Delete one member; the controller gap-fills it and the sim
+        # must place the singleton (its sibling keeps running).
+        cs.pods.delete("default", "job-trainer-1")
+        assert wait_for(
+            lambda: (p := {x.name: x for x in cs.pods.list("default")})
+            and "job-trainer-1" in p
+            and bool(p["job-trainer-1"].spec.node_name), 10)
+
 
 class TestElasticE2E:
     def test_shrink_on_node_loss_then_reexpand(self):

@@ -28,6 +28,10 @@ POD_ROLE_LABEL = "PodRole"
 # TPU extensions
 SLICE_ID_LABEL = "TPUSliceID"
 GANG_LABEL = "TPUGang"
+# Declared member count of the gang: schedulers must not place a gang they
+# have only partially observed (pods of one slice are created over several
+# API calls; placing the visible subset first-come steals its capacity).
+GANG_SIZE_LABEL = "TPUGangSize"
 
 # --- identity env vars injected into every container
 # (reference: constants.go:13-21, pkg/controller/pod.go:600-628) -------------

@@ -155,6 +155,21 @@ class PodReconciler:
         stuck_indices: List[int] = []
         probe_failed = False
 
+        # Capacity loss is evaluated BEFORE any per-pod restart decision:
+        # when a node dies, its pods' kills surface as retryable exit codes
+        # on the SURVIVORS too (peer-loss collective failures exit 143), and
+        # whichever pod the loop visits first would otherwise win -- a
+        # full-width restart stranding a replacement on the dead node for
+        # scale_pending_time instead of an immediate shrink.
+        if spec.edl_policy == EdlPolicy.AUTO:
+            ending = self._maybe_shrink_on_capacity_loss(
+                job, rtype, rt, spec, replicas, pods, replica_pods,
+                node_ready, "node lost capacity")
+            if ending:
+                self._recount_replica_status(
+                    job, rtype, pods_below_width(replica_pods, replicas))
+                return ending
+
         for index, pod_slice in enumerate(pod_slices):
             if not pod_slice:
                 log.info("creating pod %s/%s %s-%d", job.namespace, job.name, rt, index)
@@ -187,20 +202,10 @@ class PodReconciler:
             if cmsg:
                 failed_reasons.append(cmsg)
 
-            if phase == TrainingJobPhase.NODE_FAIL:
-                # Elastic shrink on capacity loss (TPU spot preemption / host
-                # failure): instead of blocking on a full-width restart, drop
-                # the group to the surviving replicas and re-rendezvous.  New
-                # semantics -- the reference declares Min/MaxReplicas but never
-                # resizes (SURVEY.md §2.6); does not consume restart_limit.
-                ending = self._maybe_shrink_on_capacity_loss(
-                    job, rtype, rt, spec, replicas, pods, replica_pods,
-                    node_ready, cmsg)
-                if ending:
-                    self._recount_replica_status(
-                        job, rtype, pods_below_width(replica_pods, replicas))
-                    return ending
-
+            # NODE_FAIL under EdlPolicy.AUTO was already resolved by the
+            # pre-loop _maybe_shrink_on_capacity_loss (same snapshot, same
+            # sync); a NODE_FAIL reaching here is the non-elastic restart/
+            # fail path below.
             if is_restart:
                 limit = spec.restart_limit
                 if limit is None or job.status.restart_counts.get(rtype, 0) < limit:
@@ -899,6 +904,7 @@ class PodReconciler:
         pod.metadata.labels[constants.SLICE_ID_LABEL] = str(slice_id)
         pod.metadata.labels[constants.GANG_LABEL] = gen_general_name(
             job.name, rt, f"slice{slice_id}")
+        pod.metadata.labels[constants.GANG_SIZE_LABEL] = str(shape.hosts)
 
     @staticmethod
     def _match_replica_key(job: TPUTrainingJob, rt_lower: str) -> Optional[str]:

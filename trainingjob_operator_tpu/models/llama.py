@@ -54,16 +54,23 @@ class LlamaConfig:
 #: path-pattern -> PartitionSpec args (parallel/sharding.py Rules).
 #: fsdp shards the big dim; tp shards heads/ffn/vocab.
 #: Megatron-style layout; stacked layer params carry a leading scan axis that
-#: stays unsharded (None) -- fsdp/tp apply to the matmul dims.
-SHARDING_RULES = [
-    (r"tok_embed", ("tp", "fsdp")),
-    (r"lm_head", ("fsdp", "tp")),
-    (r"attn/w[qkv]$", (None, "fsdp", "tp")),
-    (r"attn/wo$", (None, "tp", "fsdp")),
-    (r"mlp/w_(gate|up)$", (None, "fsdp", "tp")),
-    (r"mlp/w_down$", (None, "tp", "fsdp")),
-    (r"norm", (None,)),
-]
+#: stays unsharded (None) by default, or rides ``pp`` under pipeline
+#: parallelism (each stage owns its contiguous layer block).
+def sharding_rules(pipeline: bool = False):
+    lead = "pp" if pipeline else None
+    return [
+        (r"tok_embed", ("tp", "fsdp")),
+        (r"lm_head", ("fsdp", "tp")),
+        (r"attn/w[qkv]$", (lead, "fsdp", "tp")),
+        (r"attn/wo$", (lead, "tp", "fsdp")),
+        (r"mlp/w_(gate|up)$", (lead, "fsdp", "tp")),
+        (r"mlp/w_down$", (lead, "tp", "fsdp")),
+        (r"layers/.*norm", (lead, None)),
+        (r"norm", (None,)),
+    ]
+
+
+SHARDING_RULES = sharding_rules()
 
 
 def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
@@ -128,12 +135,21 @@ def _rope(x, positions, theta):
 
 
 def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
-            mesh=None, sequence_parallel: bool = False, remat: bool = False):
+            mesh=None, sequence_parallel: bool = False, remat: bool = False,
+            n_microbatches: int = 4):
     """Logits for tokens [B, T] -> [B, T, vocab].
 
     With ``sequence_parallel`` (and a mesh with an ``sp`` axis), attention runs
     as ring attention over the sequence shards; positions account for the
     global offset of each shard.
+
+    With a ``pp`` axis (size > 1) on the mesh, the layer stack runs as a
+    GPipe pipeline (parallel/pipeline.py): stages own contiguous layer
+    blocks, activations rotate via ppermute, and ``n_microbatches`` (must
+    divide the batch) amortizes the bubble.  Attention inside the pipeline
+    takes the pure-XLA path (a Pallas custom call is opaque to the auto-axis
+    GSPMD partitioning); embed/head stay outside the pipeline, replicated
+    over pp.
 
     ``remat`` wraps each layer in ``jax.checkpoint``: the backward recomputes
     the layer's activations instead of saving them -- the standard HBM-for-
@@ -149,18 +165,30 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     h = params["tok_embed"].astype(compute)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
-    group = c.n_heads // c.n_kv_heads
+    pipelined = (mesh is not None and "pp" in mesh.axis_names
+                 and mesh.shape["pp"] > 1)
 
     def attn(h, layer):
+        # Shapes from h, not the captured globals: inside the pp pipeline
+        # the leading dim is a MICROBATCH of the global batch.
+        Bh = h.shape[0]
         q = (h @ layer["attn"]["wq"].astype(compute))
         k = (h @ layer["attn"]["wk"].astype(compute))
         v = (h @ layer["attn"]["wv"].astype(compute))
-        q = q.reshape(B, T, c.n_heads, c.head_dim)
-        k = k.reshape(B, T, c.n_kv_heads, c.head_dim)
-        v = v.reshape(B, T, c.n_kv_heads, c.head_dim)
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
-        if sequence_parallel and mesh is not None and "sp" in mesh.axis_names:
+        q = q.reshape(Bh, T, c.n_heads, c.head_dim)
+        k = k.reshape(Bh, T, c.n_kv_heads, c.head_dim)
+        v = v.reshape(Bh, T, c.n_kv_heads, c.head_dim)
+        pos = positions[:Bh]
+        q = _rope(q, pos, c.rope_theta)
+        k = _rope(k, pos, c.rope_theta)
+        if pipelined:
+            # Inside the pp shard_map body (auto axes): plain-XLA attention,
+            # partitioned by GSPMD over dp/fsdp/tp like any other einsum.
+            from trainingjob_operator_tpu.ops.flash_attention import (
+                attention_xla)
+
+            o = attention_xla(q, k, v, causal=True)
+        elif sequence_parallel and mesh is not None and "sp" in mesh.axis_names:
             # Ring attention is GQA-aware: the narrow kv blocks travel the
             # ring un-repeated (ICI bytes scale with n_kv_heads).
             from trainingjob_operator_tpu.parallel.ringattention import (
@@ -180,7 +208,7 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
                 o = flash_attention_sharded(q, k, v, mesh, causal=True)
             else:
                 o = flash_attention(q, k, v, causal=True)
-        o = o.reshape(B, T, c.dim)
+        o = o.reshape(Bh, T, c.dim)
         return o @ layer["attn"]["wo"].astype(compute)
 
     def mlp(h, layer):
@@ -191,13 +219,24 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     def block(h, layer):
         h = h + attn(_rmsnorm(h, layer["attn_norm"], c.norm_eps), layer)
         h = h + mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer)
-        return h, None
+        return h
 
-    # Scan over stacked layers: one compiled block, L iterations -- compile
-    # time O(1) in depth, XLA-friendly (no Python loop unrolling).
     if remat:
         block = jax.checkpoint(block)
-    h, _ = jax.lax.scan(block, h, params["layers"])
+
+    if pipelined:
+        from trainingjob_operator_tpu.parallel.pipeline import gpipe
+
+        # Largest divisor of B up to the requested count: microbatches must
+        # tile the batch exactly (static shapes).
+        m = max(d for d in range(1, min(n_microbatches, B) + 1)
+                if B % d == 0)
+        h = gpipe(block, params["layers"], h, mesh, n_microbatches=m)
+    else:
+        # Scan over stacked layers: one compiled block, L iterations --
+        # compile time O(1) in depth, XLA-friendly (no Python unrolling).
+        h, _ = jax.lax.scan(lambda hh, layer: (block(hh, layer), None),
+                            h, params["layers"])
     h = _rmsnorm(h, params["final_norm"], c.norm_eps)
     logits = h @ params["lm_head"].astype(compute)
     return logits.astype(jnp.float32)

@@ -154,7 +154,7 @@ def _dispatch_combine(probs, k: int, capacity: int):
         pos = (jnp.cumsum(onehot, axis=1) - onehot
                + used[:, None, :]) * onehot                # [B,T,E]
         within = (pos < capacity) * onehot
-        slot = jax.nn.one_hot(pos.sum(-1), capacity,
+        slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
                               dtype=probs.dtype)           # [B,T,C]
         assign = within[..., None] * slot[:, :, None, :]   # [B,T,E,C]
         dispatch = dispatch + assign

@@ -429,6 +429,24 @@ def flash_attention(q, k, v, *, causal: bool = True,
     return out.transpose(0, 2, 1, 3)
 
 
+def attention_xla(q, k, v, *, causal: bool = True,
+                  scale: Optional[float] = None):
+    """Identical-math attention on the pure-XLA path, [B, T, H, D].
+
+    For contexts where a Pallas custom call cannot appear: inside shard_map
+    bodies with ``auto`` axes (the pp pipeline -- GSPMD cannot partition an
+    opaque custom call over the auto axes, but it partitions these einsums
+    fine).  Differentiable via plain autodiff.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _reference(qt, kt, vt, scale=float(scale), causal=causal)
+    return out.transpose(0, 2, 1, 3)
+
+
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                             scale: Optional[float] = None,
                             block_q: int = 128, block_k: int = 128):

@@ -23,7 +23,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
 
-AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep")
+#: DCN-outermost order: dp (gradient all-reduce) and pp (infrequent
+#: point-to-point stage hand-offs) tolerate the slow link; fsdp/tp/sp/ep are
+#: per-layer ICI collectives.
+AXIS_ORDER = ("dp", "pp", "fsdp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)
@@ -125,6 +128,7 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
 def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
                          sequence_parallel: int = 1,
                          expert_parallel: int = 1,
+                         pipeline_parallel: int = 1,
                          fsdp: bool = True):
     """Derive the standard mesh for this worker's provisioned topology.
 
@@ -136,10 +140,11 @@ def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
     import jax
 
     n = jax.device_count()
-    inner = model_parallel * sequence_parallel * expert_parallel
+    inner = (model_parallel * sequence_parallel * expert_parallel
+             * pipeline_parallel)
     if n % inner != 0:
         raise ValueError(f"{n} devices not divisible by "
-                         f"tp*sp*ep={inner}")
+                         f"tp*sp*ep*pp={inner}")
     data = n // inner
     dp = max(rdv.num_slices, 1)
     if data % dp != 0:
@@ -147,12 +152,14 @@ def mesh_from_rendezvous(rdv: Rendezvous, model_parallel: int = 1,
         # ride DCN instead of ICI, the exact layout this module forbids.
         raise ValueError(
             f"data axis {data} not divisible by num_slices={dp}; choose "
-            f"tp/sp/ep so each slice holds an equal data shard")
+            f"tp/sp/ep/pp so each slice holds an equal data shard")
     fsdp_size = data // dp
     if fsdp:
-        spec = MeshSpec.of(dp=dp, fsdp=fsdp_size, tp=model_parallel,
-                           sp=sequence_parallel, ep=expert_parallel)
+        spec = MeshSpec.of(dp=dp, pp=pipeline_parallel, fsdp=fsdp_size,
+                           tp=model_parallel, sp=sequence_parallel,
+                           ep=expert_parallel)
     else:
-        spec = MeshSpec.of(dp=data, tp=model_parallel,
-                           sp=sequence_parallel, ep=expert_parallel)
+        spec = MeshSpec.of(dp=data, pp=pipeline_parallel,
+                           tp=model_parallel, sp=sequence_parallel,
+                           ep=expert_parallel)
     return make_mesh(spec)

@@ -1,0 +1,120 @@
+"""Pipeline parallelism over the ``pp`` mesh axis: GPipe on GSPMD terms.
+
+The reference has no parallelism strategies of its own (SURVEY.md §0 -- the
+operator provisions pods; the in-container framework decides).  The TPU
+build owns the workload layer, so pipeline parallelism is implemented here
+the XLA-native way, as pure GSPMD (no shard_map, no manual collectives):
+
+- The layer stack (already stacked [L, ...] for ``lax.scan``) is reshaped to
+  [S, L/S, ...] -- a real STAGE array dimension, sharded on ``pp`` via
+  ``with_sharding_constraint``.  Each pp shard owns one stage's contiguous
+  layer block.
+- The in-flight activations are one array [S, mb, ...], stage dim sharded on
+  ``pp``.  Each tick ``jax.vmap``s the stage body over the stage dim (every
+  stage's compute lands on its own pp shard), then ``jnp.roll``s the state
+  one slot along the stage dim -- which GSPMD lowers to a collective-permute
+  on the ``pp`` axis, the stage hand-off.
+- A static ``lax.scan`` over ``M + S - 1`` ticks implements the GPipe
+  schedule; the bubble (S - 1 idle ticks) amortizes with microbatch count M.
+
+Because everything is ordinary sharded XLA, the stage body composes with
+dp/fsdp/tp exactly like the dense path -- GSPMD partitions the microbatch
+over the data axes and the per-stage weights over fsdp/tp with the same
+rules as unpipelined layers.  (An earlier shard_map-manual-over-pp
+formulation tripped an XLA partitioner check-failure when stage weights
+were also fsdp/tp-sharded; the GSPMD form avoids manual/auto mixing
+entirely.)  Attention inside the stage body still takes the pure-XLA path:
+a Pallas custom call is opaque to GSPMD's vmapped-stage partitioning.
+
+DCN note: stage hand-offs are point-to-point and once per tick, so ``pp``
+is the one compute axis besides ``dp`` that tolerates crossing slices
+(scaling-book layout: dp/pp on DCN, fsdp/tp/sp/ep on ICI).
+
+Everything is static-shape and differentiable (scan + roll + one-hot
+selects), so ``jax.grad`` through the pipeline just works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
+          n_microbatches: int, axis: str = "pp"):
+    """Apply a stacked layer pytree to ``h`` [B, ...] as a ``pp``-stage
+    pipeline; numerically equivalent to scanning ``block_fn`` over the
+    stacked layers.
+
+    ``block_fn(h, layer) -> h`` applies ONE layer.  ``stacked_layers``
+    leaves have leading dim L (divisible by the pp size); ``h``'s leading
+    batch dim must be divisible by ``n_microbatches``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from trainingjob_operator_tpu.parallel.collectives import require_axis
+
+    S = require_axis(mesh, axis)
+    if S == 1:
+        def one(hh, layer):
+            return block_fn(hh, layer), None
+
+        return jax.lax.scan(one, h, stacked_layers)[0]
+
+    L = int(jax.tree.leaves(stacked_layers)[0].shape[0])
+    if L % S != 0:
+        raise ValueError(f"{L} layers not divisible by pp={S}")
+    M = n_microbatches
+    B = h.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches={M}")
+    mb = B // M
+
+    def stage_shard(x):
+        # [L, ...] -> [S, L/S, ...], stage dim on pp.
+        y = x.reshape(S, L // S, *x.shape[1:])
+        return jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(axis)))
+
+    layers_staged = jax.tree.map(stage_shard, stacked_layers)
+
+    def stage_apply(stage_layers, hh):
+        def one(acc, layer):
+            return block_fn(acc, layer), None
+
+        return jax.lax.scan(one, hh, stage_layers)[0]
+
+    pin = NamedSharding(mesh, P(axis))
+
+    x_mb = h.reshape(M, mb, *h.shape[1:])
+
+    def tick(carry, t):
+        state, outs = carry
+        # Inject microbatch t into stage slot 0 (clamped reads past M feed
+        # garbage that is never stored).
+        t_in = jnp.clip(t, 0, M - 1)
+        inj = jax.lax.dynamic_index_in_dim(x_mb, t_in, 0, keepdims=False)
+        state = state.at[0].set(jnp.where(t < M, inj, state[0]))
+        # Every stage advances its resident microbatch by one stage block;
+        # vmap over the stage dim keeps each stage's compute on its shard.
+        state = jax.vmap(stage_apply)(layers_staged, state)
+        state = jax.lax.with_sharding_constraint(state, pin)
+        # Stage S-1 just finished microbatch t - (S - 1).
+        t_out = t - (S - 1)
+        valid = jnp.logical_and(t_out >= 0, t_out < M)
+        stored = jax.lax.dynamic_update_index_in_dim(
+            outs, state[-1], jnp.clip(t_out, 0, M - 1), 0)
+        outs = jnp.where(valid, stored, outs)
+        # Hand off: stage s's output becomes stage s+1's input.  A roll
+        # along a pp-sharded dim lowers to a collective-permute on pp.
+        state = jnp.roll(state, 1, axis=0)
+        state = jax.lax.with_sharding_constraint(state, pin)
+        return (state, outs), None
+
+    state0 = jax.lax.with_sharding_constraint(
+        jnp.zeros((S, mb, *h.shape[1:]), h.dtype), pin)
+    outs0 = jnp.zeros_like(x_mb)
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
+                                jnp.arange(M + S - 1))
+    return outs.reshape(B, *h.shape[1:])

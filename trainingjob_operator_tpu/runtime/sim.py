@@ -147,7 +147,26 @@ class SimRuntime(PodStateRuntime):
         for pod in pending:
             gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
             gangs.setdefault((pod.namespace, gang), []).append(pod)
-        for gang_pods in gangs.values():
+        # Gang membership counts ALL live pods carrying the label, not just
+        # pending ones: a gap-filled single member of an otherwise-running
+        # gang must still be placeable (its siblings already hold nodes).
+        gang_totals: Dict[tuple, int] = {}
+        for pod in pods:
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            label = pod.metadata.labels.get(constants.GANG_LABEL)
+            if label:
+                key = (pod.namespace, label)
+                gang_totals[key] = gang_totals.get(key, 0) + 1
+        for key, gang_pods in gangs.items():
+            # Never place a partially OBSERVED gang: the controller creates
+            # a slice's pods over several API calls, and placing the
+            # visible subset would steal capacity the full gang needs.
+            declared = gang_pods[0].metadata.labels.get(
+                constants.GANG_SIZE_LABEL)
+            if (declared and declared.isdigit()
+                    and gang_totals.get(key, len(gang_pods)) < int(declared)):
+                continue
             self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
 
         # Walk running/scheduled pods through their lifecycle.

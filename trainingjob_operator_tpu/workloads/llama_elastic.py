@@ -48,13 +48,15 @@ def main() -> int:
            else llama.LlamaConfig.tiny())
     tp = int(os.environ.get("LLAMA_TP", "1"))
     sp = int(os.environ.get("LLAMA_SP", "1"))
+    pp = int(os.environ.get("LLAMA_PP", "1"))
     steps = int(os.environ.get("LLAMA_STEPS", "20"))
     global_batch = int(os.environ.get("LLAMA_BATCH", "8"))
     seq = int(os.environ.get("LLAMA_SEQ", "128"))
     lr = float(os.environ.get("LLAMA_LR", "3e-4"))
     ckpt_every = int(os.environ.get("LLAMA_CKPT_EVERY", "10"))
 
-    mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp)
+    mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp,
+                                pipeline_parallel=pp)
     use_sp = sp > 1
     print(f"elastic width {rdv.elastic_replicas}, mesh "
           f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, "
@@ -66,7 +68,7 @@ def main() -> int:
     global_batch = train.round_global_batch(global_batch, n_data)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    params = shard_pytree(params, llama.SHARDING_RULES, mesh)
+    params = shard_pytree(params, llama.sharding_rules(pipeline=pp > 1), mesh)
     tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = tx.init(params)
     batch_sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=use_sp))
@@ -104,36 +106,10 @@ def main() -> int:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
-    def save(i, wait=False):
-        # Collective: every process calls save; the write is sharded and
-        # asynchronous (the step loop does not block on I/O).
-        state.save({"params": params, "opt_state": opt_state, "step": i},
-                   wait=wait)
-
-    shutdown = train.GracefulShutdown().install()
-    profiler = train.StepProfiler()
-    loss = None
-    t_start = None
-    for i in range(start_step, steps):
-        profiler.step_start(i)
-        params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
-        if i == start_step:
-            jax.block_until_ready(loss)
-            t_start = time.time()
-            if start_step > 0:
-                # First completed step at the new width: the elastic-recovery
-                # endpoint (bench_recovery_full keys on a step > resume step).
-                print(f"step {i+1}/{steps} loss {float(loss):.4f} "
-                      f"(first after resume)", flush=True)
-        profiler.step_end(i, sync=loss)
-        if shutdown.requested:
-            shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
-        if (i + 1) % ckpt_every == 0 or i == steps - 1:
-            print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
-            save(i + 1)
-    profiler.close()
-    jax.block_until_ready(loss)
-    state.finalize()  # commit any in-flight background save before exit
+    params, opt_state, loss, t_start = train.run_elastic_loop(
+        step_fn=step_fn, batch_at=batch_at, state=state, params=params,
+        opt_state=opt_state, steps=steps, start_step=start_step,
+        ckpt_every=ckpt_every)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

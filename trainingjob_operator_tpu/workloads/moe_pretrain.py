@@ -90,32 +90,10 @@ def main() -> int:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
-    def save(i, wait=False):
-        state.save({"params": params, "opt_state": opt_state, "step": i},
-                   wait=wait)
-
-    shutdown = train.GracefulShutdown().install()
-    profiler = train.StepProfiler()
-    loss = None
-    t_start = None
-    for i in range(start_step, steps):
-        profiler.step_start(i)
-        params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
-        if i == start_step:
-            jax.block_until_ready(loss)
-            t_start = time.time()
-            if start_step > 0:
-                print(f"step {i+1}/{steps} loss {float(loss):.4f} "
-                      f"(first after resume)", flush=True)
-        profiler.step_end(i, sync=loss)
-        if shutdown.requested:
-            shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
-        if (i + 1) % ckpt_every == 0 or i == steps - 1:
-            print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
-            save(i + 1)
-    profiler.close()
-    jax.block_until_ready(loss)
-    state.finalize()
+    params, opt_state, loss, t_start = train.run_elastic_loop(
+        step_fn=step_fn, batch_at=batch_at, state=state, params=params,
+        opt_state=opt_state, steps=steps, start_step=start_step,
+        ckpt_every=ckpt_every)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

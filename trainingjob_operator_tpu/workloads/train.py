@@ -182,11 +182,17 @@ class GracefulShutdown:
 
     def checkpoint_and_exit(self, save: Callable[[], None]) -> None:
         """Call from the step loop once ``requested`` is observed."""
+        import os as _os
+
         self._surfaced = True
         save()
         self._save_done = True
         print("preemption checkpoint committed; exiting 143", flush=True)
-        raise SystemExit(self.EXIT_CODE)
+        # os._exit, NOT SystemExit: normal interpreter teardown joins the
+        # jax.distributed / orbax service threads, which can block forever
+        # when a peer is already dead -- burning the kubelet grace and
+        # downgrading the exit to SIGKILL.
+        _os._exit(self.EXIT_CODE)
 
 
 class StepProfiler:
@@ -245,6 +251,84 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
+
+
+class peer_loss_guard:
+    """Context manager around distributed workload code: any exception in a
+    multi-process job exits 143 via ``os._exit`` (restart-worthy, and no
+    interpreter teardown to hang on dead-peer service threads).  Covers the
+    collectives hiding outside the step function too -- orbax's sharded
+    save/restore does its own allgathers and dies just as loudly when a
+    peer is preempted mid-save."""
+
+    def __init__(self, shutdown: Any = None) -> None:
+        self._shutdown = shutdown
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None or exc_type in (KeyboardInterrupt, SystemExit):
+            return False
+        import os as _os
+
+        import jax
+
+        if jax.process_count() > 1 or (self._shutdown is not None
+                                       and self._shutdown.requested):
+            print(f"distributed section failed ({exc_type.__name__}: "
+                  f"{str(exc)[:300]}); exiting 143 for operator restart",
+                  flush=True)
+            _os._exit(GracefulShutdown.EXIT_CODE)
+        return False
+
+
+def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
+                     state: "CheckpointState", params: Any, opt_state: Any,
+                     steps: int, start_step: int, ckpt_every: int):
+    """The shared elastic train loop (llama_elastic / moe_pretrain):
+    checkpoint every ``ckpt_every`` steps, print the first post-resume step
+    (the elastic-recovery endpoint the bench keys on), honor the SIGTERM
+    preemption checkpoint, and run EVERYTHING -- including the orbax save
+    collectives and the final ``finalize()`` commit barrier -- under
+    ``peer_loss_guard`` so a peer preemption anywhere in the loop exits 143
+    (restart-worthy), never a crash.
+
+    Returns ``(params, opt_state, loss, t_start)`` where ``t_start`` is the
+    wall time after the first completed step (for throughput accounting).
+    """
+    import jax
+
+    shutdown = GracefulShutdown().install()
+    profiler = StepProfiler()
+    loss = None
+    t_start = None
+    with peer_loss_guard(shutdown=shutdown):
+        for i in range(start_step, steps):
+            profiler.step_start(i)
+            params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
+            if i == start_step:
+                jax.block_until_ready(loss)
+                t_start = time.time()
+                if start_step > 0:
+                    print(f"step {i+1}/{steps} loss {float(loss):.4f} "
+                          f"(first after resume)", flush=True)
+            profiler.step_end(i, sync=loss)
+
+            def save(step, wait=False):
+                state.save({"params": params, "opt_state": opt_state,
+                            "step": step}, wait=wait)
+
+            if shutdown.requested:
+                shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
+            if (i + 1) % ckpt_every == 0 or i == steps - 1:
+                print(f"step {i+1}/{steps} loss {float(loss):.4f}",
+                      flush=True)
+                save(i + 1)
+        profiler.close()
+        jax.block_until_ready(loss)
+        state.finalize()  # commit any in-flight background save before exit
+    return params, opt_state, loss, t_start
 
 
 def round_global_batch(global_batch: int, shards: int) -> int:
