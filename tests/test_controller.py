@@ -1271,3 +1271,82 @@ class TestGangAtomicity:
         spec = RS(replicas=8, min_replicas=3,
                   tpu=TPUSpec(topology="4x4", slice_count=2))
         assert tc._min_width(spec) == 4  # 3 hosts is not a runnable unit
+
+    def test_gang_release_backs_off(self):
+        # A persistent one-host-short cluster must not delete/recreate the
+        # slice at scale_pending_time period forever: releases back off
+        # exponentially and reset only when the group runs at full width.
+        cs, tc = make_env()
+        tc.options.scale_pending_time = 0.05
+        for i in range(3):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=4,
+                       tpu=TPUSpec(accelerator="tpu-v5-lite-podslice",
+                                   topology="4x4"),
+                       restart_policy=RestartPolicy.ON_NODE_FAIL)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+
+        def strand_pod_3():
+            for i in range(3):
+                set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+            pod = cs.pods.get("default", "job-trainer-3")
+            pod.status.conditions = [Condition(
+                type="PodScheduled", status=ConditionStatus.FALSE,
+                reason="Unschedulable", message="0/3 nodes available")]
+            cs.pods.update(pod)
+
+        strand_pod_3()
+        time.sleep(0.1)
+        sync(tc, job)
+        assert pods_of(cs) == []  # release 1 fired
+        key = "default/job/trainer"
+        last, attempts = tc._gang_release_backoff[key]
+        assert attempts == 1
+        # An immediate retry is suppressed (inside the backoff window).
+        assert tc._release_partial_gangs(
+            get_job(cs), "trainer", "trainer", 4, [3], [], last + 0.01) is None
+        # Once the group runs at full width, the backoff resets.
+        sync(tc, job)  # recreate all 4
+        for i in range(4):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i % 3}")
+        sync(tc, job)
+        assert key not in tc._gang_release_backoff
+
+
+class TestControllerRestart:
+    def test_new_controller_resumes_mid_scaling_drain(self):
+        """Controller crash/restart mid-elastic-drain: a fresh controller
+        (empty expectations, no in-memory state) must pick the job up from
+        its status and finish the resize -- the CR carries the contract."""
+        cs, tc = make_env()
+        for i in range(3):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=3, min_replicas=1, max_replicas=3,
+                       edl_policy="Auto",
+                       restart_policy=RestartPolicy.ON_NODE_FAIL,
+                       restart_scope=RestartScope.REPLICA)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        for i in range(3):
+            set_pod_running(cs, f"job-trainer-{i}", node=f"node-{i}")
+        sync(tc, job)
+        node = cs.nodes.get_node("node-2")
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        cs.nodes.update(node)
+        sync(tc, job)  # shrink decided; pods deleted; drain in flight
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.SCALING
+        assert got.status.elastic_replicas == {"trainer": 2}
+
+        # "Crash": a brand-new controller instance over the same cluster.
+        tc2 = TrainingJobController(cs, options=OperatorOptions())
+        sync(tc2, job, n=3)
+        pods = pods_of(cs)
+        assert [p.name for p in pods] == ["job-trainer-0", "job-trainer-1"]
+        env = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        assert env[constants.NUM_PROCESSES_ENV] == "2"
+        for p in pods:
+            set_pod_running(cs, p.name, node="node-0")
+        sync(tc2, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING

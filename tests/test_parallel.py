@@ -406,3 +406,54 @@ class TestPipelineParallel:
             losses.append(float(l))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
+
+
+class TestPipelineEdgeCases:
+    def test_pp1_degenerates_to_scan(self):
+        import jax
+        import jax.numpy as jnp
+
+        from trainingjob_operator_tpu.parallel.pipeline import gpipe
+
+        mesh = make_mesh(MeshSpec.of(dp=8))  # no pp axis at size > 1
+        with pytest.raises(ValueError, match="no 'pp'"):
+            gpipe(lambda h, l: h, jnp.zeros((4, 2, 2)),
+                  jnp.zeros((4, 2)), mesh, 2)
+
+    def test_layers_must_divide_stages(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from trainingjob_operator_tpu.parallel.pipeline import gpipe
+
+        devs = np.array(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devs, ("dp", "pp"))
+        with pytest.raises(ValueError, match="not divisible by pp"):
+            gpipe(lambda h, l: h, jnp.zeros((6, 2, 2)),
+                  jnp.zeros((4, 2)), mesh, 2)
+        with pytest.raises(ValueError, match="microbatches"):
+            gpipe(lambda h, l: h, jnp.zeros((4, 2, 2)),
+                  jnp.zeros((5, 2)), mesh, 2)
+
+    def test_single_microbatch(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from trainingjob_operator_tpu.parallel.pipeline import gpipe
+
+        devs = np.array(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devs, ("dp", "pp"))
+        L, B, D = 4, 2, 8
+        layers = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.2
+        h = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+        def block(hh, w):
+            return jnp.tanh(hh @ w)
+
+        ref = h
+        for i in range(L):
+            ref = block(ref, layers[i])
+        out = jax.jit(lambda ls, x: gpipe(block, ls, x, mesh, 1))(layers, h)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -188,3 +188,27 @@ class TestMoEWorkload:
         assert moe_pretrain.main() == 0
         out = capsys.readouterr().out
         assert "resumed at step 4" in out
+
+
+class TestPeerLossGuard:
+    def test_classifier(self):
+        from trainingjob_operator_tpu.workloads import train
+
+        assert train.looks_like_peer_loss(ValueError(
+            "UNKNOWN: Gloo AllGather failed: Read error [127.0.0.1]:25483: "
+            "Connection reset by peer"))
+        assert train.looks_like_peer_loss(RuntimeError(
+            "Coordination service agent heartbeat timeout"))
+        assert not train.looks_like_peer_loss(ValueError(
+            "cannot reshape array of shape (2, 32) into (3, 3)"))
+        assert not train.looks_like_peer_loss(KeyError("params"))
+
+    def test_local_bug_propagates(self):
+        # A deterministic local error must NOT be converted to exit 143.
+        import pytest as _pytest
+
+        from trainingjob_operator_tpu.workloads import train
+
+        with _pytest.raises(ValueError, match="reshape"):
+            with train.peer_loss_guard():
+                raise ValueError("cannot reshape array")

@@ -407,6 +407,7 @@ class PodReconciler:
         if not released:
             return None
         backoffs[key] = (now, min(attempts + 1, 10))
+        self.metrics.inc("trainingjob_gang_releases_total")
         msg = (f"slice(s) {released} of {rt} partially scheduled for "
                f">{self.options.scale_pending_time:.0f}s; releasing for "
                f"atomic retry (attempt {attempts + 1})")

@@ -71,11 +71,19 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
         raise ValueError(f"batch {B} not divisible by microbatches={M}")
     mb = B // M
 
+    def pp_spec(ndim: int) -> P:
+        # Pin ONLY the stage dim; every other dim stays UNCONSTRAINED so
+        # GSPMD keeps the weights' fsdp/tp sharding and the activations'
+        # dp batch sharding.  (A short PartitionSpec would mark the
+        # remaining dims REPLICATED -- silently erasing FSDP and
+        # duplicating dp compute.)
+        return P(axis, *([P.UNCONSTRAINED] * (ndim - 1)))
+
     def stage_shard(x):
         # [L, ...] -> [S, L/S, ...], stage dim on pp.
         y = x.reshape(S, L // S, *x.shape[1:])
         return jax.lax.with_sharding_constraint(
-            y, NamedSharding(mesh, P(axis)))
+            y, NamedSharding(mesh, pp_spec(y.ndim)))
 
     layers_staged = jax.tree.map(stage_shard, stacked_layers)
 
@@ -85,7 +93,9 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
 
         return jax.lax.scan(one, hh, stage_layers)[0]
 
-    pin = NamedSharding(mesh, P(axis))
+    def pin(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, pp_spec(x.ndim)))
 
     x_mb = h.reshape(M, mb, *h.shape[1:])
 
@@ -99,7 +109,7 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
         # Every stage advances its resident microbatch by one stage block;
         # vmap over the stage dim keeps each stage's compute on its shard.
         state = jax.vmap(stage_apply)(layers_staged, state)
-        state = jax.lax.with_sharding_constraint(state, pin)
+        state = pin(state)
         # Stage S-1 just finished microbatch t - (S - 1).
         t_out = t - (S - 1)
         valid = jnp.logical_and(t_out >= 0, t_out < M)
@@ -109,11 +119,10 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
         # Hand off: stage s's output becomes stage s+1's input.  A roll
         # along a pp-sharded dim lowers to a collective-permute on pp.
         state = jnp.roll(state, 1, axis=0)
-        state = jax.lax.with_sharding_constraint(state, pin)
+        state = pin(state)
         return (state, outs), None
 
-    state0 = jax.lax.with_sharding_constraint(
-        jnp.zeros((S, mb, *h.shape[1:]), h.dtype), pin)
+    state0 = pin(jnp.zeros((S, mb, *h.shape[1:]), h.dtype))
     outs0 = jnp.zeros_like(x_mb)
     (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
                                 jnp.arange(M + S - 1))

@@ -253,13 +253,32 @@ class StepProfiler:
             self._tracing = False
 
 
+#: Substrings identifying transport/collective failures caused by a LOST
+#: PEER (Gloo/gRPC/coordination-service surfaces); a deterministic local bug
+#: (shape error, checkpoint mismatch) matches none of these and must crash
+#: normally so exit-code policy can mark the job Failed instead of
+#: restart-looping it forever.
+_PEER_LOSS_MARKERS = (
+    "gloo", "grpc", "connection reset", "connection refused", "broken pipe",
+    "socket closed", "unavailable", "deadline exceeded", "peer",
+    "coordination service", "barrier", "heartbeat", "disconnect",
+)
+
+
+def looks_like_peer_loss(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(marker in text for marker in _PEER_LOSS_MARKERS)
+
+
 class peer_loss_guard:
-    """Context manager around distributed workload code: any exception in a
-    multi-process job exits 143 via ``os._exit`` (restart-worthy, and no
-    interpreter teardown to hang on dead-peer service threads).  Covers the
-    collectives hiding outside the step function too -- orbax's sharded
-    save/restore does its own allgathers and dies just as loudly when a
-    peer is preempted mid-save."""
+    """Context manager around distributed workload code: a PEER-LOSS-shaped
+    exception in a multi-process job exits 143 via ``os._exit``
+    (restart-worthy, and no interpreter teardown to hang on dead-peer
+    service threads).  Covers the collectives hiding outside the step
+    function too -- orbax's sharded save/restore does its own allgathers and
+    dies just as loudly when a peer is preempted mid-save.  Exceptions that
+    do not look like transport failures propagate (a deterministic bug must
+    reach the exit-code policy as a failure, not crash-loop as 143)."""
 
     def __init__(self, shutdown: Any = None) -> None:
         self._shutdown = shutdown
@@ -274,8 +293,10 @@ class peer_loss_guard:
 
         import jax
 
-        if jax.process_count() > 1 or (self._shutdown is not None
-                                       and self._shutdown.requested):
+        sigterm_seen = (self._shutdown is not None
+                        and self._shutdown.requested)
+        if sigterm_seen or (jax.process_count() > 1
+                            and looks_like_peer_loss(exc)):
             print(f"distributed section failed ({exc_type.__name__}: "
                   f"{str(exc)[:300]}); exiting 143 for operator restart",
                   flush=True)
