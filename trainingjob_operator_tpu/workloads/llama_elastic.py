@@ -15,7 +15,8 @@ multislice DCN when present.  The global batch is kept constant across widths
 (per-process share rescales), so the loss trajectory is width-independent.
 
 Run: ``python -m trainingjob_operator_tpu.workloads.llama_elastic``.
-Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_STEPS, LLAMA_BATCH
+Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline stages),
+LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
 (global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY.
 """
 
@@ -54,6 +55,7 @@ def main() -> int:
     seq = int(os.environ.get("LLAMA_SEQ", "128"))
     lr = float(os.environ.get("LLAMA_LR", "3e-4"))
     ckpt_every = int(os.environ.get("LLAMA_CKPT_EVERY", "10"))
+    accum = int(os.environ.get("LLAMA_ACCUM", "1"))
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp,
                                 pipeline_parallel=pp)
@@ -65,7 +67,9 @@ def main() -> int:
 
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    global_batch = train.round_global_batch(global_batch, n_data)
+    # The rounded batch must tile BOTH the data shards and the accumulation
+    # microbatches, at every elastic width.
+    global_batch = train.round_global_batch(global_batch, n_data * accum)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     params = shard_pytree(params, llama.sharding_rules(pipeline=pp > 1), mesh)
@@ -75,11 +79,11 @@ def main() -> int:
 
     @jax.jit
     def step_fn(p, o, tokens):
-        def loss(pp):
-            return llama.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh,
+        def loss(pp, tb):
+            return llama.loss_fn(pp, {"tokens": tb}, cfg, mesh=mesh,
                                  sequence_parallel=use_sp)
 
-        l, grads = jax.value_and_grad(loss)(p)
+        l, grads = train.accumulated_value_and_grad(loss, p, tokens, accum)
         updates, o = tx.update(grads, o, p)
         return optax.apply_updates(p, updates), o, l
 

@@ -266,8 +266,18 @@ _PEER_LOSS_MARKERS = (
 
 
 def looks_like_peer_loss(exc: BaseException) -> bool:
-    text = f"{type(exc).__name__}: {exc}".lower()
-    return any(marker in text for marker in _PEER_LOSS_MARKERS)
+    """Match the whole exception CHAIN: orbax/asyncio wrap the underlying
+    gRPC/Gloo error (``raise X from grpc_err``) and the marker often lives
+    only on the cause."""
+    seen = set()
+    node: Optional[BaseException] = exc
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        text = f"{type(node).__name__}: {node}".lower()
+        if any(marker in text for marker in _PEER_LOSS_MARKERS):
+            return True
+        node = node.__cause__ or node.__context__
+    return False
 
 
 class peer_loss_guard:
@@ -350,6 +360,38 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
         jax.block_until_ready(loss)
         state.finalize()  # commit any in-flight background save before exit
     return params, opt_state, loss, t_start
+
+
+def accumulated_value_and_grad(loss_fn: Callable, params: Any, tokens,
+                               accum: int):
+    """``value_and_grad`` over ``accum`` microbatches via ``lax.scan``,
+    averaging losses and gradients -- the standard HBM-for-throughput trade
+    when the global batch exceeds one step's activation memory.  Exactly
+    equals the full-batch gradient for mean-reduced losses (equal microbatch
+    sizes); XLA keeps a single compiled microstep.
+
+    ``loss_fn(params, tokens) -> scalar``; tokens' leading dim must divide
+    by ``accum``."""
+    import jax
+    import jax.numpy as jnp
+
+    if accum <= 1:
+        return jax.value_and_grad(loss_fn)(params, tokens)
+    B = tokens.shape[0]
+    if B % accum != 0:
+        raise ValueError(f"batch {B} not divisible by accum={accum}")
+    micro_batches = tokens.reshape(accum, B // accum, *tokens.shape[1:])
+
+    def micro(carry, tb):
+        acc_l, acc_g = carry
+        l, g = jax.value_and_grad(loss_fn)(params, tb)
+        return (acc_l + l, jax.tree.map(jnp.add, acc_g, g)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss, grads), _ = jax.lax.scan(
+        micro, (jnp.zeros((), jnp.float32), zeros), micro_batches)
+    inv = 1.0 / accum
+    return loss * inv, jax.tree.map(lambda x: x * inv, grads)
 
 
 def round_global_batch(global_batch: int, shards: int) -> int:
