@@ -50,6 +50,49 @@ class TestLlama:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_remat_policies_agree(self):
+        # Every remat policy is a memory/recompute trade, never a math
+        # change: loss identical, grads equal up to bf16 reassociation.
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+        ref = None
+        for pol in [False, True, "full", "attn", "dots", "none"]:
+            l, g = jax.value_and_grad(
+                lambda pp: llama.loss_fn(pp, {"tokens": tokens}, cfg,
+                                         remat=pol))(params)
+            gn = float(jax.tree.reduce(
+                lambda a, b: a + jnp.sum(b.astype(jnp.float32) ** 2), g, 0.0))
+            if ref is None:
+                ref = (float(l), gn)
+            assert abs(float(l) - ref[0]) < 1e-5, pol
+            assert abs(gn - ref[1]) / ref[1] < 2e-2, (pol, gn, ref[1])
+        with pytest.raises(ValueError):
+            llama.forward(params, tokens[:, :-1], cfg, remat="bogus")
+
+    def test_attn_policy_skips_attention_recompute(self):
+        # The trade "attn" sells is structural, not just numeric: the grad
+        # jaxpr must not re-run the quadratic attention forward (its [B, H,
+        # T, T] score tensors appear only in the fwd + bwd kernels, as under
+        # remat "none"), while "full" recomputes them once more per layer.
+        import re
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+
+        def scores(pol):
+            f = jax.grad(lambda pp: llama.loss_fn(
+                pp, {"tokens": tokens}, cfg, remat=pol))
+            txt = str(jax.make_jaxpr(f)(params))
+            return len(re.findall(r"\[2,4,32,32\]", txt))
+
+        none, attn, full = scores("none"), scores("attn"), scores("full")
+        assert attn == none, (attn, none)
+        assert full > attn, (full, attn)
+
     def test_param_count_7b(self):
         # Llama-2-7B ~= 6.74e9 params.
         n = llama.num_params(llama.LlamaConfig.llama2_7b())

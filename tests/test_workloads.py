@@ -213,6 +213,32 @@ class TestPeerLossGuard:
             with train.peer_loss_guard():
                 raise ValueError("cannot reshape array")
 
+    def test_classifier_walks_cause_chain(self):
+        from trainingjob_operator_tpu.workloads import train
+
+        try:
+            try:
+                raise ConnectionError("connection reset by peer")
+            except ConnectionError as inner:
+                raise RuntimeError("save failed for step 40") from inner
+        except RuntimeError as wrapped:
+            assert train.looks_like_peer_loss(wrapped)
+
+    def test_classifier_ignores_implicit_context(self):
+        # A deterministic local bug raised while HANDLING a transport error
+        # must NOT inherit the peer-loss marker via __context__ -- it has to
+        # reach the exit-code policy as a failure, not restart-loop as 143.
+        from trainingjob_operator_tpu.workloads import train
+
+        try:
+            try:
+                raise ConnectionError("connection reset by peer")
+            except ConnectionError:
+                raise ValueError("shape mismatch in restore")  # no `from`
+        except ValueError as bug:
+            assert bug.__context__ is not None
+            assert not train.looks_like_peer_loss(bug)
+
 
 class TestGradAccumulation:
     def test_matches_full_batch_gradient(self):
@@ -252,13 +278,21 @@ class TestGradAccumulation:
             train.accumulated_value_and_grad(
                 lambda p, t: t.sum(), {}, jnp.zeros((5, 2)), accum=2)
 
-    def test_classifier_walks_cause_chain(self):
+    def test_round_global_batch_never_inflates(self):
+        import pytest as _pytest
+
         from trainingjob_operator_tpu.workloads import train
 
-        try:
-            try:
-                raise ConnectionError("connection reset by peer")
-            except ConnectionError as inner:
-                raise RuntimeError("save failed for step 40") from inner
-        except RuntimeError as wrapped:
-            assert train.looks_like_peer_loss(wrapped)
+        assert train.round_global_batch(10, 4) == (8, 1)
+        assert train.round_global_batch(8, 8) == (8, 1)
+        # Accumulation sheds before the batch ever inflates.
+        assert train.round_global_batch(8, 2, accum=8) == (8, 4)
+        assert train.round_global_batch(8, 8, accum=4) == (8, 1)
+        # ...and sheds PAST the bare fit when a smaller accum preserves the
+        # requested batch (elastic contract: batch is width-independent).
+        # Ties prefer the larger accum (smaller microbatch HBM): accum 3
+        # and 2 both keep batch 12 at 2 shards.
+        assert train.round_global_batch(12, 2, accum=4) == (12, 3)
+        assert train.round_global_batch(12, 4, accum=4) == (12, 3)
+        with _pytest.raises(ValueError, match="data shards"):
+            train.round_global_batch(8, 16)

@@ -1,0 +1,106 @@
+"""On-chip perf sweep for the bench config: remat policy x flash block sizes.
+
+Run on the real TPU (no args):  python tools/tune_perf.py
+Prints one line per variant -- ms/step and MFU -- and a final WINNER line.
+The winning settings get baked into bench.py / workloads as defaults.
+
+Uses the same forced-d2h-sync timing as bench.py (jax.block_until_ready does
+not wait on this axon runtime; see tools/repro_block_until_ready.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed_step(cfg, batch, seq, remat, steps=6):
+    import functools
+
+    import jax
+    import optax
+
+    from trainingjob_operator_tpu.models import llama
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, o, tokens):
+        def loss(pp):
+            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat)
+
+        l, grads = jax.value_and_grad(loss)(p)
+        updates, o2 = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o2, l
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+    params, opt, l = step(params, opt, tokens)
+    for _ in range(2):
+        params, opt, l = step(params, opt, tokens)
+    float(l)  # d2h fence
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, l = step(params, opt, tokens)
+    float(l)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    import jax
+
+    from trainingjob_operator_tpu.models import llama
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench import _chip_peak, train_flops_per_step  # noqa: E402
+
+    assert jax.default_backend() == "tpu", "run on the real chip"
+    peak = _chip_peak()
+    cfg = llama.LlamaConfig(vocab_size=32000, dim=2048, n_layers=12,
+                            n_heads=16, n_kv_heads=16, ffn_dim=6144,
+                            max_seq_len=2048)
+    batch, seq = 8, 2048
+    flops = train_flops_per_step(cfg, batch, seq)
+
+    results = []
+
+    def trial(tag, remat, bq, bk):
+        os.environ["TRAININGJOB_FA_BLOCK_Q"] = str(bq)
+        os.environ["TRAININGJOB_FA_BLOCK_K"] = str(bk)
+        try:
+            t = timed_step(cfg, batch, seq, remat)
+        except Exception as exc:
+            print(json.dumps({"tag": tag, "batch": batch,
+                              "error": type(exc).__name__}), flush=True)
+            return
+        mfu = flops / t / peak * 100
+        results.append((tag, batch, t, mfu))
+        print(json.dumps({"tag": tag, "batch": batch,
+                          "step_ms": round(t * 1e3, 1),
+                          "mfu_pct": round(mfu, 1)}), flush=True)
+
+    # 1) remat policy sweep at default blocks
+    for pol in ["full", "attn", "dots", "none"]:
+        trial(f"remat={pol}", pol, 0, 0)
+
+    if not results:
+        sys.exit("all remat trials failed (see error lines above)")
+
+    # 2) block-size sweep on the best-so-far policy
+    best_pol = max(results, key=lambda r: r[3])[0].split("=")[1]
+    for bq, bk in [(256, 128), (512, 128), (256, 256), (512, 512),
+                   (1024, 128), (128, 256)]:
+        trial(f"remat={best_pol},fa={bq}x{bk}", best_pol, bq, bk)
+
+    tag, b, t, mfu = max(results, key=lambda r: r[3])
+    print(json.dumps({"winner": tag, "batch": b,
+                      "step_ms": round(t * 1e3, 1),
+                      "mfu_pct": round(mfu, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

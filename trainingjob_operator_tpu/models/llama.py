@@ -114,6 +114,35 @@ def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
     return params
 
 
+def _remat_wrap(block, remat):
+    """Apply the requested rematerialization policy to a layer block.
+
+    ``remat`` is False/"none" (save everything), True/"full" (save only the
+    layer boundary; backward re-runs the whole layer, +~1/3 model FLOPs), or
+    "attn" (additionally save the flash kernel's residuals, tagged
+    ``attn_out`` in ops/flash_attention.py ``_flash_fwd`` -- the backward
+    skips re-running the quadratic attention forward, the dominant
+    recompute, at ~one extra [B, T, D] tensor + lse per layer of HBM; the
+    ring-attention sp path has no such tag and degrades to "full"
+    behavior).  "dots" saves every no-batch-dim matmul output (cheapest
+    compute, largest HBM; only fits smaller configs).
+    """
+    import jax
+
+    if remat in (False, None, "none"):
+        return block
+    if remat in (True, "full"):
+        return jax.checkpoint(block)
+    policies = {
+        "attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if remat not in policies:
+        raise ValueError(f"unknown remat policy {remat!r}; "
+                         f"expected bool, 'none', 'full', 'attn' or 'dots'")
+    return jax.checkpoint(block, policy=policies[remat])
+
+
 def _rmsnorm(x, scale, eps):
     from trainingjob_operator_tpu.ops import rmsnorm
 
@@ -135,7 +164,7 @@ def _rope(x, positions, theta):
 
 
 def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
-            mesh=None, sequence_parallel: bool = False, remat: bool = False,
+            mesh=None, sequence_parallel: bool = False, remat=False,
             n_microbatches: int = 4):
     """Logits for tokens [B, T] -> [B, T, vocab].
 
@@ -155,6 +184,10 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     the layer's activations instead of saving them -- the standard HBM-for-
     FLOPs trade that lets chip-saturating batch*seq fit in 16 GB v5e HBM
     (saved activations drop from ~6 tensors/layer to the layer boundary).
+    Accepts a policy name instead of a bool: "full" (= True), "attn" (also
+    save the attention output -- backward skips re-running the quadratic
+    attention forward at one extra [B, T, D]/layer of HBM), "dots", "none"
+    (= False); see ``_remat_wrap``.
     """
     import jax
     import jax.numpy as jnp
@@ -209,6 +242,10 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             else:
                 o = flash_attention(q, k, v, causal=True)
         o = o.reshape(Bh, T, c.dim)
+        # The "attn" remat anchors live on the flash kernel's RESIDUALS
+        # (ops/flash_attention.py _flash_fwd): tagging here, downstream of
+        # the custom_vjp call, would not stop the backward from re-running
+        # the attention forward to regenerate them.
         return o @ layer["attn"]["wo"].astype(compute)
 
     def mlp(h, layer):
@@ -221,8 +258,7 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         h = h + mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer)
         return h
 
-    if remat:
-        block = jax.checkpoint(block)
+    block = _remat_wrap(block, remat)
 
     if pipelined:
         from trainingjob_operator_tpu.parallel.pipeline import gpipe
@@ -243,7 +279,7 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
 
 
 def loss_fn(params, batch, config: LlamaConfig, *, mesh=None,
-            sequence_parallel: bool = False, remat: bool = False):
+            sequence_parallel: bool = False, remat=False):
     """Next-token cross-entropy; batch: {"tokens": [B, T+1]}."""
     import optax
 

@@ -199,7 +199,7 @@ def _moe_mlp(h, layer, config: MoEConfig, compute):
 
 
 def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
-            mesh=None, remat: bool = False):
+            mesh=None, remat=False):
     """Logits [B, T, vocab] plus the mean auxiliary load-balancing loss."""
     import jax
     import jax.numpy as jnp
@@ -227,6 +227,8 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
             o = flash_attention_sharded(q, k, v, mesh, causal=True)
         else:
             o = flash_attention(q, k, v, causal=True)
+        # "attn" remat anchors are on the flash kernel's residuals
+        # (ops/flash_attention.py _flash_fwd).
         return o.reshape(B, T, c.dim) @ layer["attn"]["wo"].astype(compute)
 
     def block(carry, layer):
@@ -238,8 +240,9 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
             compute)
         return (h + y, aux + layer_aux), None
 
-    if remat:
-        block = jax.checkpoint(block)
+    # Same policy surface as the Llama family (bool or "full"/"attn"/
+    # "dots"/"none"; _remat_wrap docs the trade-offs).
+    block = _llama._remat_wrap(block, remat)
     (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)),
                                params["layers"])
     h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)

@@ -388,6 +388,15 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     else:
         out = _reference(q, k, v, scale=scale, causal=causal)
         lse = _reference_lse(q, k, scale=scale, causal=causal)
+    # Remat anchors ON THE RESIDUALS: under save_only_these_names("attn_out")
+    # the backward reloads (out, lse) instead of re-running the quadratic
+    # attention forward.  Tagging a tensor derived downstream of this
+    # custom_vjp call would not help -- the residuals are what the backward
+    # consumes, so they are what the policy must be able to save.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_out")
     return out, (q, k, v, out, lse)
 
 
@@ -414,13 +423,27 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def default_blocks() -> "tuple[int, int]":
+    """(block_q, block_k) defaults, overridable via TRAININGJOB_FA_BLOCK_Q/K
+    (read at trace time; the on-chip tuner sweeps these without code edits)."""
+    import os
+
+    bq = int(os.environ.get("TRAININGJOB_FA_BLOCK_Q", "0") or 0)
+    bk = int(os.environ.get("TRAININGJOB_FA_BLOCK_K", "0") or 0)
+    return (bq or 128, bk or 128)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Flash attention over [B, T, H, D] tensors (GQA: k/v may have fewer
     heads).  Pallas on TPU, XLA reference elsewhere; differentiable."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    dq, dk = default_blocks()
+    block_q = block_q or dq
+    block_k = block_k or dk
     # Kernel layout is [B, H, T, D].
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -449,7 +472,8 @@ def attention_xla(q, k, v, *, causal: bool = True,
 
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                             scale: Optional[float] = None,
-                            block_q: int = 128, block_k: int = 128):
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None):
     """Flash attention under a dp/fsdp x tp mesh via shard_map.
 
     A Pallas kernel is an opaque custom call to GSPMD, so it must run

@@ -61,7 +61,7 @@ def main() -> int:
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     batch_sharding = NamedSharding(mesh, P(data_axes))
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    global_batch = train.round_global_batch(global_batch, n_data)
+    global_batch, _ = train.round_global_batch(global_batch, n_data)
 
     params = bert.init_params(cfg, jax.random.PRNGKey(0))
     params = shard_pytree(params, bert.SHARDING_RULES, mesh)

@@ -68,8 +68,10 @@ def main() -> int:
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
     # The rounded batch must tile BOTH the data shards and the accumulation
-    # microbatches, at every elastic width.
-    global_batch = train.round_global_batch(global_batch, n_data * accum)
+    # microbatches, at every elastic width; the helper sheds accumulation
+    # first so the global batch never exceeds the request.
+    global_batch, accum = train.round_global_batch(global_batch, n_data,
+                                                   accum=accum)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     params = shard_pytree(params, llama.sharding_rules(pipeline=pp > 1), mesh)

@@ -55,7 +55,7 @@ def main() -> int:
 
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    global_batch = train.round_global_batch(global_batch, n_data)
+    global_batch, _ = train.round_global_batch(global_batch, n_data)
 
     params = shard_pytree(moe.init_params(cfg, jax.random.PRNGKey(0)),
                           moe.SHARDING_RULES, mesh)

@@ -48,7 +48,7 @@ def main() -> int:
     mesh = Mesh(np.array(devices), ("dp",))
     batch_sharding = NamedSharding(mesh, P("dp"))
     replicated = NamedSharding(mesh, P())
-    global_batch = train.round_global_batch(global_batch, len(devices))
+    global_batch, _ = train.round_global_batch(global_batch, len(devices))
 
     key = jax.random.PRNGKey(0)
     params, stats = resnet.init_params(cfg, key)

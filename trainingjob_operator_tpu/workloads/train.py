@@ -266,9 +266,13 @@ _PEER_LOSS_MARKERS = (
 
 
 def looks_like_peer_loss(exc: BaseException) -> bool:
-    """Match the whole exception CHAIN: orbax/asyncio wrap the underlying
-    gRPC/Gloo error (``raise X from grpc_err``) and the marker often lives
-    only on the cause."""
+    """Match the exception and its EXPLICIT cause chain: orbax/asyncio wrap
+    the underlying gRPC/Gloo error (``raise X from grpc_err``) and the
+    marker often lives only on the cause.  Implicit context
+    (``__context__``) is deliberately NOT followed: a deterministic local
+    bug raised while HANDLING a transport error would inherit the transport
+    marker and restart-loop forever instead of reaching the exit-code
+    policy as a failure."""
     seen = set()
     node: Optional[BaseException] = exc
     while node is not None and id(node) not in seen:
@@ -276,7 +280,7 @@ def looks_like_peer_loss(exc: BaseException) -> bool:
         text = f"{type(node).__name__}: {node}".lower()
         if any(marker in text for marker in _PEER_LOSS_MARKERS):
             return True
-        node = node.__cause__ or node.__context__
+        node = node.__cause__
     return False
 
 
@@ -380,7 +384,15 @@ def accumulated_value_and_grad(loss_fn: Callable, params: Any, tokens,
     B = tokens.shape[0]
     if B % accum != 0:
         raise ValueError(f"batch {B} not divisible by accum={accum}")
-    micro_batches = tokens.reshape(accum, B // accum, *tokens.shape[1:])
+    # INTERLEAVED split (microbatch a = rows congruent to a mod accum), not
+    # a contiguous reshape: with the batch dim sharded in contiguous blocks
+    # over the data axes, a contiguous microbatch would live entirely on a
+    # subset of shards whenever accum >= n_data shards (the elastic-shrink
+    # case this feature targets), serializing the microsteps or forcing
+    # per-microstep resharding.  Strided rows spread every microbatch
+    # across all data shards; the gradient average is order-invariant.
+    micro_batches = tokens.reshape(B // accum, accum,
+                                   *tokens.shape[1:]).swapaxes(0, 1)
 
     def micro(carry, tb):
         acc_l, acc_g = carry
@@ -394,10 +406,49 @@ def accumulated_value_and_grad(loss_fn: Callable, params: Any, tokens,
     return loss * inv, jax.tree.map(lambda x: x * inv, grads)
 
 
-def round_global_batch(global_batch: int, shards: int) -> int:
-    """Largest multiple of ``shards`` <= global_batch (floor ``shards``)."""
+def round_global_batch(global_batch: int, shards: int,
+                       accum: int = 1) -> "tuple[int, int]":
+    """(batch, accum): largest multiple of ``shards * accum`` <= the request.
+
+    Accumulation is the shedable factor: at a wider-than-planned elastic
+    width it is clamped down first so the global batch never exceeds the
+    request -- a silently INFLATED batch changes the loss trajectory and
+    HBM footprint behind the user's back.  When even one row per data shard
+    does not fit (batch < shards) this raises: there is no honest way to
+    run data-parallel with an empty shard.
+    """
     shards = max(shards, 1)
-    return max(shards, global_batch // shards * shards)
+    accum = max(accum, 1)
+    if global_batch < shards:
+        raise ValueError(
+            f"global batch {global_batch} < {shards} data shards: every "
+            f"shard needs at least one row; raise the batch or use fewer "
+            f"data shards")
+    # Pick the accum <= requested that yields the LARGEST rounded batch (on
+    # ties, the largest accum -- smallest microbatch HBM).  Merely clamping
+    # accum to fit would deflate the batch at widths where a smaller accum
+    # tiles it exactly -- e.g. batch 12, shards 2, accum 4 rounds to 8,
+    # while accum 2 keeps the requested 12 -- and a width-dependent batch
+    # breaks the elastic contract that the loss trajectory is
+    # width-independent.
+    requested = accum
+    best = None
+    for a in range(min(accum, global_batch // shards), 0, -1):
+        step = shards * a
+        rounded = global_batch // step * step
+        if best is None or rounded > best[0]:
+            best = (rounded, a)
+    rounded, accum = best
+    if accum != requested:
+        print(f"using gradient accumulation {accum} (requested {requested}) "
+              f"for {shards} data shards at global batch {rounded}",
+              flush=True)
+    if rounded != global_batch:
+        # A changed batch changes the loss trajectory; never do it silently
+        # (the same rationale that forbids inflating it).
+        print(f"rounded global batch {global_batch} -> {rounded} to tile "
+              f"{shards} data shards x {accum} accumulation", flush=True)
+    return rounded, accum
 
 
 def globalize_batch(sharding, local):
