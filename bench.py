@@ -52,7 +52,8 @@ def train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 6.0 * n * batch * seq + 6.0 * cfg.n_layers * batch * seq * seq * cfg.dim
 
 
-def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0):
+def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0,
+                 remat=True):
     import jax
     import optax
 
@@ -65,7 +66,7 @@ def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0):
     @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(p, o, tokens):
         def loss(pp):
-            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=True)
+            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat)
 
         l, grads = jax.value_and_grad(loss)(p)
         updates, o2 = tx.update(grads, o, p)

@@ -4,59 +4,26 @@ Run on the real TPU (no args):  python tools/tune_perf.py
 Prints one line per variant -- ms/step and MFU -- and a final WINNER line.
 The winning settings get baked into bench.py / workloads as defaults.
 
-Uses the same forced-d2h-sync timing as bench.py (jax.block_until_ready does
-not wait on this axon runtime; see tools/repro_block_until_ready.py).
+Reuses bench.py's _timed_steps so every trial inherits its guards: the
+forced device-to-host fence (jax.block_until_ready does not wait on this
+axon runtime; tools/repro_block_until_ready.py), the N-vs-3N scaling
+cross-check, and the physical step-time floor -- a fence that silently stops
+synchronizing fails the trial instead of baking a bogus WINNER into the
+defaults.
 """
 
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def timed_step(cfg, batch, seq, remat, steps=6):
-    import functools
-
-    import jax
-    import optax
-
-    from trainingjob_operator_tpu.models import llama
-
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt = tx.init(params)
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(p, o, tokens):
-        def loss(pp):
-            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat)
-
-        l, grads = jax.value_and_grad(loss)(p)
-        updates, o2 = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o2, l
-
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
-                                cfg.vocab_size)
-    params, opt, l = step(params, opt, tokens)
-    for _ in range(2):
-        params, opt, l = step(params, opt, tokens)
-    float(l)  # d2h fence
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, l = step(params, opt, tokens)
-    float(l)
-    return (time.perf_counter() - t0) / steps
 
 
 def main():
     import jax
 
+    from bench import _chip_peak, _timed_steps, train_flops_per_step
     from trainingjob_operator_tpu.models import llama
-
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from bench import _chip_peak, train_flops_per_step  # noqa: E402
 
     assert jax.default_backend() == "tpu", "run on the real chip"
     peak = _chip_peak()
@@ -65,6 +32,7 @@ def main():
                             max_seq_len=2048)
     batch, seq = 8, 2048
     flops = train_flops_per_step(cfg, batch, seq)
+    floor = flops / peak
 
     results = []
 
@@ -72,7 +40,8 @@ def main():
         os.environ["TRAININGJOB_FA_BLOCK_Q"] = str(bq)
         os.environ["TRAININGJOB_FA_BLOCK_K"] = str(bk)
         try:
-            t = timed_step(cfg, batch, seq, remat)
+            t = _timed_steps(cfg, batch, seq, steps=4, remat=remat,
+                             min_plausible_s=floor)
         except Exception as exc:
             print(json.dumps({"tag": tag, "batch": batch,
                               "error": type(exc).__name__}), flush=True)
@@ -86,7 +55,6 @@ def main():
     # 1) remat policy sweep at default blocks
     for pol in ["full", "attn", "dots", "none"]:
         trial(f"remat={pol}", pol, 0, 0)
-
     if not results:
         sys.exit("all remat trials failed (see error lines above)")
 

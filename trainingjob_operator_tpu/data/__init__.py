@@ -1,0 +1,22 @@
+"""Input pipeline: deterministic, elastic-friendly token loading.
+
+The reference operator has no data layer -- feeding the model is the
+in-container framework's job (SURVEY.md §0, §2.7).  The TPU build owns the
+workload layer, so it owns input too, designed around the same elastic
+contract as the rest of the framework:
+
+- **Stateless sampling** (`TokenDataset.batch`): the global batch for step N
+  is a pure function of (seed, step, batch, seq) -- no iterator state to
+  checkpoint, and a job resumed at a different elastic width replays the
+  byte-identical global batch sequence (each data shard just takes its rows
+  of it).  Orbax only ever has to persist the step number.
+- **Host-side prefetch** (`Prefetcher`): a background thread assembles the
+  next batch and lands it on device while the current step runs, hiding
+  host->HBM transfer behind MXU time (single-core TPU-VM hosts still
+  overlap DMA with compute).
+"""
+
+from trainingjob_operator_tpu.data.tokens import TokenDataset, write_tokens
+from trainingjob_operator_tpu.data.loader import Prefetcher
+
+__all__ = ["TokenDataset", "write_tokens", "Prefetcher"]

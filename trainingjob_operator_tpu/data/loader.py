@@ -1,0 +1,100 @@
+"""Host->device prefetch: overlap next-batch assembly with the current step.
+
+TPU-first rationale: a training step is MXU-bound; the host is idle while the
+chip computes.  ``Prefetcher`` uses that idle time to (a) gather the next
+batch's windows from the memory-mapped dataset and (b) start its DMA to HBM
+(``jax.device_put`` is async), so step N+1's data is resident when step N's
+``step_fn`` returns.  One background thread + a bounded handoff queue -- the
+sampling is stateless (data/tokens.py), so the thread holds no state worth
+checkpointing and a crashed prefetcher is rebuilt from the step number alone.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+
+_DONE = object()
+
+
+class Prefetcher:
+    """Iterates ``fetch(step)`` for step = start..stop-1, one step ahead.
+
+    ``fetch`` returns a device array (or pytree); it runs on the background
+    thread, so it should end in an async ``jax.device_put``/
+    ``globalize_batch`` -- NOT a blocking transfer.  Exceptions propagate to
+    the consumer at the matching ``next()``.
+    """
+
+    def __init__(self, fetch: Callable[[int], Any], start: int, stop: int,
+                 depth: int = 1):
+        self._fetch = fetch
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(start, stop), name="prefetcher",
+            daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._shutdown.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, start: int, stop: int) -> None:
+        for step in range(start, stop):
+            if self._shutdown.is_set():
+                return
+            try:
+                item = (step, self._fetch(step), None)
+            except BaseException as exc:  # surfaced at next()
+                self._put((step, None, exc))
+                return
+            if not self._put(item):
+                return
+        self._put(_DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        """(step, batch) in order; raises the producer's exception, or
+        StopIteration after the final step."""
+        if self._shutdown.is_set():
+            raise StopIteration
+        try:
+            item = self._q.get(timeout=300.0)
+        except queue.Empty:
+            raise RuntimeError("prefetcher stalled >300 s (dataset IO hung?)")
+        if item is _DONE:
+            self._thread.join(timeout=5.0)
+            raise StopIteration
+        step, batch, exc = item
+        if exc is not None:
+            self.close()
+            raise exc
+        return step, batch
+
+    def close(self) -> None:
+        """Stop the producer (used on preemption-triggered early exit)."""
+        self._shutdown.set()
+        # Drain so a blocked put() observes the shutdown flag.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,127 @@
+"""Memory-mapped token datasets with stateless deterministic sampling.
+
+File format (``.tokens``): a 16-byte header -- magic ``b"AITJTOK1"``, then
+uint32 dtype code (2 = uint16, 4 = uint32) and uint32 reserved -- followed by
+the flat token stream.  Written by ``write_tokens`` (tokenize once, train
+many); memory-mapped on load so a TPU-VM host never pages the whole corpus
+into RAM (reference has no equivalent; the in-container framework owns data,
+SURVEY.md §2.7).
+
+Sampling is STATELESS: ``batch(step)`` derives every row's window offset from
+``(seed, step, row)`` via a tiny splitmix-style hash -- random access, no
+shuffle buffer, no iterator state.  Restart/elastic contracts fall out:
+resuming at step N at ANY data-parallel width replays the byte-identical
+global batch sequence, because a width-w shard just takes its ``rows / w``
+slice of the same global batch (workloads/train.py ``globalize_batch``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+MAGIC = b"AITJTOK1"
+_DTYPES = {2: "uint16", 4: "uint32"}
+_CODES = {v: k for k, v in _DTYPES.items()}
+HEADER_BYTES = 16
+
+
+def write_tokens(path: str, tokens, vocab_size: Optional[int] = None) -> int:
+    """Serialize a 1-D int array to the ``.tokens`` format; returns count.
+
+    Picks uint16 when the ids fit (vocab <= 65536: half the disk and HBM-DMA
+    bytes of int32 -- bandwidth is the input pipeline's budget).
+    """
+    import numpy as np
+
+    arr = np.asarray(tokens)
+    if arr.ndim != 1:
+        raise ValueError(f"tokens must be 1-D, got shape {arr.shape}")
+    top = int(arr.max()) if arr.size else 0
+    if arr.size and int(arr.min()) < 0:
+        raise ValueError(f"negative token id {int(arr.min())}")
+    hi = int(vocab_size) if vocab_size else top + 1
+    if top >= hi:
+        # A narrower dtype would WRAP the stray id into a plausible-looking
+        # wrong token -- corrupting the corpus at write time, silently.
+        raise ValueError(f"token id {top} >= vocab_size {hi}")
+    dtype = "uint16" if hi <= 65536 else "uint32"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        import struct
+
+        f.write(MAGIC + struct.pack("<II", _CODES[dtype], 0))
+        f.write(arr.astype(dtype).tobytes())
+    os.replace(tmp, path)  # atomic: a reader never sees a half-written file
+    return int(arr.size)
+
+
+class TokenDataset:
+    """Random-access window sampler over a memory-mapped token file."""
+
+    def __init__(self, path: str, seed: int = 0):
+        import struct
+
+        import numpy as np
+
+        with open(path, "rb") as f:
+            head = f.read(HEADER_BYTES)
+        if len(head) != HEADER_BYTES or head[:8] != MAGIC:
+            raise ValueError(f"{path}: not a {MAGIC.decode()} token file")
+        code, _ = struct.unpack("<II", head[8:])
+        if code not in _DTYPES:
+            raise ValueError(f"{path}: unknown dtype code {code}")
+        self.path = path
+        self.seed = int(seed)
+        self._tokens = np.memmap(path, dtype=_DTYPES[code], mode="r",
+                                 offset=HEADER_BYTES)
+        if self._tokens.size == 0:
+            raise ValueError(f"{path}: empty token stream")
+
+    def __len__(self) -> int:
+        return int(self._tokens.size)
+
+    def _offsets(self, step: int, rows: int, window: int):
+        """Window start offsets for every row of global step ``step``.
+
+        splitmix64-style avalanche of (seed, step, row): uncorrelated,
+        O(1)-random-access, and identical on every host -- determinism
+        across widths needs no coordination.
+        """
+        import numpy as np
+
+        span = len(self) - window
+        if span < 0:
+            raise ValueError(
+                f"{self.path}: {len(self)} tokens < window {window}")
+        with np.errstate(over="ignore"):  # uint64 wraparound is the hash
+            x = (np.uint64(self.seed) * np.uint64(0x9E3779B97F4A7C15)
+                 + np.uint64(step) * np.uint64(0xBF58476D1CE4E5B9)
+                 + np.arange(rows, dtype=np.uint64)
+                 * np.uint64(0x94D049BB133111EB))
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            x *= np.uint64(0x94D049BB133111EB)
+            x ^= x >> np.uint64(31)
+        return (x % np.uint64(span + 1)).astype(np.int64)
+
+    def batch(self, step: int, batch: int, seq: int, *,
+              rows: Optional[slice] = None):
+        """[rows, seq + 1] int32 windows for global step ``step``.
+
+        ``seq + 1`` tokens per row (input + next-token target, the shape
+        workloads/train.py losses expect).  ``rows`` selects this process's
+        slice of the global batch (multi-host: each host materializes only
+        its own rows and ``globalize_batch`` assembles the sharded global
+        array); default is every row.
+        """
+        import numpy as np
+
+        offs = self._offsets(step, batch, seq + 1)
+        if rows is not None:
+            offs = offs[rows]
+        out = np.empty((len(offs), seq + 1), np.int32)
+        for i, o in enumerate(offs):
+            out[i] = self._tokens[o:o + seq + 1]
+        return out

@@ -17,7 +17,9 @@ multislice DCN when present.  The global batch is kept constant across widths
 Run: ``python -m trainingjob_operator_tpu.workloads.llama_elastic``.
 Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline stages),
 LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
-(global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY.
+(global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY, LLAMA_DATA (path to a
+``.tokens`` corpus, data/tokens.py; default trains on synthetic tokens),
+LLAMA_SEED.
 """
 
 from __future__ import annotations
@@ -90,12 +92,29 @@ def main() -> int:
         return optax.apply_updates(p, updates), o, l
 
     local_batch = global_batch // max(jax.process_count(), 1)
+    data_path = os.environ.get("LLAMA_DATA", "")
 
-    def batch_at(i):
-        k = jax.random.fold_in(jax.random.PRNGKey(17 + rdv.process_id), i)
-        tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
-                                    cfg.vocab_size)
-        return train.globalize_batch(batch_sharding, tokens)
+    if data_path:
+        # File-backed corpus: stateless (seed, step)-indexed windows
+        # (data/tokens.py), so every elastic width replays the byte-identical
+        # global batch sequence; this process materializes only its
+        # contiguous row block of it.
+        from trainingjob_operator_tpu.data import TokenDataset
+
+        ds = TokenDataset(data_path, seed=int(os.environ.get("LLAMA_SEED",
+                                                             "17")))
+        row0 = rdv.process_id * local_batch
+
+        def batch_at(i):
+            local = ds.batch(i, global_batch, seq,
+                             rows=slice(row0, row0 + local_batch))
+            return train.globalize_batch(batch_sharding, local)
+    else:
+        def batch_at(i):
+            k = jax.random.fold_in(jax.random.PRNGKey(17 + rdv.process_id), i)
+            tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
+                                        cfg.vocab_size)
+            return train.globalize_batch(batch_sharding, tokens)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
     # Sharded orbax save/restore -- each host writes/reads only its own

@@ -334,14 +334,20 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
     """
     import jax
 
+    from trainingjob_operator_tpu.data.loader import Prefetcher
+
     shutdown = GracefulShutdown().install()
     profiler = StepProfiler()
     loss = None
     t_start = None
-    with peer_loss_guard(shutdown=shutdown):
-        for i in range(start_step, steps):
+    # One-step-ahead prefetch: batch_at(i) runs on a background thread while
+    # step i-1 executes on the chip (batch_at ends in an async device_put,
+    # so the host->HBM DMA overlaps compute too).
+    with peer_loss_guard(shutdown=shutdown), \
+            Prefetcher(batch_at, start_step, steps) as batches:
+        for i, batch in batches:
             profiler.step_start(i)
-            params, opt_state, loss = step_fn(params, opt_state, batch_at(i))
+            params, opt_state, loss = step_fn(params, opt_state, batch)
             if i == start_step:
                 jax.block_until_ready(loss)
                 t_start = time.time()
