@@ -20,8 +20,10 @@ from trainingjob_operator_tpu.data import (  # noqa: E402
 def corpus(tmp_path):
     path = str(tmp_path / "corpus.tokens")
     rng = np.random.default_rng(0)
-    toks = rng.integers(0, 32000, size=5000, dtype=np.int64)
-    write_tokens(path, toks, vocab_size=32000)
+    # Vocab 256 = the tiny model config's, so the workload integration test
+    # exercises a MATCHED corpus (a larger corpus vocab is refused).
+    toks = rng.integers(0, 256, size=5000, dtype=np.int64)
+    write_tokens(path, toks, vocab_size=256)
     return path, toks
 
 
@@ -42,6 +44,20 @@ class TestTokenFormat:
         b = ds.batch(0, 2, 1)
         assert b.max() <= 123456
         assert len(ds) == 3
+
+    def test_vocab_travels_in_header(self, corpus, tmp_path):
+        path, _ = corpus
+        assert TokenDataset(path).vocab_size == 256
+        p2 = str(tmp_path / "auto.tokens")
+        write_tokens(p2, np.array([3, 7, 11]))
+        assert TokenDataset(p2).vocab_size == 12  # max id + 1
+
+    def test_rejects_out_of_range_ids(self, tmp_path):
+        p = str(tmp_path / "bad.tokens")
+        with pytest.raises(ValueError, match="vocab_size"):
+            write_tokens(p, np.array([0, 70000]), vocab_size=32000)
+        with pytest.raises(ValueError, match="negative"):
+            write_tokens(p, np.array([-1, 3]))
 
     def test_rejects_garbage(self, tmp_path):
         p = tmp_path / "bad.tokens"
@@ -155,11 +171,26 @@ class TestWorkloadIntegration:
         monkeypatch.setenv("LLAMA_STEPS", "2")
         monkeypatch.setenv("LLAMA_SEQ", "32")
         monkeypatch.setenv("LLAMA_CKPT_EVERY", "100")
-        monkeypatch.setenv("TRAININGJOB_CKPT_DIR", str(tmp_path / "ckpt"))
+        monkeypatch.setenv("TRAININGJOB_CHECKPOINT_DIR",
+                           str(tmp_path / "ckpt"))
         monkeypatch.setenv("TRAININGJOB_JAX_PLATFORM", "cpu")
         from trainingjob_operator_tpu.workloads import llama_elastic
 
         assert llama_elastic.main() == 0
+
+    def test_llama_elastic_refuses_vocab_mismatch(self, tmp_path,
+                                                  monkeypatch):
+        big = str(tmp_path / "big.tokens")
+        write_tokens(big, np.array([0, 31999]), vocab_size=32000)
+        monkeypatch.setenv("LLAMA_DATA", big)
+        monkeypatch.setenv("LLAMA_BATCH", "16")
+        monkeypatch.setenv("LLAMA_STEPS", "1")
+        monkeypatch.setenv("LLAMA_SEQ", "32")
+        monkeypatch.setenv("TRAININGJOB_JAX_PLATFORM", "cpu")
+        from trainingjob_operator_tpu.workloads import llama_elastic
+
+        with pytest.raises(ValueError, match="corpus vocab"):
+            llama_elastic.main()
 
 
 if __name__ == "__main__":

@@ -134,6 +134,30 @@ class TestJaxWorkloads:
         assert "resumed at step 4" in out
         assert "steps=" in out
 
+    def test_generate_samples_from_checkpoint(self, monkeypatch, tmp_path,
+                                              capsys):
+        """Train -> checkpoint -> sample: the serve half of the loop, with a
+        PLACEHOLDER partial restore (params read, optimizer moments not)."""
+        from trainingjob_operator_tpu.workloads import generate, llama_elastic
+
+        monkeypatch.setenv("LLAMA_STEPS", "2")
+        monkeypatch.setenv("LLAMA_CKPT_EVERY", "2")
+        monkeypatch.setenv("LLAMA_BATCH", "8")
+        monkeypatch.setenv("LLAMA_SEQ", "32")
+        monkeypatch.setenv("TRAININGJOB_CHECKPOINT_DIR", str(tmp_path))
+        assert llama_elastic.main() == 0
+        capsys.readouterr()
+
+        monkeypatch.setenv("GEN_STEPS", "4")
+        monkeypatch.setenv("GEN_BATCH", "2")
+        monkeypatch.setenv("GEN_PROMPT", "3,1,4")
+        assert generate.main() == 0
+        out = capsys.readouterr().out
+        assert "sampling from checkpoint at step 2" in out
+        lines = [l for l in out.splitlines() if l.startswith("tokens:")]
+        assert len(lines) == 2
+        assert len(lines[0].split(":")[1].split(",")) == 4
+
     def test_bert_resume_restores_params(self, monkeypatch, tmp_path, capsys):
         from trainingjob_operator_tpu.workloads import bert_pretrain
 

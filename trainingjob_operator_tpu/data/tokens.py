@@ -1,11 +1,13 @@
 """Memory-mapped token datasets with stateless deterministic sampling.
 
 File format (``.tokens``): a 16-byte header -- magic ``b"AITJTOK1"``, then
-uint32 dtype code (2 = uint16, 4 = uint32) and uint32 reserved -- followed by
-the flat token stream.  Written by ``write_tokens`` (tokenize once, train
-many); memory-mapped on load so a TPU-VM host never pages the whole corpus
-into RAM (reference has no equivalent; the in-container framework owns data,
-SURVEY.md §2.7).
+uint32 dtype code (2 = uint16, 4 = uint32) and uint32 vocab size -- followed
+by the flat token stream.  The vocab travels WITH the corpus so a consumer
+can refuse a model/corpus mismatch (an out-of-range id would otherwise be
+silently clamped by XLA's gather into a plausible-looking wrong token).
+Written by ``write_tokens`` (tokenize once, train many); memory-mapped on
+load so a TPU-VM host never pages the whole corpus into RAM (reference has
+no equivalent; the in-container framework owns data, SURVEY.md §2.7).
 
 Sampling is STATELESS: ``batch(step)`` derives every row's window offset from
 ``(seed, step, row)`` via a tiny splitmix-style hash -- random access, no
@@ -50,7 +52,7 @@ def write_tokens(path: str, tokens, vocab_size: Optional[int] = None) -> int:
     with open(tmp, "wb") as f:
         import struct
 
-        f.write(MAGIC + struct.pack("<II", _CODES[dtype], 0))
+        f.write(MAGIC + struct.pack("<II", _CODES[dtype], hi))
         f.write(arr.astype(dtype).tobytes())
     os.replace(tmp, path)  # atomic: a reader never sees a half-written file
     return int(arr.size)
@@ -68,10 +70,12 @@ class TokenDataset:
             head = f.read(HEADER_BYTES)
         if len(head) != HEADER_BYTES or head[:8] != MAGIC:
             raise ValueError(f"{path}: not a {MAGIC.decode()} token file")
-        code, _ = struct.unpack("<II", head[8:])
+        code, vocab = struct.unpack("<II", head[8:])
         if code not in _DTYPES:
             raise ValueError(f"{path}: unknown dtype code {code}")
         self.path = path
+        #: ids are < vocab_size (0 on files from before the field existed).
+        self.vocab_size = int(vocab)
         self.seed = int(seed)
         self._tokens = np.memmap(path, dtype=_DTYPES[code], mode="r",
                                  offset=HEADER_BYTES)

@@ -1,0 +1,171 @@
+"""Autoregressive decoding for the Llama family: KV cache + sampling.
+
+The reference operator serves no models (it is a control plane, SURVEY.md
+§0); the TPU build owns the workload layer, and a training framework whose
+checkpoints cannot be sampled from is half a framework.  Design is
+XLA-first, mirroring the training side's constraints:
+
+- **Static shapes everywhere**: the KV cache is allocated at ``max_len`` up
+  front and written with ``lax.dynamic_update_slice``; the decode loop is a
+  ``lax.scan`` over positions (one compiled step, no Python loop, no
+  recompilation as the sequence grows).
+- **Causality via position masking**, not shape: step t attends to cache
+  slots ``< t`` through a mask computed from the loop counter -- the
+  data-dependent part stays in predicates, where XLA wants it.
+- **Same params, same shardings**: decode reuses the training pytree and
+  SHARDING_RULES; under a mesh the per-step attention/matmuls partition over
+  tp/fsdp exactly like training (decode attention is a [B, H, 1, t] matvec,
+  MXU-light, HBM-bound -- the cache layout keeps the contiguous T axis
+  innermost-but-one so cache reads stream).
+
+Prefill runs the training ``forward`` once over the whole prompt (full
+flash-attention path) while also returning each layer's K/V; generation then
+scans single-token steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from trainingjob_operator_tpu.models import llama
+
+
+def init_cache(config: llama.LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> Dict[str, Any]:
+    """Zeroed KV cache: k/v of [L, B, max_len, Hkv, Dh]."""
+    import jax.numpy as jnp
+
+    c = config
+    dtype = dtype or jnp.dtype(c.dtype)
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _attend_cache(q, keys, values, t, group: int):
+    """q: [B, 1, Hq, Dh] vs cache [B, S, Hkv, Dh], slots <= t visible."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, Hkv, Dh = keys.shape
+    qh = q.reshape(B, Hkv, group, Dh).astype(jnp.float32)
+    kh = keys.transpose(0, 2, 1, 3).astype(jnp.float32)    # [B,Hkv,S,Dh]
+    vh = values.transpose(0, 2, 1, 3).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bhsd->bhgs", qh, kh) * (Dh ** -0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= t
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", probs, vh)
+    return out.reshape(B, 1, Hkv * group, Dh)
+
+
+def prefill(params, tokens, config: llama.LlamaConfig, max_len: int, *,
+            mesh=None):
+    """Run the prompt [B, T] through the model once; returns (logits of the
+    LAST position [B, vocab], cache filled for slots [0, T)).
+
+    Delegates to the TRAINING ``llama.forward`` (``return_kv=True``) -- one
+    implementation of the layer math, so sampling cannot desynchronize from
+    what was trained (full flash-attention path included).
+    """
+    import jax.numpy as jnp
+
+    c = config
+    B, T = tokens.shape
+    if T > max_len:
+        raise ValueError(f"prompt {T} exceeds max_len {max_len}")
+    logits_all, (k, v) = llama.forward(params, tokens, c, mesh=mesh,
+                                       return_kv=True)
+
+    dtype = jnp.dtype(c.dtype)
+    pad = ((0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0))
+    cache = {"k": jnp.pad(k, pad).astype(dtype),
+             "v": jnp.pad(v, pad).astype(dtype)}
+    return logits_all[:, -1, :], cache
+
+
+def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
+                mesh=None):
+    """One token [B] at position ``t`` (scalar) -> (logits [B, vocab],
+    updated cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B = token.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = params["tok_embed"].astype(compute)[token][:, None, :]  # [B,1,D]
+    pos = jnp.broadcast_to(t[None, None], (B, 1))
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = (x @ layer["attn"]["wq"].astype(compute)).reshape(
+            B, 1, c.n_heads, c.head_dim)
+        k = (x @ layer["attn"]["wk"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        v = (x @ layer["attn"]["wv"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        q = llama._rope(q, pos, c.rope_theta)
+        k = llama._rope(k, pos, c.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
+        o = _attend_cache(q, k_cache, v_cache, t, group).astype(compute)
+        h = h + o.reshape(B, 1, c.dim) @ layer["attn"]["wo"].astype(compute)
+        x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
+        gate = jax.nn.silu(x @ layer["mlp"]["w_gate"].astype(compute))
+        up = x @ layer["mlp"]["w_up"].astype(compute)
+        h = h + (gate * up) @ layer["mlp"]["w_down"].astype(compute)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = (h[:, 0, :] @ params["lm_head"].astype(compute))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             key=None, mesh=None):
+    """Sample ``steps`` tokens after ``prompt`` [B, T]; returns [B, steps].
+
+    ``temperature`` 0 is greedy (argmax); otherwise requires ``key``.  The
+    whole generation is one jit-able computation: prefill + ``lax.scan``
+    over decode steps.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, T = prompt.shape
+    max_len = max_len or (T + steps)
+    if T + steps > max_len:
+        raise ValueError(f"{T} prompt + {steps} steps > max_len {max_len}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+
+    logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(jnp.int32)
+
+    key0 = key if key is not None else jax.random.PRNGKey(0)
+    first = pick(logits, jax.random.fold_in(key0, 0))
+
+    def step(carry, i):
+        token, cache = carry
+        logits, cache = decode_step(params, cache, token, T + i, config,
+                                    mesh=mesh)
+        nxt = pick(logits, jax.random.fold_in(key0, i + 1))
+        return (nxt, cache), nxt
+
+    # steps - 1 decode calls: the first token came from prefill's logits,
+    # and the scan emits each NEW sample (no wasted final step).
+    (_, _), rest = jax.lax.scan(step, (first, cache),
+                                jnp.arange(steps - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, steps]

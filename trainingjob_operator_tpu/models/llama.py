@@ -165,8 +165,13 @@ def _rope(x, positions, theta):
 
 def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             mesh=None, sequence_parallel: bool = False, remat=False,
-            n_microbatches: int = 4):
+            n_microbatches: int = 4, return_kv: bool = False):
     """Logits for tokens [B, T] -> [B, T, vocab].
+
+    With ``return_kv`` returns ``(logits, (k, v))`` where k/v are the
+    post-rope per-layer projections stacked [L, B, T, Hkv, Dh] -- decode
+    prefill reuses THIS forward so sampling can never desynchronize from
+    the trained math (models/decode.py).
 
     With ``sequence_parallel`` (and a mesh with an ``sp`` axis), attention runs
     as ring attention over the sequence shards; positions account for the
@@ -246,7 +251,7 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         # (ops/flash_attention.py _flash_fwd): tagging here, downstream of
         # the custom_vjp call, would not stop the backward from re-running
         # the attention forward to regenerate them.
-        return o @ layer["attn"]["wo"].astype(compute)
+        return o @ layer["attn"]["wo"].astype(compute), (k, v)
 
     def mlp(h, layer):
         gate = jax.nn.silu(h @ layer["mlp"]["w_gate"].astype(compute))
@@ -254,28 +259,47 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         return (gate * up) @ layer["mlp"]["w_down"].astype(compute)
 
     def block(h, layer):
-        h = h + attn(_rmsnorm(h, layer["attn_norm"], c.norm_eps), layer)
+        a, kv = attn(_rmsnorm(h, layer["attn_norm"], c.norm_eps), layer)
+        h = h + a
         h = h + mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer)
-        return h
+        # kv only survives the scan under return_kv (else y=None below).
+        return h, kv
 
     block = _remat_wrap(block, remat)
 
+    if return_kv and sequence_parallel:
+        # Under sp the k/v are shard-local ring chunks, not the full-sequence
+        # cache the decode contract promises -- padding them into a cache
+        # would silently attend to zero slots.
+        raise ValueError("return_kv is not supported with sequence_parallel")
     if pipelined:
+        if return_kv:
+            raise ValueError("return_kv is not supported under pipeline "
+                             "parallelism (stage-sharded layers)")
         from trainingjob_operator_tpu.parallel.pipeline import gpipe
 
         # Largest divisor of B up to the requested count: microbatches must
         # tile the batch exactly (static shapes).
         m = max(d for d in range(1, min(n_microbatches, B) + 1)
                 if B % d == 0)
-        h = gpipe(block, params["layers"], h, mesh, n_microbatches=m)
+        h = gpipe(lambda hh, layer: block(hh, layer)[0], params["layers"],
+                  h, mesh, n_microbatches=m)
+        kv = None
     else:
         # Scan over stacked layers: one compiled block, L iterations --
         # compile time O(1) in depth, XLA-friendly (no Python unrolling).
-        h, _ = jax.lax.scan(lambda hh, layer: (block(hh, layer), None),
-                            h, params["layers"])
+        def body(hh, layer):
+            h2, kv2 = block(hh, layer)
+            return h2, (kv2 if return_kv else None)
+
+        h, kv = jax.lax.scan(body, h, params["layers"])
     h = _rmsnorm(h, params["final_norm"], c.norm_eps)
-    logits = h @ params["lm_head"].astype(compute)
-    return logits.astype(jnp.float32)
+    logits = (h @ params["lm_head"].astype(compute)).astype(jnp.float32)
+    if return_kv:
+        # Post-rope per-layer K/V, stacked [L, B, T, Hkv, Dh] -- the decode
+        # cache layout (models/decode.py prefill).
+        return logits, kv
+    return logits
 
 
 def loss_fn(params, batch, config: LlamaConfig, *, mesh=None,

@@ -1,0 +1,71 @@
+"""Sample from a trained Llama checkpoint -- the serve half of the loop.
+
+Loads the llama_elastic checkpoint (same shared path contract,
+workloads/train.py CheckpointState) and autoregressively decodes with the KV
+cache (models/decode.py).  The reference operator never serves models (it is
+a control plane, SURVEY.md §0); this exists so a checkpoint produced by the
+elastic trainer is demonstrably usable, end to end, inside the same
+framework.
+
+Run: ``python -m trainingjob_operator_tpu.workloads.generate``.
+Env: LLAMA_CONFIG=tiny|7b, GEN_STEPS (tokens to sample, default 32),
+GEN_BATCH (parallel samples, default 1), GEN_TEMPERATURE (0 = greedy),
+GEN_SEED, GEN_PROMPT (comma-separated token ids; default "1"),
+TRAININGJOB_CHECKPOINT_DIR (the trainer's checkpoint root).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous, train
+
+    rdv = rendezvous.initialize_jax_distributed()
+
+    import jax
+    import jax.numpy as jnp
+
+    from trainingjob_operator_tpu.models import decode, llama
+
+    cfg = (llama.LlamaConfig.llama2_7b()
+           if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
+           else llama.LlamaConfig.tiny())
+    steps = int(os.environ.get("GEN_STEPS", "32"))
+    batch = int(os.environ.get("GEN_BATCH", "1"))
+    temperature = float(os.environ.get("GEN_TEMPERATURE", "0"))
+    seed = int(os.environ.get("GEN_SEED", "0"))
+    prompt_ids = [int(x) for x in
+                  os.environ.get("GEN_PROMPT", "1").split(",")]
+
+    import orbax.checkpoint as ocp
+
+    # PLACEHOLDER skips the AdamW moments entirely: a 7B checkpoint holds
+    # ~2x the params in optimizer state the sampler never uses -- restoring
+    # it would triple restore IO and can OOM a host that fits params alone.
+    state = train.CheckpointState.restore_or_init(
+        rdv, {"params": llama.init_params(cfg, jax.random.PRNGKey(0)),
+              "opt_state": ocp.PLACEHOLDER, "step": 0},
+        subdir="llama")
+    step = int(state.value["step"])
+    params = state.value["params"]
+    if step == 0:
+        print("warning: no checkpoint found, sampling from random init",
+              flush=True)
+    else:
+        print(f"sampling from checkpoint at step {step}", flush=True)
+
+    prompt = jnp.broadcast_to(jnp.asarray(prompt_ids, jnp.int32)[None, :],
+                              (batch, len(prompt_ids)))
+    out = decode.generate(
+        params, prompt, cfg, steps=steps, temperature=temperature,
+        key=jax.random.PRNGKey(seed) if temperature > 0 else None)
+    for row in out:
+        print("tokens:", ",".join(str(int(t)) for t in row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,12 @@ def main() -> int:
 
         ds = TokenDataset(data_path, seed=int(os.environ.get("LLAMA_SEED",
                                                              "17")))
+        if ds.vocab_size > cfg.vocab_size:
+            # XLA's gather clamps out-of-range ids, so a mismatched corpus
+            # would train on silently-corrupted tokens; refuse instead.
+            raise ValueError(
+                f"{data_path}: corpus vocab {ds.vocab_size} exceeds model "
+                f"vocab {cfg.vocab_size}")
         row0 = rdv.process_id * local_batch
 
         def batch_at(i):

@@ -45,7 +45,10 @@ class CheckpointState:
         ``jax.Array`` leaves in ``init_value`` act as the restore template:
         the checkpoint is restored *onto their shardings* (the current mesh),
         regardless of the mesh shape at save time.  ``None`` leaves mean the
-        structure is only known from the checkpoint itself.
+        structure is only known from the checkpoint itself; an
+        ``orbax.checkpoint.PLACEHOLDER`` leaf SKIPS that subtree entirely
+        (e.g. a sampler restoring params but not optimizer moments,
+        workloads/generate.py).
         """
         directory = rdv.checkpoint_dir
         if not directory:
@@ -59,12 +62,17 @@ class CheckpointState:
                                 rdv.replica_name or "worker",
                                 str(rdv.replica_index))
         os.makedirs(path, exist_ok=True)
+
+        import jax
+
+        skip = [k for k, v in init_value.items() if v is ocp.PLACEHOLDER]
         manager = ocp.CheckpointManager(
-            path, options=ocp.CheckpointManagerOptions(max_to_keep=2))
+            path, options=ocp.CheckpointManagerOptions(max_to_keep=2),
+            # Partial restore (PLACEHOLDER) needs the PyTree handler; the
+            # on-disk format is the same as StandardSave's.
+            item_handlers=ocp.PyTreeCheckpointHandler() if skip else None)
         latest = manager.latest_step()
         if latest is not None:
-            import jax
-
             has_placeholders = any(
                 leaf is None for leaf in jax.tree.leaves(
                     init_value, is_leaf=lambda x: x is None))
@@ -94,9 +102,23 @@ class CheckpointState:
                                                     sharding=sharding)
                     return x
 
-                template = jax.tree.map(abstract, init_value)
-                restored = manager.restore(
-                    latest, args=ocp.args.StandardRestore(template))
+                if skip:
+                    # Partial restore: PLACEHOLDER top-level items are not
+                    # read at all (a sampler restoring params but not the
+                    # ~2x-params optimizer moments, workloads/generate.py).
+                    template = jax.tree.map(
+                        abstract, {k: v for k, v in init_value.items()
+                                   if k not in skip})
+                    restored = manager.restore(
+                        latest, args=ocp.args.PyTreeRestore(
+                            template, partial_restore=True))
+                    restored = dict(restored)
+                    for k in skip:
+                        restored[k] = ocp.PLACEHOLDER
+                else:
+                    template = jax.tree.map(abstract, init_value)
+                    restored = manager.restore(
+                        latest, args=ocp.args.StandardRestore(template))
             return cls(path, restored, manager)
         return cls(path, init_value, manager)
 
