@@ -178,6 +178,56 @@ class TestWorkloadIntegration:
 
         assert llama_elastic.main() == 0
 
+    def test_make_corpus_byte_level(self, tmp_path):
+        import tools.make_corpus as mc
+
+        txt = tmp_path / "a.txt"
+        txt.write_text("hello tokens")
+        out = str(tmp_path / "a.tokens")
+        assert mc.main([out, str(txt)]) == 0
+        ds = TokenDataset(out)
+        assert ds.vocab_size == 256
+        assert bytes(ds._tokens[:5].astype(np.uint8)) == b"hello"
+
+    def test_eval_stream_is_heldout_and_printed(self, corpus, tmp_path,
+                                                monkeypatch, capsys):
+        path, _ = corpus
+        monkeypatch.setenv("LLAMA_DATA", path)
+        monkeypatch.setenv("LLAMA_BATCH", "16")
+        monkeypatch.setenv("LLAMA_STEPS", "2")
+        monkeypatch.setenv("LLAMA_SEQ", "32")
+        monkeypatch.setenv("LLAMA_CKPT_EVERY", "100")
+        monkeypatch.setenv("LLAMA_EVAL_EVERY", "2")
+        monkeypatch.setenv("LLAMA_EVAL_BATCHES", "1")
+        monkeypatch.setenv("TRAININGJOB_JAX_PLATFORM", "cpu")
+        from trainingjob_operator_tpu.workloads import llama_elastic
+
+        assert llama_elastic.main() == 0
+        out = capsys.readouterr().out
+        assert "eval step 2 loss" in out
+        # The split holds DISJOINT tokens: train windows stay in the first
+        # 90% of the stream, eval windows in the last 10%.
+        ds_train = TokenDataset(path, seed=17, region=(0.0, 0.9))
+        ds_eval = TokenDataset(path, seed=17, region=(0.9, 1.0))
+        n = len(ds_train)
+        train_offs = ds_train._offsets(0, 64, 17)
+        eval_offs = ds_eval._offsets(0, 64, 17)
+        assert train_offs.max() + 17 <= int(n * 0.9)
+        assert eval_offs.min() >= int(n * 0.9)
+
+    def test_region_restricts_and_rejects(self, corpus):
+        path, toks = corpus
+        tail = TokenDataset(path, seed=1, region=(0.9, 1.0))
+        batch = tail.batch(3, 4, 16)
+        lo = int(len(toks) * 0.9)
+        for row, off in zip(batch, tail._offsets(3, 4, 17)):
+            assert off >= lo
+            np.testing.assert_array_equal(row, toks[off:off + 17])
+        with pytest.raises(ValueError, match="bad region"):
+            TokenDataset(path, region=(0.5, 0.4))
+        with pytest.raises(ValueError, match="region"):
+            TokenDataset(path, region=(0.999, 1.0)).batch(0, 1, 64)
+
     def test_llama_elastic_refuses_vocab_mismatch(self, tmp_path,
                                                   monkeypatch):
         big = str(tmp_path / "big.tokens")

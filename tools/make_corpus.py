@@ -1,0 +1,60 @@
+"""Build a .tokens corpus (data/tokens.py format) from text files.
+
+Byte-level tokenization (vocab 256) by default -- zero external tokenizer
+dependencies, reversible, and enough to train/sample real text end to end:
+
+    python tools/make_corpus.py out.tokens input1.txt input2.txt ...
+    LLAMA_DATA=out.tokens python -m trainingjob_operator_tpu.workloads.llama_elastic
+
+With --vocab-from-json VOCAB.json (a {"token": id} map, e.g. an exported BPE
+vocab) input must be pre-tokenized ids, one sequence of space-separated ints
+per line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    from trainingjob_operator_tpu.data import write_tokens
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("output", help="path for the .tokens file")
+    ap.add_argument("inputs", nargs="+", help="text files (utf-8)")
+    ap.add_argument("--vocab-from-json", default=None,
+                    help="treat inputs as space-separated ids; vocab size "
+                         "is taken from this {token: id} json map")
+    args = ap.parse_args(argv)
+
+    if args.vocab_from_json:
+        with open(args.vocab_from_json) as f:
+            # Ids need not be dense 0..len-1 (pads, reserved, gaps): the
+            # vocab size is the highest id + 1.
+            vocab = max(json.load(f).values()) + 1
+        ids = []
+        for path in args.inputs:
+            with open(path) as f:
+                for line in f:
+                    ids.extend(int(x) for x in line.split())
+        tokens = np.asarray(ids, np.int64)
+    else:
+        vocab = 256
+        chunks = []
+        for path in args.inputs:
+            with open(path, "rb") as f:
+                chunks.append(np.frombuffer(f.read(), np.uint8))
+        tokens = np.concatenate(chunks).astype(np.int64)
+
+    n = write_tokens(args.output, tokens, vocab_size=vocab)
+    print(f"{args.output}: {n} tokens, vocab {vocab}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,9 +59,16 @@ def write_tokens(path: str, tokens, vocab_size: Optional[int] = None) -> int:
 
 
 class TokenDataset:
-    """Random-access window sampler over a memory-mapped token file."""
+    """Random-access window sampler over a memory-mapped token file.
 
-    def __init__(self, path: str, seed: int = 0):
+    ``region=(lo, hi)`` restricts sampling to that fraction of the stream --
+    a REAL train/eval split (train on ``(0, 0.9)``, eval on ``(0.9, 1.0)``):
+    held-out data must be disjoint TOKENS, not merely a different sampling
+    seed over the same tokens, or eval loss tracks memorization.
+    """
+
+    def __init__(self, path: str, seed: int = 0,
+                 region: "tuple[float, float]" = (0.0, 1.0)):
         import struct
 
         import numpy as np
@@ -73,10 +80,14 @@ class TokenDataset:
         code, vocab = struct.unpack("<II", head[8:])
         if code not in _DTYPES:
             raise ValueError(f"{path}: unknown dtype code {code}")
+        lo, hi = region
+        if not (0.0 <= lo < hi <= 1.0):
+            raise ValueError(f"bad region {region}")
         self.path = path
         #: ids are < vocab_size (0 on files from before the field existed).
         self.vocab_size = int(vocab)
         self.seed = int(seed)
+        self.region = (float(lo), float(hi))
         self._tokens = np.memmap(path, dtype=_DTYPES[code], mode="r",
                                  offset=HEADER_BYTES)
         if self._tokens.size == 0:
@@ -94,10 +105,13 @@ class TokenDataset:
         """
         import numpy as np
 
-        span = len(self) - window
+        lo = int(len(self) * self.region[0])
+        hi = int(len(self) * self.region[1])
+        span = (hi - lo) - window
         if span < 0:
             raise ValueError(
-                f"{self.path}: {len(self)} tokens < window {window}")
+                f"{self.path}: region {self.region} holds {hi - lo} "
+                f"tokens < window {window}")
         with np.errstate(over="ignore"):  # uint64 wraparound is the hash
             x = (np.uint64(self.seed) * np.uint64(0x9E3779B97F4A7C15)
                  + np.uint64(step) * np.uint64(0xBF58476D1CE4E5B9)
@@ -108,7 +122,7 @@ class TokenDataset:
             x ^= x >> np.uint64(27)
             x *= np.uint64(0x94D049BB133111EB)
             x ^= x >> np.uint64(31)
-        return (x % np.uint64(span + 1)).astype(np.int64)
+        return (np.uint64(lo) + x % np.uint64(span + 1)).astype(np.int64)
 
     def batch(self, step: int, batch: int, seq: int, *,
               rows: Optional[slice] = None):

@@ -19,7 +19,9 @@ Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline stages),
 LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
 (global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY, LLAMA_DATA (path to a
 ``.tokens`` corpus, data/tokens.py; default trains on synthetic tokens),
-LLAMA_SEED.
+LLAMA_SEED, LLAMA_EVAL_EVERY (held-out eval cadence in steps; 0 = off),
+LLAMA_EVAL_BATCHES, LLAMA_EVAL_FRACTION (corpus tail reserved for eval
+when eval is on; default 0.1).
 """
 
 from __future__ import annotations
@@ -93,6 +95,14 @@ def main() -> int:
 
     local_batch = global_batch // max(jax.process_count(), 1)
     data_path = os.environ.get("LLAMA_DATA", "")
+    eval_every = int(os.environ.get("LLAMA_EVAL_EVERY", "0"))
+    eval_batches = int(os.environ.get("LLAMA_EVAL_BATCHES", "2"))
+    # Held-out split: the corpus TAIL is reserved for eval (disjoint
+    # tokens, not just a different sampling seed -- otherwise eval loss
+    # would track memorization).  Training uses the full stream when eval
+    # is off, so enabling eval is the only thing that changes the split.
+    eval_frac = float(os.environ.get("LLAMA_EVAL_FRACTION", "0.1"))
+    train_region = (0.0, 1.0 - eval_frac) if eval_every > 0 else (0.0, 1.0)
 
     if data_path:
         # File-backed corpus: stateless (seed, step)-indexed windows
@@ -102,7 +112,8 @@ def main() -> int:
         from trainingjob_operator_tpu.data import TokenDataset
 
         ds = TokenDataset(data_path, seed=int(os.environ.get("LLAMA_SEED",
-                                                             "17")))
+                                                             "17")),
+                          region=train_region)
         if ds.vocab_size > cfg.vocab_size:
             # XLA's gather clamps out-of-range ids, so a mismatched corpus
             # would train on silently-corrupted tokens; refuse instead.
@@ -116,11 +127,46 @@ def main() -> int:
                              rows=slice(row0, row0 + local_batch))
             return train.globalize_batch(batch_sharding, local)
     else:
+        ds = None
+
         def batch_at(i):
             k = jax.random.fold_in(jax.random.PRNGKey(17 + rdv.process_id), i)
             tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
                                         cfg.vocab_size)
             return train.globalize_batch(batch_sharding, tokens)
+
+    eval_fn = None
+    if eval_every > 0:
+        # FIXED held-out set (batches j = 0..N-1 every time): comparable
+        # across checkpoints and widths.  File-backed eval reads the
+        # reserved corpus tail; synthetic fallback uses a held-out key.
+        if ds is None:
+            eval_ds = None
+        else:
+            eval_ds = TokenDataset(data_path, seed=ds.seed,
+                                   region=(1.0 - eval_frac, 1.0))
+
+        @jax.jit
+        def eval_loss(p, tokens):
+            return llama.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
+                                 sequence_parallel=use_sp)
+
+        def eval_batch_at(j):
+            if eval_ds is not None:
+                local = eval_ds.batch(j, global_batch, seq,
+                                      rows=slice(row0, row0 + local_batch))
+            else:
+                k = jax.random.fold_in(
+                    jax.random.PRNGKey(0x5EED + rdv.process_id), j)
+                local = jax.random.randint(k, (local_batch, seq + 1), 0,
+                                           cfg.vocab_size)
+            return train.globalize_batch(batch_sharding, local)
+
+        def eval_fn(p):
+            total = 0.0
+            for j in range(eval_batches):
+                total += float(eval_loss(p, eval_batch_at(j)))
+            return total / max(eval_batches, 1)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
     # Sharded orbax save/restore -- each host writes/reads only its own
@@ -140,7 +186,7 @@ def main() -> int:
     params, opt_state, loss, t_start = train.run_elastic_loop(
         step_fn=step_fn, batch_at=batch_at, state=state, params=params,
         opt_state=opt_state, steps=steps, start_step=start_step,
-        ckpt_every=ckpt_every)
+        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

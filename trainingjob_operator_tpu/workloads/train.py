@@ -342,7 +342,9 @@ class peer_loss_guard:
 
 def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                      state: "CheckpointState", params: Any, opt_state: Any,
-                     steps: int, start_step: int, ckpt_every: int):
+                     steps: int, start_step: int, ckpt_every: int,
+                     eval_fn: Optional[Callable] = None,
+                     eval_every: int = 0):
     """The shared elastic train loop (llama_elastic / moe_pretrain):
     checkpoint every ``ckpt_every`` steps, print the first post-resume step
     (the elastic-recovery endpoint the bench keys on), honor the SIGTERM
@@ -388,6 +390,14 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                 print(f"step {i+1}/{steps} loss {float(loss):.4f}",
                       flush=True)
                 save(i + 1)
+            if (eval_fn is not None and eval_every > 0
+                    and (i + 1) % eval_every == 0):
+                # Held-out loss on the params, not a training step.  The
+                # eval set is FIXED (same batches every eval point), so the
+                # series is comparable across checkpoints and elastic
+                # widths.
+                print(f"eval step {i+1} loss {eval_fn(params):.4f}",
+                      flush=True)
         profiler.close()
         jax.block_until_ready(loss)
         state.finalize()  # commit any in-flight background save before exit
