@@ -133,7 +133,30 @@ def bench_train():
     os.environ["TRAININGJOB_PALLAS"] = "auto"
     flops = train_flops_per_step(cfg, batch, seq)
     floor = flops / peak if peak else 0.0
-    t_step = _timed_steps(cfg, batch, seq, steps, min_plausible_s=floor)
+    # Policy ladder: "attn" saves the flash kernel's residuals so the
+    # backward skips re-running the quadratic attention forward (~one extra
+    # [B, T, D] + lse per layer of HBM); if that does not fit, fall back to
+    # full remat (the round-4 measured 42.3% MFU configuration).
+    t_step = None
+    remat_policy = None
+    for pol in (["attn", "full"] if on_tpu else ["full"]):
+        try:
+            t_step = _timed_steps(cfg, batch, seq, steps, remat=pol,
+                                  min_plausible_s=floor)
+            remat_policy = pol
+            break
+        except Exception as exc:
+            # Only an OOM downgrades the ladder; anything else -- above all
+            # _timed_steps' own harness-integrity RuntimeErrors (broken
+            # fence, scaling mismatch) -- must fail loudly, not be masked
+            # by a retry at the next policy.
+            msg = str(exc)
+            if ("RESOURCE_EXHAUSTED" not in msg
+                    and "out of memory" not in msg.lower()):
+                raise
+            last_exc = exc
+    if t_step is None:
+        raise last_exc
     mfu = flops / t_step / peak * 100 if peak else None
     if mfu is not None and not (0.0 < mfu < 100.0):
         # A physically impossible number must fail loudly, never be the
@@ -149,6 +172,7 @@ def bench_train():
         "tokens_per_s": round(batch * seq / t_step),
         "model_tflops_per_step": round(flops / 1e12, 1),
         "mfu_pct": round(mfu, 1) if mfu is not None else None,
+        "remat_policy": remat_policy,
     }
 
     # Pallas vs XLA attention A/B at a size both fit.

@@ -55,17 +55,26 @@ def test_two_process_data_parallel_train(tmp_path):
         "PYTHONPATH": REPO,
     }
     procs = []
-    for pid in range(2):
-        env = {**env_common, "TRAININGJOB_PROCESS_ID": str(pid)}
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m",
-             "trainingjob_operator_tpu.workloads.llama_elastic"],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=420)
-        outs.append(out)
+    try:
+        for pid in range(2):
+            env = {**env_common, "TRAININGJOB_PROCESS_ID": str(pid)}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "trainingjob_operator_tpu.workloads.llama_elastic"],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        # A barrier deadlock times out ONE communicate; without this both
+        # children (one wedged in the coordinator barrier) would outlive
+        # the test holding the port.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {pid} failed:\n{out[-2000:]}"
     # Both ranks computed the SAME global loss (one global batch, two

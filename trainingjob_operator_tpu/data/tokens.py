@@ -96,6 +96,13 @@ class TokenDataset:
     def __len__(self) -> int:
         return int(self._tokens.size)
 
+    def check_window(self, window: int) -> None:
+        """Raise unless the region holds at least one ``window``-token
+        sample -- the startup-time misconfiguration check (a too-small eval
+        tail must fail before training burns steps toward the first eval
+        point, not at it)."""
+        self._offsets(0, 1, window)
+
     def _offsets(self, step: int, rows: int, window: int):
         """Window start offsets for every row of global step ``step``.
 

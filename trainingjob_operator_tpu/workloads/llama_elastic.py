@@ -94,85 +94,21 @@ def main() -> int:
         return optax.apply_updates(p, updates), o, l
 
     local_batch = global_batch // max(jax.process_count(), 1)
-    data_path = os.environ.get("LLAMA_DATA", "")
-    eval_every = int(os.environ.get("LLAMA_EVAL_EVERY", "0"))
-    eval_batches = int(os.environ.get("LLAMA_EVAL_BATCHES", "2"))
-    # Held-out split: the corpus TAIL is reserved for eval (disjoint
-    # tokens, not just a different sampling seed -- otherwise eval loss
-    # would track memorization).  Training uses the full stream when eval
-    # is off, so enabling eval is the only thing that changes the split.
-    eval_frac = float(os.environ.get("LLAMA_EVAL_FRACTION", "0.1"))
-    train_region = (0.0, 1.0 - eval_frac) if eval_every > 0 else (0.0, 1.0)
-
-    row0 = rdv.process_id * local_batch
-
-    if data_path:
-        from trainingjob_operator_tpu.data import TokenDataset
-
-        ds = TokenDataset(data_path, seed=int(os.environ.get("LLAMA_SEED",
-                                                             "17")),
-                          region=train_region)
-        if ds.vocab_size > cfg.vocab_size:
-            # XLA's gather clamps out-of-range ids, so a mismatched corpus
-            # would train on silently-corrupted tokens; refuse instead.
-            raise ValueError(
-                f"{data_path}: corpus vocab {ds.vocab_size} exceeds model "
-                f"vocab {cfg.vocab_size}")
-    else:
-        ds = None
-
-    def make_batch_at(dataset, key_base):
-        """Stateless (source, step) -> this process's contiguous row block
-        of the GLOBAL batch.  Both sources derive content independent of
-        the process layout (file windows / a global PRNG key), so every
-        elastic width sees the byte-identical global batch sequence --
-        train and eval alike."""
-        if dataset is not None:
-            def fetch(i):
-                local = dataset.batch(i, global_batch, seq,
-                                      rows=slice(row0, row0 + local_batch))
-                return train.globalize_batch(batch_sharding, local)
-        else:
-            def fetch(i):
-                k = jax.random.fold_in(jax.random.PRNGKey(key_base), i)
-                tokens = jax.random.randint(k, (global_batch, seq + 1), 0,
-                                            cfg.vocab_size)
-                return train.globalize_batch(
-                    batch_sharding, tokens[row0:row0 + local_batch])
-        return fetch
-
-    batch_at = make_batch_at(ds, 17)
+    batch_at, eval_batch_at, eval_every, eval_batches = (
+        train.build_batch_sources(
+            prefix="LLAMA", vocab_size=cfg.vocab_size,
+            global_batch=global_batch, local_batch=local_batch,
+            row0=rdv.process_id * local_batch, seq=seq,
+            batch_sharding=batch_sharding, synthetic_key=17))
 
     eval_fn = None
-    if eval_every > 0:
-        if eval_batches < 1:
-            raise ValueError(
-                f"LLAMA_EVAL_BATCHES={eval_batches} with eval enabled: a "
-                f"zero-batch eval would print a bogus 0.0 loss")
-        # FIXED held-out set (batches j = 0..N-1 every time): comparable
-        # across checkpoints and widths.  File-backed eval reads the
-        # reserved corpus tail; synthetic fallback uses a held-out key.
-        if ds is None:
-            eval_ds = None
-        else:
-            eval_ds = TokenDataset(data_path, seed=ds.seed,
-                                   region=(1.0 - eval_frac, 1.0))
-            # Fail at startup, not at the first eval step N*eval_every
-            # deep into paid TPU time: the tail must hold one window.
-            eval_ds._offsets(0, 1, seq + 1)
-
+    if eval_batch_at is not None:
         @jax.jit
         def eval_loss(p, tokens):
             return llama.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
                                  sequence_parallel=use_sp)
 
-        eval_batch_at = make_batch_at(eval_ds, 0x5EED)
-
-        def eval_fn(p):
-            total = 0.0
-            for j in range(eval_batches):
-                total += float(eval_loss(p, eval_batch_at(j)))
-            return total / eval_batches
+        eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at, eval_batches)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
     # Sharded orbax save/restore -- each host writes/reads only its own

@@ -8,7 +8,9 @@ einsum carries the all-to-all on ICI (models/moe.py).
 
 Run: ``python -m trainingjob_operator_tpu.workloads.moe_pretrain``.
 Env: MOE_CONFIG=tiny|8x7b, MOE_TP, MOE_EP, MOE_STEPS, MOE_BATCH (global),
-MOE_SEQ, MOE_LR, MOE_CKPT_EVERY.
+MOE_SEQ, MOE_LR, MOE_CKPT_EVERY, plus the shared data/eval set
+(MOE_DATA, MOE_SEED, MOE_EVAL_EVERY/_BATCHES/_FRACTION --
+workloads/train.py build_batch_sources).
 """
 
 from __future__ import annotations
@@ -73,12 +75,20 @@ def main() -> int:
         return optax.apply_updates(p, updates), o, l
 
     local_batch = global_batch // max(jax.process_count(), 1)
+    batch_at, eval_batch_at, eval_every, eval_batches = (
+        train.build_batch_sources(
+            prefix="MOE", vocab_size=cfg.vocab_size,
+            global_batch=global_batch, local_batch=local_batch,
+            row0=rdv.process_id * local_batch, seq=seq,
+            batch_sharding=batch_sharding, synthetic_key=23))
 
-    def batch_at(i):
-        k = jax.random.fold_in(jax.random.PRNGKey(23 + rdv.process_id), i)
-        tokens = jax.random.randint(k, (local_batch, seq + 1), 0,
-                                    cfg.vocab_size)
-        return train.globalize_batch(batch_sharding, tokens)
+    eval_fn = None
+    if eval_batch_at is not None:
+        @jax.jit
+        def eval_loss(p, tokens):
+            return moe.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh)
+
+        eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at, eval_batches)
 
     state = train.CheckpointState.restore_or_init(
         rdv, {"params": params, "opt_state": opt_state, "step": 0},
@@ -93,7 +103,7 @@ def main() -> int:
     params, opt_state, loss, t_start = train.run_elastic_loop(
         step_fn=step_fn, batch_at=batch_at, state=state, params=params,
         opt_state=opt_state, steps=steps, start_step=start_step,
-        ckpt_every=ckpt_every)
+        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

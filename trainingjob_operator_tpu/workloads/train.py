@@ -489,6 +489,96 @@ def round_global_batch(global_batch: int, shards: int,
     return rounded, accum
 
 
+def build_batch_sources(*, prefix: str, vocab_size: int, global_batch: int,
+                        local_batch: int, row0: int, seq: int,
+                        batch_sharding, synthetic_key: int):
+    """(batch_at, eval_batch_at | None, eval_every, eval_batches) from env.
+
+    Shared data plumbing for the elastic workloads (llama_elastic,
+    moe_pretrain).  Env, under the workload's ``prefix`` (e.g. ``LLAMA``):
+    ``{P}_DATA`` (.tokens corpus; default synthetic), ``{P}_SEED``,
+    ``{P}_EVAL_EVERY`` / ``{P}_EVAL_BATCHES`` / ``{P}_EVAL_FRACTION``.
+
+    Both sources are stateless functions of (source, step) with NO
+    process-layout input -- file windows or a global PRNG key -- so every
+    elastic width sees the byte-identical global batch sequence; each
+    process materializes only its contiguous row block.  When eval is on,
+    the corpus TAIL is reserved for it (disjoint tokens, not a reseed:
+    sampling the training tokens with a different seed would track
+    memorization), and misconfigurations fail here at startup, not at the
+    first eval step deep into paid TPU time.
+    """
+    import jax
+
+    data_path = os.environ.get(f"{prefix}_DATA", "")
+    seed = int(os.environ.get(f"{prefix}_SEED", str(synthetic_key)))
+    eval_every = int(os.environ.get(f"{prefix}_EVAL_EVERY", "0"))
+    eval_batches = int(os.environ.get(f"{prefix}_EVAL_BATCHES", "2"))
+    eval_frac = float(os.environ.get(f"{prefix}_EVAL_FRACTION", "0.1"))
+    if eval_every > 0:
+        if eval_batches < 1:
+            raise ValueError(
+                f"{prefix}_EVAL_BATCHES={eval_batches} with eval enabled: "
+                f"a zero-batch eval would print a bogus 0.0 loss")
+        if not 0.0 < eval_frac < 1.0:
+            raise ValueError(
+                f"{prefix}_EVAL_FRACTION={eval_frac} must be in (0, 1)")
+    train_region = (0.0, 1.0 - eval_frac) if eval_every > 0 else (0.0, 1.0)
+
+    ds = eval_ds = None
+    if data_path:
+        from trainingjob_operator_tpu.data import TokenDataset
+
+        ds = TokenDataset(data_path, seed=seed, region=train_region)
+        if ds.vocab_size > vocab_size:
+            # XLA's gather clamps out-of-range ids: a mismatched corpus
+            # would train on silently-corrupted tokens; refuse instead.
+            raise ValueError(
+                f"{data_path}: corpus vocab {ds.vocab_size} exceeds model "
+                f"vocab {vocab_size}")
+        ds.check_window(seq + 1)
+        if eval_every > 0:
+            eval_ds = TokenDataset(data_path, seed=seed,
+                                   region=(1.0 - eval_frac, 1.0))
+            eval_ds.check_window(seq + 1)  # tail must hold one window
+
+    def make_batch_at(dataset, key_base):
+        if dataset is not None:
+            def fetch(i):
+                local = dataset.batch(i, global_batch, seq,
+                                      rows=slice(row0, row0 + local_batch))
+                return globalize_batch(batch_sharding, local)
+        else:
+            def fetch(i):
+                # Key = (base, step, ABSOLUTE row): content is a pure
+                # function of the global row index, so every width agrees,
+                # and each process generates only its own rows.
+                k = jax.random.fold_in(jax.random.PRNGKey(key_base), i)
+                keys = jax.vmap(lambda r: jax.random.fold_in(k, r))(
+                    jax.numpy.arange(row0, row0 + local_batch))
+                tokens = jax.vmap(lambda kk: jax.random.randint(
+                    kk, (seq + 1,), 0, vocab_size))(keys)
+                return globalize_batch(batch_sharding, tokens)
+        return fetch
+
+    batch_at = make_batch_at(ds, synthetic_key)
+    eval_batch_at = (make_batch_at(eval_ds, synthetic_key ^ 0x5EED)
+                     if eval_every > 0 else None)
+    return batch_at, eval_batch_at, eval_every, eval_batches
+
+
+def mean_eval_fn(eval_loss, eval_batch_at, eval_batches: int):
+    """Average a jitted ``eval_loss(params, tokens)`` over the FIXED
+    held-out set (batches j = 0..N-1 every eval point -- comparable across
+    checkpoints and elastic widths)."""
+    def eval_fn(p):
+        total = 0.0
+        for j in range(eval_batches):
+            total += float(eval_loss(p, eval_batch_at(j)))
+        return total / eval_batches
+    return eval_fn
+
+
 def globalize_batch(sharding, local):
     """Per-process local batch shard -> global sharded array (identity when
     single-process)."""
