@@ -63,10 +63,13 @@ def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0,
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     opt = tx.init(params)
 
+    ce_chunk = int(os.environ.get("TRAININGJOB_CE_CHUNK", "0") or 0)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(p, o, tokens):
         def loss(pp):
-            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat)
+            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat,
+                                 ce_chunk=ce_chunk)
 
         l, grads = jax.value_and_grad(loss)(p)
         updates, o2 = tx.update(grads, o, p)

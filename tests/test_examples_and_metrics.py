@@ -30,6 +30,17 @@ class TestExamples:
             again = TPUTrainingJob.from_dict(job.to_dict())
             assert again.to_dict() == job.to_dict(), path
 
+    def test_volumes_survive_the_pod_model(self):
+        # A user's corpus/checkpoint volumes must round-trip through the
+        # template model -- a stripped mount would crash the workload at a
+        # nonexistent path (the flagship example mounts /data).
+        path = os.path.join(EXAMPLES, "llama2-7b-elastic-v5e32.yaml")
+        job = TPUTrainingJob.from_yaml(open(path).read())
+        tmpl = job.spec.replica_specs["trainer"].template
+        assert tmpl.spec.volumes and tmpl.spec.volumes[0]["name"] == "corpus"
+        mounts = tmpl.spec.containers[0].volume_mounts
+        assert mounts and mounts[0]["mountPath"] == "/data"
+
     def test_elastic_example_declares_range(self):
         job = TPUTrainingJob.from_yaml(
             open(os.path.join(EXAMPLES, "llama2-7b-elastic-v5e32.yaml")).read())

@@ -71,6 +71,30 @@ class TestLlama:
         with pytest.raises(ValueError):
             llama.forward(params, tokens[:, :-1], cfg, remat="bogus")
 
+    def test_chunked_ce_is_exact(self):
+        # Chunked head+CE trades peak HBM for recompute, never math: loss
+        # and grads match the monolithic-logits path exactly in f32.
+        cfg = llama.LlamaConfig(**{**llama.LlamaConfig.tiny().__dict__,
+                                   "dtype": "float32"})
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+
+        def loss(pp, chunk):
+            return llama.loss_fn(pp, {"tokens": tokens}, cfg,
+                                 ce_chunk=chunk)
+
+        l0, g0 = jax.value_and_grad(loss)(params, 0)
+        for chunk in (8, 16, 32):
+            l1, g1 = jax.value_and_grad(loss)(params, chunk)
+            assert abs(float(l0) - float(l1)) < 1e-6
+            for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
+        # A chunk that cannot be honored is refused, not silently ignored.
+        with pytest.raises(ValueError, match="does not divide"):
+            loss(params, 7)
+
     def test_attn_policy_skips_attention_recompute(self):
         # The trade "attn" sells is structural, not just numeric: the grad
         # jaxpr must not re-run the quadratic attention forward (its [B, H,

@@ -36,9 +36,10 @@ def main():
 
     results = []
 
-    def trial(tag, remat, bq, bk):
+    def trial(tag, remat, bq, bk, ce_chunk=0):
         os.environ["TRAININGJOB_FA_BLOCK_Q"] = str(bq)
         os.environ["TRAININGJOB_FA_BLOCK_K"] = str(bk)
+        os.environ["TRAININGJOB_CE_CHUNK"] = str(ce_chunk)
         try:
             t = _timed_steps(cfg, batch, seq, steps=4, remat=remat,
                              min_plausible_s=floor)
@@ -59,10 +60,24 @@ def main():
         sys.exit("all remat trials failed (see error lines above)")
 
     # 2) block-size sweep on the best-so-far policy
-    best_pol = max(results, key=lambda r: r[3])[0].split("=")[1]
+    best_pol = max(results, key=lambda r: r[3])[0].split("=")[1].split(",")[0]
     for bq, bk in [(256, 128), (512, 128), (256, 256), (512, 512),
                    (1024, 128), (128, 256)]:
         trial(f"remat={best_pol},fa={bq}x{bk}", best_pol, bq, bk)
+
+    # 3) chunked cross-entropy at the WINNING blocks (the combined optimum
+    # is what matters): frees the ~2.7 GB fp32 logits, which can unlock
+    # the lighter remat policies at the full batch.
+    best_tag = max(results, key=lambda r: r[3])[0]
+    bq = bk = 0
+    if ",fa=" in best_tag:
+        bq, bk = (int(x) for x in best_tag.split(",fa=")[1].split("x"))
+    for chunk in (256, 512):
+        trial(f"remat={best_pol},fa={bq or 128}x{bk or 128},ce={chunk}",
+              best_pol, bq, bk, ce_chunk=chunk)
+        if best_pol != "dots":
+            trial(f"remat=dots,fa={bq or 128}x{bk or 128},ce={chunk}",
+                  "dots", bq, bk, ce_chunk=chunk)
 
     tag, b, t, mfu = max(results, key=lambda r: r[3])
     print(json.dumps({"winner": tag, "batch": b,

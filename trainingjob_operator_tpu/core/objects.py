@@ -222,6 +222,10 @@ class Container:
     ports: List[ContainerPort] = field(default_factory=list)
     resources: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     working_dir: str = ""
+    #: raw corev1.VolumeMount dicts -- carried through verbatim (the
+    #: controller never interprets them; stripping them would silently
+    #: unmount a user's corpus/checkpoint volumes).
+    volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"name": self.name}
@@ -239,6 +243,8 @@ class Container:
             d["resources"] = copy.deepcopy(self.resources)
         if self.working_dir:
             d["workingDir"] = self.working_dir
+        if self.volume_mounts:
+            d["volumeMounts"] = copy.deepcopy(self.volume_mounts)
         return d
 
     @classmethod
@@ -252,6 +258,7 @@ class Container:
             ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
             resources=copy.deepcopy(d.get("resources") or {}),
             working_dir=d.get("workingDir", ""),
+            volume_mounts=copy.deepcopy(d.get("volumeMounts") or []),
         )
 
 
@@ -375,6 +382,8 @@ class PodSpec:
     host_network: bool = False
     subdomain: str = ""
     priority_class_name: str = ""
+    #: raw corev1.Volume dicts, round-tripped like volume_mounts above.
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"containers": [c.to_dict() for c in self.containers]}
@@ -394,6 +403,8 @@ class PodSpec:
             d["subdomain"] = self.subdomain
         if self.priority_class_name:
             d["priorityClassName"] = self.priority_class_name
+        if self.volumes:
+            d["volumes"] = copy.deepcopy(self.volumes)
         return d
 
     @classmethod
@@ -408,6 +419,7 @@ class PodSpec:
             host_network=bool(d.get("hostNetwork", False)),
             subdomain=d.get("subdomain", ""),
             priority_class_name=d.get("priorityClassName", ""),
+            volumes=copy.deepcopy(d.get("volumes") or []),
         )
 
 

@@ -165,13 +165,16 @@ def _rope(x, positions, theta):
 
 def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             mesh=None, sequence_parallel: bool = False, remat=False,
-            n_microbatches: int = 4, return_kv: bool = False):
+            n_microbatches: int = 4, return_kv: bool = False,
+            return_hidden: bool = False):
     """Logits for tokens [B, T] -> [B, T, vocab].
 
     With ``return_kv`` returns ``(logits, (k, v))`` where k/v are the
     post-rope per-layer projections stacked [L, B, T, Hkv, Dh] -- decode
     prefill reuses THIS forward so sampling can never desynchronize from
-    the trained math (models/decode.py).
+    the trained math (models/decode.py).  With ``return_hidden`` returns
+    the final-norm hidden states [B, T, D] instead of logits (the chunked
+    cross-entropy path, ``_chunked_ce``).
 
     With ``sequence_parallel`` (and a mesh with an ``sp`` axis), attention runs
     as ring attention over the sequence shards; positions account for the
@@ -294,6 +297,8 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
 
         h, kv = jax.lax.scan(body, h, params["layers"])
     h = _rmsnorm(h, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return h
     logits = (h @ params["lm_head"].astype(compute)).astype(jnp.float32)
     if return_kv:
         # Post-rope per-layer K/V, stacked [L, B, T, Hkv, Dh] -- the decode
@@ -302,13 +307,66 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     return logits
 
 
-def loss_fn(params, batch, config: LlamaConfig, *, mesh=None,
-            sequence_parallel: bool = False, remat=False):
-    """Next-token cross-entropy; batch: {"tokens": [B, T+1]}."""
+def _chunked_ce(h, lm_head, targets, chunk: int, compute):
+    """Next-token CE without materializing the full [B, T, V] logits.
+
+    The fp32 logits are the single biggest live tensor of the train step
+    (B * T * vocab * 4 bytes -- ~2.7 GB at batch 8 / seq 2048 / vocab 32k,
+    plus the bf16 copy): scanning ``chunk``-length sequence slices under
+    ``jax.checkpoint`` keeps only ONE chunk's logits alive at a time, in
+    both forward and backward (recomputed per chunk from the saved hidden).
+    Exact -- per-position CE is independent, so chunking changes nothing
+    but peak HBM.
+    """
+    import jax
+    import jax.numpy as jnp
     import optax
 
+    B, T, D = h.shape
+    n = T // chunk
+
+    def body(total, xs):
+        hh, tt = xs                               # [B, chunk, D], [B, chunk]
+        logits = (hh @ lm_head.astype(compute)).astype(jnp.float32)
+        return total + optax.softmax_cross_entropy_with_integer_labels(
+            logits, tt).sum(), None
+
+    h_ch = h.reshape(B, n, chunk, D).swapaxes(0, 1)
+    t_ch = targets.reshape(B, n, chunk).swapaxes(0, 1)
+    total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0.0),
+                            (h_ch, t_ch))
+    return total / (B * T)
+
+
+def loss_fn(params, batch, config: LlamaConfig, *, mesh=None,
+            sequence_parallel: bool = False, remat=False, ce_chunk: int = 0):
+    """Next-token cross-entropy; batch: {"tokens": [B, T+1]}.
+
+    ``ce_chunk`` > 0 (dividing T) computes the head + CE in sequence chunks
+    so the full [B, T, vocab] logits never materialize (``_chunked_ce``) --
+    the HBM that buys typically funds a lighter remat policy or a larger
+    batch.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    c = config
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], config, mesh=mesh,
+    T = tokens.shape[1] - 1
+    if ce_chunk:
+        # A requested-but-unusable chunking must not silently fall back to
+        # the monolithic logits: the user asked for it to FIT, and a bench
+        # trial tagged ce=N must actually measure it.
+        if sequence_parallel:
+            raise ValueError("ce_chunk is not supported with "
+                             "sequence_parallel (logits are seq-sharded)")
+        if T % ce_chunk != 0:
+            raise ValueError(f"ce_chunk={ce_chunk} does not divide seq {T}")
+        h = forward(params, tokens[:, :-1], c, mesh=mesh, remat=remat,
+                    return_hidden=True)
+        return _chunked_ce(h, params["lm_head"], tokens[:, 1:], ce_chunk,
+                           jnp.dtype(c.dtype))
+    logits = forward(params, tokens[:, :-1], c, mesh=mesh,
                      sequence_parallel=sequence_parallel, remat=remat)
     return optax.softmax_cross_entropy_with_integer_labels(
         logits, tokens[:, 1:]).mean()
