@@ -21,7 +21,9 @@ LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
 ``.tokens`` corpus, data/tokens.py; default trains on synthetic tokens),
 LLAMA_SEED, LLAMA_EVAL_EVERY (held-out eval cadence in steps; 0 = off),
 LLAMA_EVAL_BATCHES, LLAMA_EVAL_FRACTION (corpus tail reserved for eval
-when eval is on; default 0.1).
+when eval is on; default 0.1), LLAMA_REMAT (rematerialization policy
+none/full/attn/dots; default attn for 7b, none for tiny), LLAMA_CE_CHUNK
+(chunked cross-entropy; 0 = monolithic logits).
 """
 
 from __future__ import annotations
@@ -60,6 +62,13 @@ def main() -> int:
     lr = float(os.environ.get("LLAMA_LR", "3e-4"))
     ckpt_every = int(os.environ.get("LLAMA_CKPT_EVERY", "10"))
     accum = int(os.environ.get("LLAMA_ACCUM", "1"))
+    # Remat defaults to "attn" for the 7B config (chip-saturating batches
+    # do not fit 16 GB HBM without it; "attn" skips the quadratic
+    # attention recompute at ~one [B, T, D] + lse per layer) and off for
+    # tiny test runs.  LLAMA_CE_CHUNK>0 additionally keeps the [B, T,
+    # vocab] logits from materializing (models/llama.py loss_fn).
+    remat = os.environ.get("LLAMA_REMAT", train.default_remat(cfg.n_layers))
+    ce_chunk = int(os.environ.get("LLAMA_CE_CHUNK", "0"))
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp,
                                 pipeline_parallel=pp)
@@ -87,7 +96,8 @@ def main() -> int:
     def step_fn(p, o, tokens):
         def loss(pp, tb):
             return llama.loss_fn(pp, {"tokens": tb}, cfg, mesh=mesh,
-                                 sequence_parallel=use_sp)
+                                 sequence_parallel=use_sp, remat=remat,
+                                 ce_chunk=ce_chunk)
 
         l, grads = train.accumulated_value_and_grad(loss, p, tokens, accum)
         updates, o = tx.update(grads, o, p)
@@ -105,8 +115,12 @@ def main() -> int:
     if eval_batch_at is not None:
         @jax.jit
         def eval_loss(p, tokens):
+            # Same remat/ce_chunk as the train step: eval must fit exactly
+            # where training fits (a monolithic-logits eval would OOM at
+            # the first eval point of the config ce_chunk exists for).
             return llama.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
-                                 sequence_parallel=use_sp)
+                                 sequence_parallel=use_sp, remat=remat,
+                                 ce_chunk=ce_chunk)
 
         eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at, eval_batches)
 

@@ -47,6 +47,7 @@ def main() -> int:
     seq = int(os.environ.get("MOE_SEQ", "128"))
     lr = float(os.environ.get("MOE_LR", "3e-4"))
     ckpt_every = int(os.environ.get("MOE_CKPT_EVERY", "10"))
+    remat = os.environ.get("MOE_REMAT", train.default_remat(cfg.n_layers))
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, expert_parallel=ep)
     print(f"elastic width {rdv.elastic_replicas}, mesh "
@@ -68,7 +69,8 @@ def main() -> int:
     @jax.jit
     def step_fn(p, o, tokens):
         def loss(pp):
-            return moe.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh)
+            return moe.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh,
+                               remat=remat)
 
         l, grads = jax.value_and_grad(loss)(p)
         updates, o = tx.update(grads, o, p)

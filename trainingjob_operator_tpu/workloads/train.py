@@ -567,6 +567,14 @@ def build_batch_sources(*, prefix: str, vocab_size: int, global_batch: int,
     return batch_at, eval_batch_at, eval_every, eval_batches
 
 
+def default_remat(n_layers: int) -> str:
+    """Shared workload default: full-size configs cannot fit chip-saturating
+    batches in 16 GB v5e HBM without remat, and "attn" (save the flash
+    kernel's residuals) is the cheapest policy that does; tiny test configs
+    skip remat entirely."""
+    return "attn" if n_layers >= 32 else "none"
+
+
 def mean_eval_fn(eval_loss, eval_batch_at, eval_batches: int):
     """Average a jitted ``eval_loss(params, tokens)`` over the FIXED
     held-out set (batches j = 0..N-1 every eval point -- comparable across
