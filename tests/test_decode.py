@@ -95,6 +95,31 @@ class TestGenerate:
                               key=jax.random.PRNGKey(3))
         assert out.shape == (1, 3)
 
+    def test_top_k_restricts_support(self):
+        cfg = _f32_tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 3), 0,
+                                    cfg.vocab_size)
+        # top_k=1 at any temperature is greedy.
+        greedy = decode.generate(params, prompt, cfg, steps=4)
+        k1 = decode.generate(params, prompt, cfg, steps=4, temperature=5.0,
+                             top_k=1, key=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+        # A tight nucleus behaves likewise at modest temperature.
+        p_small = decode.generate(params, prompt, cfg, steps=4,
+                                  temperature=0.5, top_p=1e-6,
+                                  key=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(p_small),
+                                      np.asarray(greedy))
+        with pytest.raises(ValueError, match="temperature"):
+            decode.generate(params, prompt, cfg, steps=2, top_k=5)
+        # top_p=1.0 / top_k>=vocab restrict nothing -> valid with greedy.
+        none_restricting = decode.generate(params, prompt, cfg, steps=4,
+                                           top_p=1.0,
+                                           top_k=cfg.vocab_size + 5)
+        np.testing.assert_array_equal(np.asarray(none_restricting),
+                                      np.asarray(greedy))
+
     def test_generate_is_jittable(self):
         cfg = _f32_tiny()
         params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -127,14 +127,39 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def _mask_logits(logits, top_k: int, top_p: float):
+    """Restrict sampling support: outside top-k ids and/or beyond the top-p
+    nucleus, logits become -inf.  Static-shape (sort + threshold), so it
+    jits into the decode scan."""
+    import jax.numpy as jnp
+
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        import jax
+
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always
+        # keep the first); the cutoff logit is the last kept one.
+        keep = cum - probs < top_p
+        cutoff = jnp.max(jnp.where(keep, sorted_logits, -jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             key=None, mesh=None):
+             top_k: int = 0, top_p: float = 0.0, key=None, mesh=None):
     """Sample ``steps`` tokens after ``prompt`` [B, T]; returns [B, steps].
 
-    ``temperature`` 0 is greedy (argmax); otherwise requires ``key``.  The
-    whole generation is one jit-able computation: prefill + ``lax.scan``
-    over decode steps.
+    ``temperature`` 0 is greedy (argmax); otherwise requires ``key``, and
+    ``top_k``/``top_p`` optionally restrict the sampling support (both may
+    be combined; applied in that order).  The whole generation is one
+    jit-able computation: prefill + ``lax.scan`` over decode steps.
     """
     import jax
     import jax.numpy as jnp
@@ -145,14 +170,23 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
         raise ValueError(f"{T} prompt + {steps} steps > max_len {max_len}")
     if temperature > 0.0 and key is None:
         raise ValueError("temperature sampling needs a PRNG key")
+    # top_k >= vocab and top_p >= 1.0 restrict nothing: treat as disabled
+    # (so e.g. top_p=1.0 with greedy decoding is not a spurious error).
+    top_k = 0 if top_k >= config.vocab_size else top_k
+    top_p = 0.0 if top_p >= 1.0 else top_p
+    if (top_k or top_p > 0.0) and temperature <= 0.0:
+        raise ValueError("top_k/top_p require temperature > 0 (greedy "
+                         "already picks the single best token)")
 
     logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
 
     def pick(logits, k):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1).astype(jnp.int32)
+        # Temperature FIRST: the top-p nucleus must hold top_p mass of the
+        # distribution actually sampled from, not of the unscaled one.
+        logits = _mask_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     key0 = key if key is not None else jax.random.PRNGKey(0)
     first = pick(logits, jax.random.fold_in(key0, 0))

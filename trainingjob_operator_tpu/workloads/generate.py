@@ -10,6 +10,7 @@ framework.
 Run: ``python -m trainingjob_operator_tpu.workloads.generate``.
 Env: LLAMA_CONFIG=tiny|7b, GEN_STEPS (tokens to sample, default 32),
 GEN_BATCH (parallel samples, default 1), GEN_TEMPERATURE (0 = greedy),
+GEN_TOP_K / GEN_TOP_P (restrict the sampling support; need temperature),
 GEN_SEED, GEN_PROMPT (comma-separated token ids; default "1"),
 TRAININGJOB_CHECKPOINT_DIR (the trainer's checkpoint root).
 """
@@ -36,6 +37,8 @@ def main() -> int:
     steps = int(os.environ.get("GEN_STEPS", "32"))
     batch = int(os.environ.get("GEN_BATCH", "1"))
     temperature = float(os.environ.get("GEN_TEMPERATURE", "0"))
+    top_k = int(os.environ.get("GEN_TOP_K", "0"))
+    top_p = float(os.environ.get("GEN_TOP_P", "0"))
     seed = int(os.environ.get("GEN_SEED", "0"))
     prompt_ids = [int(x) for x in
                   os.environ.get("GEN_PROMPT", "1").split(",")]
@@ -61,6 +64,7 @@ def main() -> int:
                               (batch, len(prompt_ids)))
     out = decode.generate(
         params, prompt, cfg, steps=steps, temperature=temperature,
+        top_k=top_k, top_p=top_p,
         key=jax.random.PRNGKey(seed) if temperature > 0 else None)
     for row in out:
         print("tokens:", ",".join(str(int(t)) for t in row), flush=True)
