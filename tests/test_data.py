@@ -245,3 +245,38 @@ class TestWorkloadIntegration:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+class TestPrefetcherStall:
+    """ADVICE r4: a slow-but-alive producer warns and keeps waiting (one cold
+    NFS page-in must not abort un-checkpointed training); the hard error is
+    reserved for a dead producer."""
+
+    def test_slow_fetch_warns_but_succeeds(self, monkeypatch, capsys):
+        import time as _time
+
+        monkeypatch.setenv("TRAININGJOB_PREFETCH_STALL_S", "0.1")
+
+        def fetch(s):
+            if s == 0:
+                _time.sleep(0.5)
+            return s
+
+        with Prefetcher(fetch, 0, 2) as pf:
+            got = list(pf)
+        assert got == [(0, 0), (1, 1)]
+        assert "prefetcher stalled" in capsys.readouterr().out
+
+    def test_dead_producer_raises(self, monkeypatch):
+        monkeypatch.setenv("TRAININGJOB_PREFETCH_STALL_S", "0.1")
+        pf = Prefetcher(lambda s: s, 0, 1)
+        assert next(pf) == (0, 0)
+        with pytest.raises(StopIteration):
+            next(pf)
+        # Producer gone AND queue empty -> hard error, not an endless wait.
+        pf2 = Prefetcher(lambda s: s, 0, 1)
+        pf2._thread.join(timeout=5.0)
+        pf2._q.get()  # steal the item; queue now empty, thread dead
+        pf2._q.get()  # the _DONE sentinel too
+        with pytest.raises(RuntimeError, match="died"):
+            next(pf2)

@@ -457,3 +457,221 @@ class TestPipelineEdgeCases:
             ref = block(ref, layers[i])
         out = jax.jit(lambda ls, x: gpipe(block, ls, x, mesh, 1))(layers, h)
         assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestPipelineFlashAttention:
+    """The pp path runs the real Pallas flash kernel (VERDICT r4 #2): the
+    stage body is a partial-manual shard_map over pp, and the kernel nests a
+    second partial-manual shard_map over data/tp (flash_attention_pp) --
+    attention no longer silently downgrades to attention_xla under pp."""
+
+    def _mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()).reshape(2, 2, 2)
+        return Mesh(devs, ("pp", "fsdp", "tp"))
+
+    def test_pp_uses_pallas_kernel_not_xla_fallback(self, monkeypatch):
+        """With attention_xla poisoned, the pipelined forward still runs --
+        proof the Pallas kernel (interpret mode) is on the pp path -- and
+        matches the dense forward."""
+        import importlib
+
+        import jax
+
+        from trainingjob_operator_tpu.models import llama
+        from trainingjob_operator_tpu.parallel.sharding import shard_pytree
+
+        # The ops package re-exports the flash_attention FUNCTION under the
+        # module's name; reach the module itself for monkeypatching.
+        fa = importlib.import_module(
+            "trainingjob_operator_tpu.ops.flash_attention")
+
+        monkeypatch.setenv("TRAININGJOB_PALLAS", "interpret")
+
+        def poisoned(*a, **k):
+            raise AssertionError("pp path fell back to attention_xla")
+
+        monkeypatch.setattr(fa, "attention_xla", poisoned)
+
+        mesh = self._mesh()
+        cfg = llama.LlamaConfig.tiny(n_layers=4)
+        cfg32 = llama.LlamaConfig(**{**cfg.__dict__, "dtype": "float32"})
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab_size)
+        dense = llama.forward(params, tokens, cfg32)
+        sharded = shard_pytree(params, llama.sharding_rules(pipeline=True),
+                               mesh)
+        # mb = B/M = 2, divisible by fsdp=2; heads 4 / kv-heads 2 tile tp=2.
+        piped = jax.jit(lambda p, t: llama.forward(
+            p, t, cfg32, mesh=mesh, n_microbatches=2))(sharded, tokens)
+        assert np.allclose(np.asarray(piped), np.asarray(dense),
+                           rtol=1e-4, atol=1e-4)
+
+    def test_pp_grads_flow_through_pallas(self, monkeypatch):
+        import jax
+
+        from trainingjob_operator_tpu.models import llama
+        from trainingjob_operator_tpu.parallel.sharding import shard_pytree
+
+        monkeypatch.setenv("TRAININGJOB_PALLAS", "interpret")
+        mesh = self._mesh()
+        cfg = llama.LlamaConfig.tiny(n_layers=4)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sharded = shard_pytree(params, llama.sharding_rules(pipeline=True),
+                               mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                    cfg.vocab_size)
+        loss, grads = jax.jit(jax.value_and_grad(lambda p: llama.loss_fn(
+            p, {"tokens": tokens}, cfg, mesh=mesh)))(sharded)
+        assert np.isfinite(float(loss))
+        flat = jax.tree.leaves(grads)
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+        assert any(float(np.abs(np.asarray(g)).max()) > 0 for g in flat)
+
+    def test_untileable_microbatch_falls_back_not_raises(self):
+        """mb=1 cannot tile fsdp=2: flash_attention_pp must degrade to the
+        XLA path (correct math), never error."""
+        import jax
+
+        from trainingjob_operator_tpu.models import llama
+        from trainingjob_operator_tpu.parallel.sharding import shard_pytree
+
+        mesh = self._mesh()
+        cfg = llama.LlamaConfig.tiny(n_layers=4)
+        cfg32 = llama.LlamaConfig(**{**cfg.__dict__, "dtype": "float32"})
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab_size)
+        dense = llama.forward(params, tokens, cfg32)
+        sharded = shard_pytree(params, llama.sharding_rules(pipeline=True),
+                               mesh)
+        piped = jax.jit(lambda p, t: llama.forward(
+            p, t, cfg32, mesh=mesh, n_microbatches=4))(sharded, tokens)
+        assert np.allclose(np.asarray(piped), np.asarray(dense),
+                           rtol=1e-4, atol=1e-4)
+
+    def test_bubble_fraction_formula(self):
+        from trainingjob_operator_tpu.parallel.pipeline import bubble_fraction
+
+        assert abs(bubble_fraction(2, 8) - 1 / 9) < 1e-9
+        assert abs(bubble_fraction(4, 24) - 3 / 27) < 1e-9
+
+    def test_microbatch_chooser(self):
+        """choose_microbatches: explicit requests are honored verbatim;
+        the default prefers a flashable count only when the added bubble
+        stays bounded (never collapses M for a ~1.1x kernel win)."""
+        from trainingjob_operator_tpu.models.llama import choose_microbatches
+
+        # Default, B=8, dp*fsdp=2, pp=2, target 8: M=4 keeps mb=2 tiling
+        # the data axes at ~equal bubble.
+        assert choose_microbatches(8, 8, 2, 2, explicit=False) == 4
+        # B=8, n_data=8, pp=4: only M=1 is flashable -- a 75% bubble; the
+        # chooser must refuse the collapse and keep M=8.
+        assert choose_microbatches(8, 24, 8, 4, explicit=False) == 8
+        # Explicit request: largest divisor <= request, no second-guessing.
+        assert choose_microbatches(8, 2, 8, 4, explicit=True) == 2
+        # Everything-tiles case: max divisor under the target.
+        assert choose_microbatches(16, 8, 1, 2, explicit=False) == 8
+
+
+class TestMultisliceCompileClean:
+    def test_multislice_compiles_without_involuntary_remat(self, capfd,
+                                                           monkeypatch):
+        """VERDICT r4 #5: the 6-axis multislice train step must compile with
+        ZERO "Involuntary full rematerialization" warnings (each one is a
+        replicate-then-repartition of a tensor on every step).  Fixed by the
+        rmsnorm cotangent pin (models/llama.py pin_act) + the classic
+        partitioner default (rendezvous.configure_partitioner)."""
+        import os
+
+        import jax
+        import optax
+        from jax.sharding import NamedSharding
+
+        from trainingjob_operator_tpu.api import constants
+        from trainingjob_operator_tpu.models import llama
+        from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+        from trainingjob_operator_tpu.workloads import rendezvous
+
+        rendezvous.configure_partitioner()
+        monkeypatch.setenv(constants.VIRTUAL_DEVICES_PER_SLICE_ENV, "4")
+        rdv = rendezvous.from_env({
+            "MEGASCALE_NUM_SLICES": "2", "MEGASCALE_SLICE_ID": "0",
+            "TRAININGJOB_ELASTIC_REPLICAS": "2"})
+        mesh = mesh_from_rendezvous(rdv, model_parallel=2)
+        cfg = llama.LlamaConfig.tiny()
+        params = shard_pytree(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                              llama.SHARDING_RULES, mesh)
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                    cfg.vocab_size)
+        tokens = jax.device_put(tokens,
+                                NamedSharding(mesh, batch_spec(mesh)))
+
+        @jax.jit
+        def step(p, o, t):
+            l, g = jax.value_and_grad(lambda pp: llama.loss_fn(
+                pp, {"tokens": t}, cfg, mesh=mesh))(p)
+            u, o2 = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o2, l
+
+        capfd.readouterr()  # drain
+        p, o, l = step(params, opt, tokens)
+        jax.block_until_ready(l)
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err
+        assert np.isfinite(float(l))
+
+    def test_pipeline_compiles_without_involuntary_remat(self, capfd):
+        """Same guard for the pp path: the gpipe state pin (stage dim on pp
+        + microbatch on the data axes) keeps the scan carry's sharding
+        stable; without it the partitioner full-remats the [S, mb, T, D]
+        state every tick."""
+        import jax
+        import optax
+        from jax.sharding import Mesh, NamedSharding
+
+        from trainingjob_operator_tpu.models import llama
+        from trainingjob_operator_tpu.workloads import rendezvous
+
+        rendezvous.configure_partitioner()
+        devs = np.array(jax.devices()).reshape(1, 2, 2, 2)
+        mesh = Mesh(devs, ("dp", "pp", "fsdp", "tp"))
+        cfg = llama.LlamaConfig.tiny(n_layers=4)
+        params = shard_pytree(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                              llama.sharding_rules(pipeline=True), mesh)
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                    cfg.vocab_size)
+        tokens = jax.device_put(tokens,
+                                NamedSharding(mesh, batch_spec(mesh)))
+
+        @jax.jit
+        def step(p, o, t):
+            l, g = jax.value_and_grad(lambda pp: llama.loss_fn(
+                pp, {"tokens": t}, cfg, mesh=mesh))(p)
+            u, o2 = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o2, l
+
+        capfd.readouterr()
+        p, o, l = step(params, opt, tokens)
+        jax.block_until_ready(l)
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err
+        assert np.isfinite(float(l))
+
+
+class TestFitSpecAbsentAxes:
+    def test_rule_axes_missing_from_mesh_are_dropped(self):
+        from trainingjob_operator_tpu.parallel.sharding import fit_spec
+
+        mesh = make_mesh(MeshSpec.of(dp=2, sp=4))  # no fsdp/tp axis
+        assert fit_spec(P(None, "fsdp", "tp"), (2, 8, 8), mesh) == \
+            P(None, None, None)
+        assert fit_spec(P(("dp", "fsdp"), None), (8, 4), mesh) == \
+            P("dp", None)

@@ -318,5 +318,107 @@ class TestGradAccumulation:
         # and 2 both keep batch 12 at 2 shards.
         assert train.round_global_batch(12, 2, accum=4) == (12, 3)
         assert train.round_global_batch(12, 4, accum=4) == (12, 3)
-        with _pytest.raises(ValueError, match="data shards"):
-            train.round_global_batch(8, 16)
+        # Scale-up PAST the global batch: inflate to one row per shard
+        # (loudly) instead of crash-looping the job at the new width.
+        assert train.round_global_batch(8, 16) == (16, 1)
+        assert train.round_global_batch(3, 4, accum=2) == (4, 1)
+
+
+class TestPeerLossContextHop:
+    """ADVICE r4: implicit __context__ is followed one hop, but only from a
+    transport-shaped wrapper (OSError/ConnectionError/TimeoutError)."""
+
+    def test_io_shaped_wrapper_follows_context(self):
+        from trainingjob_operator_tpu.workloads import train
+
+        try:
+            try:
+                raise ConnectionResetError("connection reset by peer")
+            except ConnectionResetError:
+                raise OSError("write failed")  # bare re-raise, no `from`
+        except OSError as wrapped:
+            assert wrapped.__cause__ is None
+            assert train.looks_like_peer_loss(wrapped)
+
+    def test_non_io_wrapper_still_ignores_context(self):
+        from trainingjob_operator_tpu.workloads import train
+
+        try:
+            try:
+                raise ConnectionResetError("connection reset by peer")
+            except ConnectionResetError:
+                raise ValueError("shape mismatch")
+        except ValueError as bug:
+            assert not train.looks_like_peer_loss(bug)
+
+
+class TestPSWireFormat:
+    """The PS protocol is a non-executable codec (JSON + raw array bytes):
+    no pickle on the wire, dtypes whitelisted."""
+
+    def _roundtrip(self, obj):
+        import socket
+
+        a, b = socket.socketpair()
+        try:
+            ps_worker.send_msg(a, obj)
+            return ps_worker.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_roundtrip_nested_arrays(self):
+        msg = {"op": "push", "lr": 0.05,
+               "grads": {"w1": np.arange(6, dtype=np.float32).reshape(2, 3),
+                         "b1": np.ones(3, np.float64)}}
+        out = self._roundtrip(msg)
+        assert out["op"] == "push" and out["lr"] == 0.05
+        np.testing.assert_array_equal(out["grads"]["w1"], msg["grads"]["w1"])
+        assert out["grads"]["w1"].dtype == np.float32
+        np.testing.assert_array_equal(out["grads"]["b1"], msg["grads"]["b1"])
+
+    def test_no_pickle_on_the_wire(self):
+        import io
+        import pickle
+        import socket
+
+        class Evil:
+            def __reduce__(self):
+                return (print, ("pwned",))
+
+        a, b = socket.socketpair()
+        try:
+            with pytest.raises((TypeError, ValueError)):
+                ps_worker.send_msg(a, {"op": "push", "grads": Evil()})
+        finally:
+            a.close()
+            b.close()
+
+    def test_rejects_object_dtype(self):
+        import json
+        import socket
+        import struct
+
+        meta = json.dumps({"x": {"__nd__": 0, "dtype": "object",
+                                 "shape": [1]}}).encode()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">II", len(meta), 0) + meta)
+            with pytest.raises(ValueError, match="dtype"):
+                ps_worker.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestEvalRequiresCorpus:
+    def test_eval_without_data_raises(self, monkeypatch):
+        from trainingjob_operator_tpu.workloads import train
+
+        monkeypatch.delenv("LLAMA_DATA", raising=False)
+        monkeypatch.setenv("LLAMA_EVAL_EVERY", "5")
+        with pytest.raises(ValueError, match="synthetic"):
+            train.build_batch_sources(
+                prefix="LLAMA", vocab_size=256, global_batch=4,
+                local_batch=4, row0=0, seq=16, batch_sharding=None,
+                synthetic_key=17)

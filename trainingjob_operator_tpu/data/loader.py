@@ -11,12 +11,19 @@ checkpointing and a crashed prefetcher is rebuilt from the step number alone.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Callable
 
 
 _DONE = object()
+
+
+def _stall_timeout() -> float:
+    """Seconds of consumer wait per warning cycle before declaring the
+    producer dead (TRAININGJOB_PREFETCH_STALL_S, default 300)."""
+    return float(os.environ.get("TRAININGJOB_PREFETCH_STALL_S", "300") or 300)
 
 
 class Prefetcher:
@@ -68,10 +75,27 @@ class Prefetcher:
         StopIteration after the final step."""
         if self._shutdown.is_set():
             raise StopIteration
-        try:
-            item = self._q.get(timeout=300.0)
-        except queue.Empty:
-            raise RuntimeError("prefetcher stalled >300 s (dataset IO hung?)")
+        # A slow-but-alive producer (cold GCS-fuse/NFS page-in of an mmap
+        # window) only WARNS each cycle; the hard error is reserved for a
+        # dead producer thread -- aborting un-checkpointed training over one
+        # slow fetch is worse than waiting it out.
+        stall = _stall_timeout()
+        waited = 0.0
+        while True:
+            try:
+                item = self._q.get(timeout=stall)
+                break
+            except queue.Empty:
+                waited += stall
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        f"prefetcher thread died after {waited:.0f} s wait "
+                        f"(dataset IO crashed?)")
+                if self._shutdown.is_set():
+                    raise StopIteration
+                print(f"WARNING: prefetcher stalled {waited:.0f} s; producer "
+                      f"thread alive, still waiting (slow dataset IO? tune "
+                      f"TRAININGJOB_PREFETCH_STALL_S)", flush=True)
         if item is _DONE:
             self._thread.join(timeout=5.0)
             raise StopIteration

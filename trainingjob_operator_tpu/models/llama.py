@@ -38,6 +38,14 @@ class LlamaConfig:
         return cls()
 
     @classmethod
+    def base_124m(cls) -> "LlamaConfig":
+        """GPT2-small-scale config (~124M params): large enough that
+        recovery is dominated by real restore/compile work (VERDICT r4 #4),
+        small enough for CPU trials."""
+        return cls(dim=768, n_layers=8, n_heads=12, n_kv_heads=12,
+                   ffn_dim=3072, max_seq_len=2048)
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256, dim: int = 64, n_layers: int = 2,
              n_heads: int = 4, n_kv_heads: int = 2, ffn_dim: int = 128,
              max_seq_len: int = 128) -> "LlamaConfig":
@@ -114,6 +122,34 @@ def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
     return params
 
 
+def choose_microbatches(batch: int, target: int, n_data: int,
+                        n_stages: int, explicit: bool) -> int:
+    """Pick the GPipe microbatch count M.
+
+    M must divide ``batch``.  With an EXPLICIT request (``n_microbatches``
+    arg or LLAMA_PP_MICROBATCH) the largest divisor <= the request wins,
+    period -- the user's bubble/memory trade is not second-guessed.  For
+    the default, prefer an M whose microbatch tiles the data axes
+    (``(batch/M) % n_data == 0`` -- the condition for the Pallas kernel on
+    the pp path, flash_attention_pp) but only when the relative schedule
+    cost (M+S-1)/M stays within 15%: the kernel's measured step win is
+    ~1.23x end-to-end (BENCH_TPU_MEASURED.md), which pays for a modestly
+    deeper bubble but never for a collapsed pipeline (e.g. M 8 -> 1 is a
+    75% bubble at pp=4).
+    """
+    divs = [d for d in range(1, min(target, batch) + 1) if batch % d == 0]
+    m0 = max(divs)
+    if explicit:
+        return m0
+    flashable = [d for d in divs if (batch // d) % n_data == 0]
+    if flashable:
+        f = max(flashable)
+        s = n_stages
+        if (f + s - 1) / f <= 1.15 * (m0 + s - 1) / m0:
+            return f
+    return m0
+
+
 def _remat_wrap(block, remat):
     """Apply the requested rematerialization policy to a layer block.
 
@@ -165,7 +201,7 @@ def _rope(x, positions, theta):
 
 def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             mesh=None, sequence_parallel: bool = False, remat=False,
-            n_microbatches: int = 4, return_kv: bool = False,
+            n_microbatches: Optional[int] = None, return_kv: bool = False,
             return_hidden: bool = False):
     """Logits for tokens [B, T] -> [B, T, vocab].
 
@@ -182,10 +218,12 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
 
     With a ``pp`` axis (size > 1) on the mesh, the layer stack runs as a
     GPipe pipeline (parallel/pipeline.py): stages own contiguous layer
-    blocks, activations rotate via ppermute, and ``n_microbatches`` (must
-    divide the batch) amortizes the bubble.  Attention inside the pipeline
-    takes the pure-XLA path (a Pallas custom call is opaque to the auto-axis
-    GSPMD partitioning); embed/head stay outside the pipeline, replicated
+    blocks, activations rotate via ppermute, and microbatching amortizes
+    the (S-1)/(M+S-1) bubble.  ``n_microbatches`` defaults to
+    ``LLAMA_PP_MICROBATCH`` from env, else 8*(S-1) (bubble ~= 11%), clipped
+    to the largest divisor of the batch.  Attention inside the pipeline
+    runs the Pallas flash kernel via a nested partial-manual shard_map
+    (flash_attention_pp); embed/head stay outside the pipeline, replicated
     over pp.
 
     ``remat`` wraps each layer in ``jax.checkpoint``: the backward recomputes
@@ -204,14 +242,49 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     compute = jnp.dtype(c.dtype)
     B, T = tokens.shape
     h = params["tok_embed"].astype(compute)[tokens]
-    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
     pipelined = (mesh is not None and "pp" in mesh.axis_names
                  and mesh.shape["pp"] > 1)
 
+    # Pre-cast the stacked matmul weights to the compute dtype OURSELVES,
+    # with an explicit sharding anchor.  XLA hoists the per-layer
+    # ``astype`` out of the scan anyway, but the hoisted stacked bf16
+    # tensor then carries no user sharding, and on many-axis meshes the
+    # SPMD partitioner can choose CLASHING shardings for its forward and
+    # backward-scan uses -- the "Involuntary full rematerialization"
+    # warning (spmd_partitioner.cc:652) seen on the multislice mesh.  The
+    # in-body ``astype(compute)`` calls below become no-ops.  Norm scales
+    # stay f32 (rmsnorm computes in f32).
+    layers = params["layers"]
+    if mesh is not None:
+        import re as _re
+
+        from jax.sharding import NamedSharding
+
+        from trainingjob_operator_tpu.parallel.sharding import (
+            fit_spec,
+            path_of,
+            spec_for_path,
+        )
+
+        rules = sharding_rules(pipeline=pipelined)
+
+        def _cast(kp, x):
+            path = "layers/" + path_of(kp)
+            if not _re.search(r"attn/w|mlp/w_", path):
+                return x
+            y = x.astype(compute)
+            return jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, fit_spec(
+                    spec_for_path(path, rules), y.shape, mesh)))
+
+        layers = jax.tree_util.tree_map_with_path(_cast, layers)
+
     def attn(h, layer):
         # Shapes from h, not the captured globals: inside the pp pipeline
-        # the leading dim is a MICROBATCH of the global batch.
+        # the leading dim is a MICROBATCH of the global batch.  Positions are
+        # computed inline (not closed over) so the attn body is closure-free
+        # under the pipeline's partial-manual shard_map.
         Bh = h.shape[0]
         q = (h @ layer["attn"]["wq"].astype(compute))
         k = (h @ layer["attn"]["wk"].astype(compute))
@@ -219,16 +292,18 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         q = q.reshape(Bh, T, c.n_heads, c.head_dim)
         k = k.reshape(Bh, T, c.n_kv_heads, c.head_dim)
         v = v.reshape(Bh, T, c.n_kv_heads, c.head_dim)
-        pos = positions[:Bh]
+        pos = jnp.broadcast_to(jnp.arange(T)[None, :], (Bh, T))
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
         if pipelined:
-            # Inside the pp shard_map body (auto axes): plain-XLA attention,
-            # partitioned by GSPMD over dp/fsdp/tp like any other einsum.
+            # Inside the pp-manual shard_map stage body: the Pallas kernel
+            # runs per-shard via a nested partial-manual shard_map over the
+            # data/tp axes (falls back to identical-math XLA attention where
+            # that cannot apply -- see flash_attention_pp).
             from trainingjob_operator_tpu.ops.flash_attention import (
-                attention_xla)
+                flash_attention_pp)
 
-            o = attention_xla(q, k, v, causal=True)
+            o = flash_attention_pp(q, k, v, mesh, causal=True)
         elif sequence_parallel and mesh is not None and "sp" in mesh.axis_names:
             # Ring attention is GQA-aware: the narrow kv blocks travel the
             # ring un-repeated (ICI bytes scale with n_kv_heads).
@@ -261,10 +336,34 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         up = h @ layer["mlp"]["w_up"].astype(compute)
         return (gate * up) @ layer["mlp"]["w_down"].astype(compute)
 
+    def pin_act(y):
+        # Pin normed activations to the canonical batch sharding.  The
+        # constraint also applies to the COTANGENT in the backward (its
+        # transpose is itself), which keeps rmsnorm's custom-vjp backward
+        # sharding-consistent: without it the incoming grad arrives
+        # tp-sharded on D from the matmul backward while the saved stats
+        # are batch-sharded, and the partitioner resolves the clash with an
+        # involuntary full rematerialization (replicate-and-repartition;
+        # observed on the 6-axis multislice mesh, spmd_partitioner.cc:652).
+        # Skipped under pp: the stage body runs in a partial-manual
+        # shard_map where a concrete-mesh NamedSharding cannot appear.
+        if mesh is None or pipelined:
+            return y
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        batch = data if len(data) > 1 else (data[0] if data else None)
+        seq = ("sp" if sequence_parallel and "sp" in mesh.axis_names
+               else None)
+        return jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(batch, seq, None)))
+
     def block(h, layer):
-        a, kv = attn(_rmsnorm(h, layer["attn_norm"], c.norm_eps), layer)
+        a, kv = attn(pin_act(_rmsnorm(h, layer["attn_norm"], c.norm_eps)),
+                     layer)
         h = h + a
-        h = h + mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer)
+        h = h + mlp(pin_act(_rmsnorm(h, layer["mlp_norm"], c.norm_eps)),
+                    layer)
         # kv only survives the scan under return_kv (else y=None below).
         return h, kv
 
@@ -279,13 +378,23 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         if return_kv:
             raise ValueError("return_kv is not supported under pipeline "
                              "parallelism (stage-sharded layers)")
+        import os
+
         from trainingjob_operator_tpu.parallel.pipeline import gpipe
 
-        # Largest divisor of B up to the requested count: microbatches must
-        # tile the batch exactly (static shapes).
-        m = max(d for d in range(1, min(n_microbatches, B) + 1)
-                if B % d == 0)
-        h = gpipe(lambda hh, layer: block(hh, layer)[0], params["layers"],
+        explicit = n_microbatches is not None
+        if n_microbatches is None:
+            env_m = int(os.environ.get("LLAMA_PP_MICROBATCH", "0") or 0)
+            explicit = env_m > 0
+            # Default M ~ 8*(S-1): bubble (S-1)/(M+S-1) ~= 11% at any depth.
+            n_microbatches = env_m or 8 * (mesh.shape["pp"] - 1)
+        n_data = 1
+        for a in ("dp", "fsdp"):
+            if a in mesh.axis_names:
+                n_data *= mesh.shape[a]
+        m = choose_microbatches(B, n_microbatches, n_data,
+                                mesh.shape["pp"], explicit)
+        h = gpipe(lambda hh, layer: block(hh, layer)[0], layers,
                   h, mesh, n_microbatches=m)
         kv = None
     else:
@@ -295,7 +404,7 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             h2, kv2 = block(hh, layer)
             return h2, (kv2 if return_kv else None)
 
-        h, kv = jax.lax.scan(body, h, params["layers"])
+        h, kv = jax.lax.scan(body, h, layers)
     h = _rmsnorm(h, params["final_norm"], c.norm_eps)
     if return_hidden:
         return h

@@ -40,6 +40,13 @@ class MoEConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    #: Routing group size (tokens), 0 = the whole sequence.  The dense
+    #: dispatch/combine einsums cost O(B*T*C*E*D) with C ~ T/E -- QUADRATIC
+    #: in sequence length.  Routing in groups of ``router_group`` tokens
+    #: (GShard's group dimension) bounds C by the group, making dispatch
+    #: linear in T; capacity (and hence token dropping) is then enforced
+    #: per group, which also matches how real batches arrive.
+    router_group: int = 0
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -164,12 +171,31 @@ def _dispatch_combine(probs, k: int, capacity: int):
 
 
 def _moe_mlp(h, layer, config: MoEConfig, compute):
-    """Routed expert MLP for h [B, T, D] -> ([B, T, D], aux_loss)."""
+    """Routed expert MLP for h [B, T, D] -> ([B, T, D], aux_loss).
+
+    With ``router_group`` g > 0 the sequence is routed in independent
+    g-token groups: fold T into the batch dim ([B, T, D] -> [B*T/g, g, D])
+    and recurse.  Capacity then scales with g, not T, so the dispatch/
+    combine einsums cost O(B*T*g*...) -- linear in sequence length --
+    instead of the O(B*T*C) ~ T^2 of whole-sequence routing.  The router
+    itself is per-token, unchanged; only the capacity budget (which tokens
+    drop under overflow) becomes group-local, the standard GShard group
+    semantics.
+    """
     import jax
     import jax.numpy as jnp
 
     c = config
-    B, T, _ = h.shape
+    B, T, D = h.shape
+    g = c.router_group
+    if g and g < T:
+        import dataclasses
+
+        if T % g:
+            raise ValueError(f"router_group={g} does not divide seq {T}")
+        y, aux = _moe_mlp(h.reshape(B * T // g, g, D), layer,
+                          dataclasses.replace(c, router_group=0), compute)
+        return y.reshape(B, T, D), aux
     cap = expert_capacity(c, T)
 
     # Router in float32: tiny matmul, and routing decisions are precision-
@@ -199,8 +225,13 @@ def _moe_mlp(h, layer, config: MoEConfig, compute):
 
 
 def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
-            mesh=None, remat=False):
-    """Logits [B, T, vocab] plus the mean auxiliary load-balancing loss."""
+            mesh=None, remat=False, return_hidden: bool = False):
+    """Logits [B, T, vocab] plus the mean auxiliary load-balancing loss.
+
+    With ``return_hidden`` returns the final-norm hidden states [B, T, D]
+    instead of logits (the chunked cross-entropy path; mirrors
+    models/llama.py).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -246,16 +277,32 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
     (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)),
                                params["layers"])
     h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return h, aux / c.n_layers
     logits = h @ params["lm_head"].astype(compute)
     return logits.astype(jnp.float32), aux / c.n_layers
 
 
 def loss_fn(params, batch, config: MoEConfig, *, mesh=None,
-            remat: bool = False):
-    """Next-token cross-entropy + weighted load-balancing auxiliary."""
+            remat: bool = False, ce_chunk: int = 0):
+    """Next-token cross-entropy + weighted load-balancing auxiliary.
+
+    ``ce_chunk`` > 0 (dividing T) computes the head + CE in sequence chunks
+    so the full [B, T, vocab] logits never materialize (llama's
+    ``_chunked_ce``; exact, HBM-only change)."""
+    import jax.numpy as jnp
     import optax
 
     tokens = batch["tokens"]
+    T = tokens.shape[1] - 1
+    if ce_chunk:
+        if T % ce_chunk != 0:
+            raise ValueError(f"ce_chunk={ce_chunk} does not divide seq {T}")
+        h, aux = forward(params, tokens[:, :-1], config, mesh=mesh,
+                         remat=remat, return_hidden=True)
+        ce = _llama._chunked_ce(h, params["lm_head"], tokens[:, 1:],
+                                ce_chunk, jnp.dtype(config.dtype))
+        return ce + config.aux_loss_weight * aux
     logits, aux = forward(params, tokens[:, :-1], config, mesh=mesh,
                           remat=remat)
     ce = optax.softmax_cross_entropy_with_integer_labels(

@@ -470,6 +470,58 @@ def attention_xla(q, k, v, *, causal: bool = True,
     return out.transpose(0, 2, 1, 3)
 
 
+def flash_attention_pp(q, k, v, mesh, *, causal: bool = True,
+                       scale: Optional[float] = None,
+                       block_q: Optional[int] = None,
+                       block_k: Optional[int] = None):
+    """Flash attention inside the gpipe stage body (models/llama.py pp path).
+
+    The stage body already runs under a shard_map manual over ONLY ``pp``
+    (parallel/pipeline.py): dp/fsdp/tp are still AUTO there, and a Pallas
+    custom call is opaque to GSPMD -- so the kernel enters manual mode for
+    those axes too via a NESTED partial-manual shard_map that takes its mesh
+    from context (passing the concrete mesh again would clash with the
+    outer abstract mesh, whose pp axis is already Manual).
+
+    Falls back to the identical-math ``attention_xla`` when the runtime has
+    no partial-manual shard_map, when the local microbatch/heads don't tile
+    over the data/tp axes, or when the sequence is sp-sharded (local-T
+    attention would be wrong math; GSPMD's gathers around the einsums are
+    the correct fallback).  q: [B, T, Hq, D]; k/v: [B, T, Hkv, D].
+    """
+    import math
+
+    from jax.sharding import PartitionSpec as P
+
+    from trainingjob_operator_tpu.parallel.pipeline import (
+        partial_manual_shard_map)
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    tp = "tp" if "tp" in mesh.axis_names else None
+    manual = frozenset(data_axes + ((tp,) if tp else ()))
+    if not manual or all(mesh.shape[a] == 1 for a in manual):
+        # pp is the only partitioned axis: the outer shard_map already made
+        # everything per-shard, the kernel can run directly.
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    shmap = partial_manual_shard_map()
+    n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
+    n_tp = mesh.shape[tp] if tp else 1
+    sp_sharded = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+    if (shmap is None or sp_sharded or q.shape[0] % n_data
+            or q.shape[2] % n_tp or k.shape[2] % n_tp):
+        return attention_xla(q, k, v, causal=causal, scale=scale)
+    batch = (data_axes if len(data_axes) > 1
+             else (data_axes[0] if data_axes else None))
+    spec = P(batch, None, tp, None)
+    fn = shmap(
+        functools.partial(flash_attention, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=manual, check_vma=False)
+    return fn(q, k, v)
+
+
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                             scale: Optional[float] = None,
                             block_q: Optional[int] = None,

@@ -20,11 +20,27 @@ the XLA-native way, as pure GSPMD (no shard_map, no manual collectives):
 Because everything is ordinary sharded XLA, the stage body composes with
 dp/fsdp/tp exactly like the dense path -- GSPMD partitions the microbatch
 over the data axes and the per-stage weights over fsdp/tp with the same
-rules as unpipelined layers.  (An earlier shard_map-manual-over-pp
+rules as unpipelined layers.
+
+The per-tick stage advance runs under a PARTIAL-MANUAL ``shard_map``:
+manual over ONLY ``pp`` (each shard sees its local stage, stage dim 1),
+auto over everything else -- dp/fsdp/tp einsums inside the body are still
+partitioned by GSPMD exactly like the dense path.  This is what lets the
+Pallas flash-attention kernel run inside the pipeline: the stage body can
+nest a second partial-manual shard_map over the data/tp axes
+(ops/flash_attention.py ``flash_attention_pp``) so the custom call --
+which GSPMD cannot partition -- executes per-shard.  (A full-manual
 formulation tripped an XLA partitioner check-failure when stage weights
-were also fsdp/tp-sharded; the GSPMD form avoids manual/auto mixing
-entirely.)  Attention inside the stage body still takes the pure-XLA path:
-a Pallas custom call is opaque to GSPMD's vmapped-stage partitioning.
+were also fsdp/tp-sharded; a pure vmap-over-the-stage-dim GSPMD
+formulation worked but forced XLA attention, since the vmapped custom
+call is opaque to the pp partitioning.  Partial-manual keeps both.)
+Runtimes without partial-manual shard_map (``jax.shard_map`` lacking
+``axis_names``) fall back to the vmap formulation + XLA attention.
+
+GPipe bubble: stage S-1 idles the first S-1 ticks and stage 0 the last
+S-1, so the idle fraction is (S-1)/(M+S-1) with M microbatches
+(``bubble_fraction``).  Callers amortize it by raising M; models/llama.py
+defaults to M ~ 8*(S-1) (bubble ~= 11%) bounded by the batch.
 
 DCN note: stage hand-offs are point-to-point and once per tick, so ``pp``
 is the one compute axis besides ``dp`` that tolerates crossing slices
@@ -36,7 +52,32 @@ selects), so ``jax.grad`` through the pipeline just works.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: (S-1)/(M+S-1)."""
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
+@functools.cache
+def partial_manual_shard_map():
+    """``jax.shard_map`` with partial-manual mode (``axis_names=``), or None.
+
+    Partial-manual (manual over a SUBSET of mesh axes, auto over the rest)
+    landed in jax 0.8+; on older runtimes gpipe falls back to the pure-GSPMD
+    vmap formulation (correct, but the stage body cannot host Pallas calls).
+    """
+    try:
+        import inspect
+
+        from jax import shard_map
+    except ImportError:
+        return None
+    if "axis_names" not in inspect.signature(shard_map).parameters:
+        return None
+    return shard_map
 
 
 def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
@@ -93,9 +134,46 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
 
         return jax.lax.scan(one, hh, stage_layers)[0]
 
+    shmap = partial_manual_shard_map()
+    if shmap is not None:
+        # Partial-manual advance: manual over ONLY pp (local stage dim 1),
+        # auto over dp/fsdp/tp -- GSPMD partitions the body's einsums as
+        # usual, and the body may nest Pallas kernels under a second
+        # partial-manual shard_map (flash_attention_pp).  P(axis) pins just
+        # the leading (stage) dim; unmentioned dims stay auto.
+        def advance(layers_staged, state):
+            def body(local_layers, local_state):
+                hh = stage_apply(
+                    jax.tree.map(lambda x: x[0], local_layers),
+                    local_state[0])
+                return hh[None]
+
+            return shmap(body, mesh=mesh,
+                         in_specs=(jax.tree.map(lambda _: P(axis),
+                                                layers_staged), P(axis)),
+                         out_specs=P(axis),
+                         axis_names=frozenset({axis}),
+                         check_vma=False)(layers_staged, state)
+    else:
+        def advance(layers_staged, state):
+            # Every stage advances its resident microbatch; vmap over the
+            # stage dim keeps each stage's compute on its pp shard.
+            return jax.vmap(stage_apply)(layers_staged, state)
+
+    data_axes = tuple(a for a in ("dp", "fsdp")
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+    batch_axes = (data_axes if len(data_axes) > 1
+                  else (data_axes[0] if data_axes else None))
+
     def pin(x):
+        # State arrays are [S, mb, ...]: pin the stage dim to pp AND the
+        # microbatch dim to the data axes.  Leaving mb unconstrained lets
+        # GSPMD pick clashing layouts for the state's producer vs the
+        # stage body (observed: an involuntary full rematerialization of
+        # the [S, mb, T, D] carry at the scan boundary).
+        spec = P(axis, batch_axes, *([P.UNCONSTRAINED] * (x.ndim - 2)))
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, pp_spec(x.ndim)))
+            x, NamedSharding(mesh, spec))
 
     x_mb = h.reshape(M, mb, *h.shape[1:])
 
@@ -106,9 +184,8 @@ def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,
         t_in = jnp.clip(t, 0, M - 1)
         inj = jax.lax.dynamic_index_in_dim(x_mb, t_in, 0, keepdims=False)
         state = state.at[0].set(jnp.where(t < M, inj, state[0]))
-        # Every stage advances its resident microbatch by one stage block;
-        # vmap over the stage dim keeps each stage's compute on its shard.
-        state = jax.vmap(stage_apply)(layers_staged, state)
+        # Every stage advances its resident microbatch by one stage block.
+        state = advance(layers_staged, state)
         state = pin(state)
         # Stage S-1 just finished microbatch t - (S - 1).
         t_out = t - (S - 1)

@@ -57,7 +57,16 @@ def fit_spec(spec, shape, mesh):
         if entry is None:
             fitted.append(None)
             continue
-        names = entry if isinstance(entry, tuple) else (entry,)
+        # Axes a rule names but this mesh lacks are dropped (replicated
+        # there): one rule set stays valid across mesh layouts (e.g. a
+        # dp x sp mesh has no fsdp/tp axis).
+        names = tuple(n for n in
+                      (entry if isinstance(entry, tuple) else (entry,))
+                      if n in mesh.axis_names)
+        if not names:
+            fitted.append(None)
+            continue
+        entry = names if isinstance(entry, tuple) else names[0]
         size = math.prod(mesh.shape[n] for n in names)
         fitted.append(entry if size and shape[i] % size == 0 else None)
     return P(*fitted)

@@ -36,7 +36,9 @@ import time
 def main() -> int:
     from trainingjob_operator_tpu.workloads import rendezvous, train
 
+    t_main = time.time()
     rdv = rendezvous.initialize_jax_distributed()
+    t_init = time.time()
 
     import jax
     import numpy as np
@@ -50,9 +52,10 @@ def main() -> int:
         shard_pytree,
     )
 
-    cfg = (llama.LlamaConfig.llama2_7b()
-           if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
-           else llama.LlamaConfig.tiny())
+    cfg = {"7b": llama.LlamaConfig.llama2_7b,
+           "124m": llama.LlamaConfig.base_124m,
+           "tiny": llama.LlamaConfig.tiny}[
+               os.environ.get("LLAMA_CONFIG", "tiny")]()
     tp = int(os.environ.get("LLAMA_TP", "1"))
     sp = int(os.environ.get("LLAMA_SP", "1"))
     pp = int(os.environ.get("LLAMA_PP", "1"))
@@ -129,15 +132,24 @@ def main() -> int:
     # shards, and restore reshards onto the CURRENT (possibly narrower) mesh;
     # nothing is ever gathered to one host (7B + AdamW replicated is ~78 GB,
     # far beyond one v5e chip's 16 GB HBM).
+    t_setup = time.time()
     state = train.CheckpointState.restore_or_init(
         rdv, {"params": params, "opt_state": opt_state, "step": 0},
         subdir="llama", mesh=mesh)
+    t_restore = time.time()
     start_step = int(state.value["step"])
     params = state.value["params"]
     opt_state = state.value["opt_state"]
     if start_step > 0:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
+    # Recovery-phase breakdown (consumed by bench.py bench_recovery_big):
+    # init = JAX/distributed bring-up, setup = model init + sharding,
+    # restore = orbax read + reshard.  The remaining component -- first-step
+    # compile (compile-cache-sensitive) -- is printed by run_elastic_loop.
+    print(f"recovery_timing init_s={t_init - t_main:.2f} "
+          f"setup_s={t_setup - t_init:.2f} "
+          f"restore_s={t_restore - t_setup:.2f}", flush=True)
 
     params, opt_state, loss, t_start = train.run_elastic_loop(
         step_fn=step_fn, batch_at=batch_at, state=state, params=params,

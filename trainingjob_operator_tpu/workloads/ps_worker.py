@@ -17,8 +17,8 @@ of either group; the entrypoint dispatches on TRAININGJOB_REPLICA_NAME.
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import socket
 import struct
 import sys
@@ -33,21 +33,68 @@ WORKER_GROUP = "WORKER"
 
 
 # -- framing ----------------------------------------------------------------
+#
+# NON-EXECUTABLE wire format: a JSON metadata document plus raw array bytes
+# (frame = >II lengths | json | blobs).  Pickle framing would let any pod
+# that can reach the pserver port execute code in it (pickle.loads runs
+# arbitrary reduce callables); JSON + frombuffer can only produce dicts,
+# scalars and numeric arrays.  Array dtypes are whitelisted for the same
+# reason ("object" would re-open the door).
+
+_SAFE_DTYPES = frozenset(
+    f"{k}{n}" for k, sizes in (("float", (16, 32, 64)),
+                               ("int", (8, 16, 32, 64)),
+                               ("uint", (8, 16, 32, 64)))
+    for n in sizes) | {"bool"}
+
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
-    payload = pickle.dumps(obj)
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    arrays: List[np.ndarray] = []
+
+    def strip(x):
+        if isinstance(x, dict):
+            return {k: strip(v) for k, v in x.items()}
+        if isinstance(x, np.ndarray):
+            a = np.ascontiguousarray(x)
+            arrays.append(a)
+            return {"__nd__": len(arrays) - 1, "dtype": str(a.dtype),
+                    "shape": list(a.shape)}
+        if isinstance(x, (np.floating, np.integer)):
+            return x.item()
+        return x
+
+    meta = json.dumps(strip(obj)).encode()
+    blobs = b"".join(a.tobytes() for a in arrays)
+    sock.sendall(struct.pack(">II", len(meta), len(blobs)) + meta + blobs)
 
 
 def recv_msg(sock: socket.socket) -> Any:
-    header = _recv_exact(sock, 4)
+    header = _recv_exact(sock, 8)
     if header is None:
         return None
-    (length,) = struct.unpack(">I", header)
-    payload = _recv_exact(sock, length)
-    if payload is None:
+    meta_len, blob_len = struct.unpack(">II", header)
+    meta = _recv_exact(sock, meta_len)
+    blobs = _recv_exact(sock, blob_len) if blob_len else b""
+    if meta is None or blobs is None:
         return None
-    return pickle.loads(payload)
+    offsets = [0]  # filled in document order, matching send_msg's append order
+
+    def build(x):
+        if isinstance(x, dict) and "__nd__" in x:
+            dtype = str(x["dtype"])
+            if dtype not in _SAFE_DTYPES:
+                raise ValueError(f"refusing non-numeric dtype {dtype!r}")
+            shape = tuple(int(s) for s in x["shape"])
+            n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+            start = offsets[0]
+            offsets[0] = start + n
+            return np.frombuffer(
+                blobs[start:start + n], dtype=dtype).reshape(shape).copy()
+        if isinstance(x, dict):
+            return {k: build(v) for k, v in x.items()}
+        return x
+
+    return build(json.loads(meta))
 
 
 def _recv_exact(sock: socket.socket, n: int):

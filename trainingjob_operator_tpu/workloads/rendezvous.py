@@ -163,3 +163,29 @@ def apply_platform_override(var: str = "TRAININGJOB_JAX_PLATFORM") -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    configure_partitioner()
+
+
+def configure_partitioner() -> None:
+    """Select the SPMD partitioner (TRAININGJOB_SHARDY=1 opts back in to
+    Shardy; default is the classic GSPMD partitioner).
+
+    Measured on the 2-slice virtual multislice mesh (6 axes, this jax/XLA
+    build): Shardy emits "Involuntary full rematerialization"
+    (spmd_partitioner.cc:652) for a per-layer tensor at the backward scan
+    boundary -- a replicate-then-repartition on every step -- and the
+    rmsnorm cotangent pin (models/llama.py ``pin_act``) does not silence
+    it (it ADDS two more around the embedding gather).  The classic
+    partitioner with the same pin compiles the full train step with ZERO
+    involuntary remats, and the partial-manual shard_map pipeline path
+    passes its parity suite under it.  Flip the default once XLA's
+    b/433785288 (per the warning text) ships.
+    """
+    shardy = os.environ.get("TRAININGJOB_SHARDY", "")
+    if shardy not in ("1", "true"):
+        import jax
+
+        try:
+            jax.config.update("jax_use_shardy_partitioner", False)
+        except AttributeError:  # config knob gone (future jax): keep default
+            pass

@@ -290,11 +290,18 @@ _PEER_LOSS_MARKERS = (
 def looks_like_peer_loss(exc: BaseException) -> bool:
     """Match the exception and its EXPLICIT cause chain: orbax/asyncio wrap
     the underlying gRPC/Gloo error (``raise X from grpc_err``) and the
-    marker often lives only on the cause.  Implicit context
-    (``__context__``) is deliberately NOT followed: a deterministic local
-    bug raised while HANDLING a transport error would inherit the transport
-    marker and restart-loop forever instead of reaching the exit-code
-    policy as a failure."""
+    marker often lives only on the cause.
+
+    Implicit context (``__context__``) is followed only from a node that is
+    itself transport-shaped (OSError/ConnectionError/TimeoutError): library
+    code that re-raises inside an ``except`` block around a socket error
+    chains implicitly (no ``from``), and skipping that hop would classify a
+    genuine peer preemption as a local crash.  From any OTHER exception
+    type the implicit context is deliberately NOT followed -- a
+    deterministic local bug raised while HANDLING a transport error would
+    inherit the transport marker and restart-loop forever instead of
+    reaching the exit-code policy as a failure."""
+    io_shaped = (OSError, ConnectionError, TimeoutError)
     seen = set()
     node: Optional[BaseException] = exc
     while node is not None and id(node) not in seen:
@@ -302,7 +309,10 @@ def looks_like_peer_loss(exc: BaseException) -> bool:
         text = f"{type(node).__name__}: {node}".lower()
         if any(marker in text for marker in _PEER_LOSS_MARKERS):
             return True
-        node = node.__cause__
+        nxt = node.__cause__
+        if nxt is None and isinstance(node, io_shaped):
+            nxt = node.__context__
+        node = nxt
     return False
 
 
@@ -364,6 +374,7 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
     profiler = StepProfiler()
     loss = None
     t_start = None
+    t_loop = time.time()
     # One-step-ahead prefetch: batch_at(i) runs on a background thread while
     # step i-1 executes on the chip (batch_at ends in an async device_put,
     # so the host->HBM DMA overlaps compute too).
@@ -375,6 +386,11 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
             if i == start_step:
                 jax.block_until_ready(loss)
                 t_start = time.time()
+                # Trace + compile (compile-cache-sensitive) + one step:
+                # the last recovery component after llama_elastic's
+                # init/setup/restore breakdown.
+                print(f"recovery_timing first_step_s="
+                      f"{t_start - t_loop:.2f}", flush=True)
                 if start_step > 0:
                     print(f"step {i+1}/{steps} loss {float(loss):.4f} "
                           f"(first after resume)", flush=True)
@@ -452,16 +468,20 @@ def round_global_batch(global_batch: int, shards: int,
     width it is clamped down first so the global batch never exceeds the
     request -- a silently INFLATED batch changes the loss trajectory and
     HBM footprint behind the user's back.  When even one row per data shard
-    does not fit (batch < shards) this raises: there is no honest way to
-    run data-parallel with an empty shard.
+    does not fit (batch < shards) the batch is inflated to exactly one row
+    per shard, LOUDLY: an elastic scale-UP past the global batch must not
+    turn a running job into a crash loop at the new width (the restart
+    would re-derive the same width and die again).  Plan elastic max width
+    <= global batch to avoid the inflation entirely.
     """
     shards = max(shards, 1)
     accum = max(accum, 1)
     if global_batch < shards:
-        raise ValueError(
-            f"global batch {global_batch} < {shards} data shards: every "
-            f"shard needs at least one row; raise the batch or use fewer "
-            f"data shards")
+        print(f"WARNING: global batch {global_batch} < {shards} data "
+              f"shards; inflating to {shards} (one row per shard) -- the "
+              f"loss trajectory changes at this width. Keep elastic max "
+              f"width <= global batch to avoid this.", flush=True)
+        return shards, 1
     # Pick the accum <= requested that yields the LARGEST rounded batch (on
     # ties, the largest accum -- smallest microbatch HBM).  Merely clamping
     # accum to fit would deflate the batch at widths where a smaller accum
@@ -523,6 +543,15 @@ def build_batch_sources(*, prefix: str, vocab_size: int, global_batch: int,
         if not 0.0 < eval_frac < 1.0:
             raise ValueError(
                 f"{prefix}_EVAL_FRACTION={eval_frac} must be in (0, 1)")
+        if not data_path:
+            # The held-out rationale only holds for a file corpus: with the
+            # synthetic generator, "eval" is random tokens under a different
+            # key and the printed loss series is pure noise.
+            raise ValueError(
+                f"{prefix}_EVAL_EVERY={eval_every} without {prefix}_DATA: "
+                f"eval on the synthetic random-token stream measures "
+                f"nothing; point {prefix}_DATA at a .tokens corpus or "
+                f"disable eval")
     train_region = (0.0, 1.0 - eval_frac) if eval_every > 0 else (0.0, 1.0)
 
     ds = eval_ds = None
