@@ -422,3 +422,32 @@ class TestEvalRequiresCorpus:
                 prefix="LLAMA", vocab_size=256, global_batch=4,
                 local_batch=4, row0=0, seq=16, batch_sharding=None,
                 synthetic_key=17)
+
+
+class TestPSWireFormatHardening:
+    def test_rejects_negative_shape(self):
+        import json
+        import socket
+        import struct
+
+        meta = json.dumps({"x": {"__nd__": 0, "dtype": "float32",
+                                 "shape": [-1]}}).encode()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">II", len(meta), 0) + meta)
+            with pytest.raises(ValueError, match="negative shape"):
+                ps_worker.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestLlamaConfigDispatch:
+    def test_unknown_config_fails_loudly(self, monkeypatch, capsys):
+        from trainingjob_operator_tpu.workloads import llama_elastic
+
+        monkeypatch.setenv("LLAMA_CONFIG", "124M")  # typo'd case
+        monkeypatch.setenv("TRAININGJOB_JAX_PLATFORM", "cpu")
+        assert llama_elastic.main() == 1
+        out = capsys.readouterr().out
+        assert "unknown" in out and "124m" in out

@@ -142,7 +142,13 @@ class PodReconciler:
 
         # An in-flight re-expand probe provisions reservation slots beyond the
         # elastic width (non-destructive: the running group is untouched until
-        # the reservations actually schedule).
+        # the reservations actually schedule).  Cost note: a probe at full
+        # width idles up to slice_hosts TPU hosts for at most
+        # 4*scale_pending_time (the canary TTL).  A cheaper capacity signal
+        # -- a zero-TPU pod with the same nodeSelector, or cluster-autoscaler
+        # status -- would make re-expands free but cannot confirm the
+        # SPECIFIC slice topology schedules as a gang, which is the property
+        # the commit step needs; we pay for the stronger guarantee.
         probe_target = (job.status.scale_probes.get(rtype, 0)
                         if spec.edl_policy == EdlPolicy.AUTO else 0)
         pod_slices = get_slices(replica_pods, max(replicas, probe_target))

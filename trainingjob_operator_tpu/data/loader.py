@@ -22,8 +22,15 @@ _DONE = object()
 
 def _stall_timeout() -> float:
     """Seconds of consumer wait per warning cycle before declaring the
-    producer dead (TRAININGJOB_PREFETCH_STALL_S, default 300)."""
-    return float(os.environ.get("TRAININGJOB_PREFETCH_STALL_S", "300") or 300)
+    producer dead (TRAININGJOB_PREFETCH_STALL_S, default 300; floored at
+    0.1 s -- a zero/negative value would busy-spin the consumer or crash
+    queue.get)."""
+    try:
+        v = float(os.environ.get("TRAININGJOB_PREFETCH_STALL_S", "300")
+                  or 300)
+    except ValueError:
+        v = 300.0
+    return max(v, 0.1)
 
 
 class Prefetcher:
@@ -88,6 +95,14 @@ class Prefetcher:
             except queue.Empty:
                 waited += stall
                 if not self._thread.is_alive():
+                    # The producer may have enqueued its final item (or
+                    # _DONE) and exited between our timeout and this check:
+                    # drain once before declaring it dead.
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
                     raise RuntimeError(
                         f"prefetcher thread died after {waited:.0f} s wait "
                         f"(dataset IO crashed?)")

@@ -11,6 +11,10 @@ the multi-host program is byte-identical on every worker.
 Run: ``python -m trainingjob_operator_tpu.workloads.bert_pretrain``.
 Env: BERT_CONFIG=tiny|base, BERT_TP (model-parallel width, default 1),
 BERT_STEPS, BERT_BATCH (global), BERT_SEQ, BERT_LR.
+
+Data is SYNTHETIC (random MLM batches) by design: this workload proves the
+multi-host operator contract, not training quality; the real-corpus path is
+llama_elastic/moe_pretrain (``{P}_DATA``).
 """
 
 from __future__ import annotations

@@ -15,7 +15,8 @@ multislice DCN when present.  The global batch is kept constant across widths
 (per-process share rescales), so the loss trajectory is width-independent.
 
 Run: ``python -m trainingjob_operator_tpu.workloads.llama_elastic``.
-Env: LLAMA_CONFIG=tiny|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline stages),
+Env: LLAMA_CONFIG=tiny|124m|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline
+stages),
 LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
 (global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY, LLAMA_DATA (path to a
 ``.tokens`` corpus, data/tokens.py; default trains on synthetic tokens),
@@ -52,10 +53,16 @@ def main() -> int:
         shard_pytree,
     )
 
-    cfg = {"7b": llama.LlamaConfig.llama2_7b,
-           "124m": llama.LlamaConfig.base_124m,
-           "tiny": llama.LlamaConfig.tiny}[
-               os.environ.get("LLAMA_CONFIG", "tiny")]()
+    configs = {"7b": llama.LlamaConfig.llama2_7b,
+               "124m": llama.LlamaConfig.base_124m,
+               "tiny": llama.LlamaConfig.tiny}
+    cfg_name = os.environ.get("LLAMA_CONFIG", "tiny")
+    if cfg_name not in configs:
+        # A loud startup error, not a KeyError restart loop.
+        print(f"LLAMA_CONFIG={cfg_name!r} unknown; expected one of "
+              f"{sorted(configs)}", flush=True)
+        return 1
+    cfg = configs[cfg_name]()
     tp = int(os.environ.get("LLAMA_TP", "1"))
     sp = int(os.environ.get("LLAMA_SP", "1"))
     pp = int(os.environ.get("LLAMA_PP", "1"))

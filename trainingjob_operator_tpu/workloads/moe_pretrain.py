@@ -8,6 +8,7 @@ einsum carries the all-to-all on ICI (models/moe.py).
 
 Run: ``python -m trainingjob_operator_tpu.workloads.moe_pretrain``.
 Env: MOE_CONFIG=tiny|8x7b, MOE_TP, MOE_EP, MOE_STEPS, MOE_BATCH (global),
+MOE_CE_CHUNK (chunked cross-entropy), MOE_ROUTER_GROUP (grouped routing),
 MOE_SEQ, MOE_LR, MOE_CKPT_EVERY, plus the shared data/eval set
 (MOE_DATA, MOE_SEED, MOE_EVAL_EVERY/_BATCHES/_FRACTION --
 workloads/train.py build_batch_sources).
@@ -48,6 +49,12 @@ def main() -> int:
     lr = float(os.environ.get("MOE_LR", "3e-4"))
     ckpt_every = int(os.environ.get("MOE_CKPT_EVERY", "10"))
     remat = os.environ.get("MOE_REMAT", train.default_remat(cfg.n_layers))
+    ce_chunk = int(os.environ.get("MOE_CE_CHUNK", "0"))
+    router_group = int(os.environ.get("MOE_ROUTER_GROUP", "0"))
+    if router_group:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, router_group=router_group)
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, expert_parallel=ep)
     print(f"elastic width {rdv.elastic_replicas}, mesh "
@@ -70,7 +77,7 @@ def main() -> int:
     def step_fn(p, o, tokens):
         def loss(pp):
             return moe.loss_fn(pp, {"tokens": tokens}, cfg, mesh=mesh,
-                               remat=remat)
+                               remat=remat, ce_chunk=ce_chunk)
 
         l, grads = jax.value_and_grad(loss)(p)
         updates, o = tx.update(grads, o, p)
@@ -88,7 +95,9 @@ def main() -> int:
     if eval_batch_at is not None:
         @jax.jit
         def eval_loss(p, tokens):
-            return moe.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh)
+            # Same ce_chunk as training: eval must fit where training fits.
+            return moe.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
+                               ce_chunk=ce_chunk)
 
         eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at, eval_batches)
 

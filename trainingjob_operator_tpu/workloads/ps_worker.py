@@ -85,6 +85,10 @@ def recv_msg(sock: socket.socket) -> Any:
             if dtype not in _SAFE_DTYPES:
                 raise ValueError(f"refusing non-numeric dtype {dtype!r}")
             shape = tuple(int(s) for s in x["shape"])
+            if any(s < 0 for s in shape):
+                # A negative entry would slice blobs with a negative stop
+                # and silently desynchronize every later array's offset.
+                raise ValueError(f"refusing negative shape {shape}")
             n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
             start = offsets[0]
             offsets[0] = start + n

@@ -10,6 +10,12 @@ the same XLA fusions.
 Checkpoint/resume keyed on TRAININGJOB_REPLICA_RESTARTCOUNT (reference
 contract, pod.go:610-613).
 
+Data is SYNTHETIC (random images) by design: this workload proves
+config/operator parity for the reference's single-host DP shape, not
+training quality -- the real-input path lives in llama_elastic/moe_pretrain
+(``{P}_DATA`` + data/tokens.py).  Wire an image loader here only if you
+need accuracy numbers.
+
 Run: ``python -m trainingjob_operator_tpu.workloads.resnet_dp``.
 Env: RESNET_CONFIG=tiny|resnet50, RESNET_STEPS, RESNET_BATCH (global),
 RESNET_LR.

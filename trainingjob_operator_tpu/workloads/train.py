@@ -310,7 +310,11 @@ def looks_like_peer_loss(exc: BaseException) -> bool:
         if any(marker in text for marker in _PEER_LOSS_MARKERS):
             return True
         nxt = node.__cause__
-        if nxt is None and isinstance(node, io_shaped):
+        if (nxt is None and isinstance(node, io_shaped)
+                and not node.__suppress_context__):
+            # `raise X from None` sets __suppress_context__: the raiser
+            # explicitly disclaimed the context -- honor that, or a
+            # deterministic local bug would restart-loop as 143 again.
             nxt = node.__context__
         node = nxt
     return False
