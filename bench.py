@@ -93,14 +93,17 @@ def _timed_loop(step, params, opt, tokens, steps, min_plausible_s=0.0):
     return t_b  # longer run: better amortization of host overhead
 
 
-def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0,
-                 remat=True):
+def _timed_train(model, cfg, batch, seq, steps, donate=True,
+                 min_plausible_s=0.0, remat=True):
+    """One timing rig for every model family: identical optimizer, ce_chunk
+    handling, and fence protocol, so the llama and moe numbers stay
+    comparable by construction.  ``model`` is the family module (must
+    expose ``init_params`` and ``loss_fn(params, batch, cfg, remat=,
+    ce_chunk=)``)."""
     import jax
     import optax
 
-    from trainingjob_operator_tpu.models import llama
-
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    params = model.init_params(cfg, jax.random.PRNGKey(0))
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     opt = tx.init(params)
 
@@ -108,17 +111,23 @@ def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0,
 
     @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(p, o, tokens):
-        def loss(pp):
-            return llama.loss_fn(pp, {"tokens": tokens}, cfg, remat=remat,
-                                 ce_chunk=ce_chunk)
-
-        l, grads = jax.value_and_grad(loss)(p)
+        l, grads = jax.value_and_grad(
+            lambda pp: model.loss_fn(pp, {"tokens": tokens}, cfg,
+                                     remat=remat, ce_chunk=ce_chunk))(p)
         updates, o2 = tx.update(grads, o, p)
         return optax.apply_updates(p, updates), o2, l
 
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
                                 cfg.vocab_size)
     return _timed_loop(step, params, opt, tokens, steps, min_plausible_s)
+
+
+def _timed_steps(cfg, batch, seq, steps, donate=True, min_plausible_s=0.0,
+                 remat=True):
+    from trainingjob_operator_tpu.models import llama
+
+    return _timed_train(llama, cfg, batch, seq, steps, donate=donate,
+                        min_plausible_s=min_plausible_s, remat=remat)
 
 
 def moe_train_flops_per_step(cfg, batch: int, seq: int) -> float:
@@ -135,26 +144,10 @@ def moe_train_flops_per_step(cfg, batch: int, seq: int) -> float:
 
 def _timed_steps_moe(cfg, batch, seq, steps, min_plausible_s=0.0,
                      remat=True):
-    import jax
-    import optax
-
     from trainingjob_operator_tpu.models import moe
 
-    params = moe.init_params(cfg, jax.random.PRNGKey(0))
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt = tx.init(params)
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(p, o, tokens):
-        l, grads = jax.value_and_grad(
-            lambda pp: moe.loss_fn(pp, {"tokens": tokens}, cfg,
-                                   remat=remat))(p)
-        updates, o2 = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o2, l
-
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
-                                cfg.vocab_size)
-    return _timed_loop(step, params, opt, tokens, steps, min_plausible_s)
+    return _timed_train(moe, cfg, batch, seq, steps,
+                        min_plausible_s=min_plausible_s, remat=remat)
 
 
 def bench_train():
@@ -635,7 +628,7 @@ def bench_recovery_124m():
             env=env, capture_output=True, text=True, timeout=timeout)
         if proc.returncode != 0:
             raise RuntimeError(f"llama_elastic rc={proc.returncode}: "
-                               f"{proc.stdout[-300:]}")
+                               f"{(proc.stderr or proc.stdout)[-300:]}")
         comp = dict(re.findall(r"(\w+_s)=([0-9.]+)", proc.stdout))
         return time.perf_counter() - t0, {k: float(v) for k, v in
                                           comp.items()}
