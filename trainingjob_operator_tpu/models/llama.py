@@ -67,7 +67,13 @@ class LlamaConfig:
 def sharding_rules(pipeline: bool = False):
     lead = "pp" if pipeline else None
     return [
-        (r"tok_embed", ("tp", "fsdp")),
+        # Embedding table: vocab over tp x fsdp, D REPLICATED.  Sharding D
+        # makes every lookup inherit a D-sharded layout that the partitioner
+        # can only reshard to the activation layout by replicate-then-
+        # repartition (involuntary full remat; measured on the sp mesh 2 vs
+        # 0).  Sharding the vocab dim over both axes keeps the same bytes
+        # per device with a gather XLA partitions cleanly.
+        (r"tok_embed", (("tp", "fsdp"), None)),
         (r"lm_head", ("fsdp", "tp")),
         (r"attn/w[qkv]$", (lead, "fsdp", "tp")),
         (r"attn/wo$", (lead, "tp", "fsdp")),
@@ -155,13 +161,14 @@ def _remat_wrap(block, remat):
 
     ``remat`` is False/"none" (save everything), True/"full" (save only the
     layer boundary; backward re-runs the whole layer, +~1/3 model FLOPs), or
-    "attn" (additionally save the flash kernel's residuals, tagged
-    ``attn_out`` in ops/flash_attention.py ``_flash_fwd`` -- the backward
-    skips re-running the quadratic attention forward, the dominant
-    recompute, at ~one extra [B, T, D] tensor + lse per layer of HBM; the
-    ring-attention sp path has no such tag and degrades to "full"
-    behavior).  "dots" saves every no-batch-dim matmul output (cheapest
-    compute, largest HBM; only fits smaller configs).
+    "attn" (additionally save the attention residuals, tagged ``attn_out``
+    in ops/flash_attention.py ``_flash_fwd`` AND
+    parallel/ringattention.py ``_ring_fwd`` -- the backward skips
+    re-running the quadratic attention forward, the dominant recompute,
+    at ~one extra [B, T, D] tensor + lse per layer of HBM; on the sp path
+    it also skips the ring's ppermute rounds).  "dots" saves every
+    no-batch-dim matmul output (cheapest compute, largest HBM; only fits
+    smaller configs).
     """
     import jax
 
@@ -246,39 +253,18 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
     pipelined = (mesh is not None and "pp" in mesh.axis_names
                  and mesh.shape["pp"] > 1)
 
-    # Pre-cast the stacked matmul weights to the compute dtype OURSELVES,
-    # with an explicit sharding anchor.  XLA hoists the per-layer
-    # ``astype`` out of the scan anyway, but the hoisted stacked bf16
-    # tensor then carries no user sharding, and on many-axis meshes the
-    # SPMD partitioner can choose CLASHING shardings for its forward and
-    # backward-scan uses -- the "Involuntary full rematerialization"
-    # warning (spmd_partitioner.cc:652) seen on the multislice mesh.  The
-    # in-body ``astype(compute)`` calls below become no-ops.  Norm scales
-    # stay f32 (rmsnorm computes in f32).
+    # Pre-cast the stacked matmul weights to the compute dtype with
+    # explicit sharding anchors (parallel/sharding.py precast_weights:
+    # prevents the partitioner's involuntary full rematerialization of the
+    # hoisted bf16 casts on many-axis meshes).  The in-body
+    # ``astype(compute)`` calls below become no-ops; norm scales stay f32.
     layers = params["layers"]
     if mesh is not None:
-        import re as _re
-
-        from jax.sharding import NamedSharding
-
         from trainingjob_operator_tpu.parallel.sharding import (
-            fit_spec,
-            path_of,
-            spec_for_path,
-        )
+            precast_weights)
 
-        rules = sharding_rules(pipeline=pipelined)
-
-        def _cast(kp, x):
-            path = "layers/" + path_of(kp)
-            if not _re.search(r"attn/w|mlp/w_", path):
-                return x
-            y = x.astype(compute)
-            return jax.lax.with_sharding_constraint(
-                y, NamedSharding(mesh, fit_spec(
-                    spec_for_path(path, rules), y.shape, mesh)))
-
-        layers = jax.tree_util.tree_map_with_path(_cast, layers)
+        layers = precast_weights(layers, sharding_rules(pipeline=pipelined),
+                                 mesh, compute, r"attn/w|mlp/w_")
 
     def attn(h, layer):
         # Shapes from h, not the captured globals: inside the pp pipeline
@@ -337,26 +323,22 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
         return (gate * up) @ layer["mlp"]["w_down"].astype(compute)
 
     def pin_act(y):
-        # Pin normed activations to the canonical batch sharding.  The
-        # constraint also applies to the COTANGENT in the backward (its
-        # transpose is itself), which keeps rmsnorm's custom-vjp backward
-        # sharding-consistent: without it the incoming grad arrives
-        # tp-sharded on D from the matmul backward while the saved stats
-        # are batch-sharded, and the partitioner resolves the clash with an
-        # involuntary full rematerialization (replicate-and-repartition;
-        # observed on the 6-axis multislice mesh, spmd_partitioner.cc:652).
+        # Pin normed activations (and, via the transpose, their cotangents)
+        # to the batch sharding -- keeps rmsnorm's custom-vjp backward
+        # sharding-consistent (parallel/sharding.py pin_batch_act).
         # Skipped under pp: the stage body runs in a partial-manual
         # shard_map where a concrete-mesh NamedSharding cannot appear.
         if mesh is None or pipelined:
             return y
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from trainingjob_operator_tpu.parallel.sharding import pin_batch_act
 
-        data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-        batch = data if len(data) > 1 else (data[0] if data else None)
-        seq = ("sp" if sequence_parallel and "sp" in mesh.axis_names
-               else None)
-        return jax.lax.with_sharding_constraint(
-            y, NamedSharding(mesh, P(batch, seq, None)))
+        return pin_batch_act(y, mesh, sequence_parallel=sequence_parallel)
+
+    # The embedding gather inherits the TABLE's sharding (D over fsdp from
+    # the (tp, fsdp) vocab layout); pin the result to the activation layout
+    # up front or the partitioner full-remats the transition (observed on
+    # the sp mesh: replicate-then-repartition of the [B, T, D] embed).
+    h = pin_act(h)
 
     def block(h, layer):
         a, kv = attn(pin_act(_rmsnorm(h, layer["attn_norm"], c.norm_eps)),

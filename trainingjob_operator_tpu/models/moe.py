@@ -73,7 +73,9 @@ class MoEConfig:
 #: Expert dim rides ``ep``; within an expert the matmul dims keep the
 #: Megatron fsdp/tp layout.  Attention/embedding rules match llama's.
 SHARDING_RULES = [
-    (r"tok_embed", ("tp", "fsdp")),
+    # Vocab over tp x fsdp, D replicated (models/llama.py sharding_rules:
+    # a D-sharded table forces an involuntary full remat of every lookup).
+    (r"tok_embed", (("tp", "fsdp"), None)),
     (r"lm_head", ("fsdp", "tp")),
     (r"attn/w[qkv]$", (None, "fsdp", "tp")),
     (r"attn/wo$", (None, "tp", "fsdp")),
@@ -241,6 +243,30 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
     h = params["tok_embed"].astype(compute)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
+    # Same partitioner hygiene as the Llama family (measured there to
+    # eliminate involuntary full rematerializations on many-axis meshes):
+    # pre-cast matmul weights with sharding anchors and pin normed
+    # activations + their cotangents to the batch sharding.  The router
+    # stays f32 (routing decisions are precision-sensitive).
+    layers = params["layers"]
+    if mesh is not None:
+        from trainingjob_operator_tpu.parallel.sharding import (
+            pin_batch_act,
+            precast_weights,
+        )
+
+        layers = precast_weights(layers, SHARDING_RULES, mesh, compute,
+                                 r"attn/w|moe/w_(gate|up|down)")
+
+        def pin_act(y):
+            return pin_batch_act(y, mesh)
+    else:
+        def pin_act(y):
+            return y
+    # Pin the embedding output to the activation layout (the gather
+    # inherits the table's (tp, fsdp) sharding; see models/llama.py).
+    h = pin_act(h)
+
     def attn(h, layer):
         q = h @ layer["attn"]["wq"].astype(compute)
         k = h @ layer["attn"]["wk"].astype(compute)
@@ -264,18 +290,17 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
 
     def block(carry, layer):
         h, aux = carry
-        h = h + attn(_llama._rmsnorm(h, layer["attn_norm"], c.norm_eps),
-                     layer)
+        h = h + attn(pin_act(_llama._rmsnorm(h, layer["attn_norm"],
+                                             c.norm_eps)), layer)
         y, layer_aux = _moe_mlp(
-            _llama._rmsnorm(h, layer["moe_norm"], c.norm_eps), layer, c,
-            compute)
+            pin_act(_llama._rmsnorm(h, layer["moe_norm"], c.norm_eps)),
+            layer, c, compute)
         return (h + y, aux + layer_aux), None
 
     # Same policy surface as the Llama family (bool or "full"/"attn"/
     # "dots"/"none"; _remat_wrap docs the trade-offs).
     block = _llama._remat_wrap(block, remat)
-    (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)),
-                               params["layers"])
+    (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)), layers)
     h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
     if return_hidden:
         return h, aux / c.n_layers

@@ -17,6 +17,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import jax
+
 NEG_INF = -1e30
 
 
@@ -33,16 +35,10 @@ def _block_attn(q, k, v, m, l, o, scale, mask):
     Tk, Hkv = k.shape[1], k.shape[2]
     # Softmax statistics in float32 regardless of compute dtype (the flash-
     # attention convention): bf16 max/exp/sum loses enough precision over long
-    # sequences to move the training loss.
-    if Hq != Hkv:
-        g = Hq // Hkv
-        qg = q.reshape(B, Tq, Hkv, g, D)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
-                       preferred_element_type=jnp.float32)
-        s = s.reshape(B, Hq, Tq, Tk) * scale
-    else:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * scale
+    # sequences to move the training loss.  THE same score function as the
+    # custom backward (_ring_bwd) -- forward lse and backward probabilities
+    # must come from identical math.
+    s = _scores_gqa(q, k, scale)
     if mask is not None:
         s = jnp.where(mask[None, None, :, :], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -64,13 +60,37 @@ def _block_attn(q, k, v, m, l, o, scale, mask):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None):
-    """Exact attention over a sequence-sharded axis.  Call inside shard_map.
+def _block_mask(my, kv_idx, T, causal: bool):
+    """[Tq, Tk] causal mask between the local q block and kv block
+    ``kv_idx`` (None when not causal)."""
+    import jax.numpy as jnp
 
-    q, k, v: [B, T_local, H, D] -- the local sequence block.
-    Returns [B, T_local, H, D].
-    """
+    if not causal:
+        return None
+    base = jnp.arange(T)
+    q_pos = my * T + base[:, None]
+    k_pos = kv_idx * T + base[None, :]
+    return k_pos <= q_pos
+
+
+def _scores_gqa(q, k, scale):
+    """f32 scores [B, Hq, Tq, Tk] for q [B,Tq,Hq,D] vs k [B,Tk,Hkv,D]."""
+    import jax.numpy as jnp
+
+    B, Tq, Hq, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if Hq != Hkv:
+        g = Hq // Hkv
+        qg = q.reshape(B, Tq, Hkv, g, D)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32)
+        return s.reshape(B, Hq, Tq, Tk) * scale
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale):
+    """(out [B,T,H,D], lse [B,H,T] f32) -- the forward ring pass."""
     import jax
     import jax.numpy as jnp
 
@@ -79,26 +99,16 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     sp = collectives.axis_size(axis_name)
     my = collectives.axis_index(axis_name)
     B, T, H, D = q.shape
-    scale = scale if scale is not None else D ** -0.5
 
     # f32 accumulators (softmax stats + output) independent of compute dtype.
     m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
 
-    base = jnp.arange(T)
-
     def step(s, carry):
         m, l, o, k_cur, v_cur = carry
         kv_idx = (my - s) % sp
-        if causal:
-            # Block-level: attend iff kv block is at or before ours; diagonal
-            # block applies the in-block causal mask.
-            q_pos = my * T + base[:, None]
-            k_pos = kv_idx * T + base[None, :]
-            mask = k_pos <= q_pos
-        else:
-            mask = None
+        mask = _block_mask(my, kv_idx, T, causal)
         m, l, o = _block_attn(q, k_cur, v_cur, m, l, o, scale, mask)
         # GQA: the ring rotates the narrow [.., Hkv, D] blocks -- ICI bytes
         # scale with kv heads, not query heads.
@@ -108,7 +118,99 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
 
     m, l, o, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, o0, k, v))
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / denom).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return (o / denom).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact attention over a sequence-sharded axis.  Call inside shard_map.
+
+    q, k, v: [B, T_local, H, D] -- the local sequence block.
+    Returns [B, T_local, H, D].
+
+    Differentiable via a CUSTOM ring backward: a second ring pass
+    recomputes blockwise probabilities from the saved per-row log-sum-exp,
+    with dK/dV riding the rotating KV blocks home -- residual memory is
+    O(T/sp) (q, k, v, out, lse), never the per-step [B, H, Tl, Tl] score
+    tensors plain autodiff-through-the-loop would save.  The (out, lse)
+    residuals carry the ``attn_out`` remat anchors, so the "attn" policy
+    (models/llama.py _remat_wrap) skips re-running the whole ring --
+    including its sp ppermute rounds -- in the layer backward.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ring_forward(q, k, v, axis_name, causal, scale)[0]
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    from jax.ad_checkpoint import checkpoint_name
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_out")
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, g):
+    """Ring backward: dQ accumulates locally; dK/dV travel with their KV
+    blocks through the full ring and arrive home after sp hops."""
+    import jax
+    import jax.numpy as jnp
+
+    from trainingjob_operator_tpu.parallel import collectives
+
+    q, k, v, out, lse = res
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    sp = collectives.axis_size(axis_name)
+    my = collectives.axis_index(axis_name)
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    grp = Hq // Hkv
+
+    gf = g.astype(jnp.float32)
+    # delta = rowsum(dO * O) per query row, [B, Hq, T] (matches lse layout).
+    delta = (gf * out.astype(jnp.float32)).sum(-1).transpose(0, 2, 1)
+    # Loop invariants, hoisted: the head-grouped views of dO and Q.
+    gg = gf.reshape(B, T, Hkv, grp, D)
+    qg = q.astype(jnp.float32).reshape(B, T, Hkv, grp, D)
+
+    def step(s, carry):
+        dq, k_cur, v_cur, dk, dv = carry
+        kv_idx = (my - s) % sp
+        mask = _block_mask(my, kv_idx, T, causal)
+        z = _scores_gqa(q, k_cur, scale)                 # [B,Hq,Tq,Tk] f32
+        if mask is not None:
+            # Mask BEFORE the exp (as in the forward): a masked raw score
+            # above lse would overflow the exp before being zeroed.
+            z = jnp.where(mask[None, None], z, NEG_INF)
+        p = jnp.exp(z - lse[..., None])
+        # dp = dO @ V^T, grouped form (exact for grp == 1 too).
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", gg, v_cur,
+                        preferred_element_type=jnp.float32)
+        dp = dp.reshape(B, Hq, T, -1)
+        dz = p * (dp - delta[..., None]) * scale         # [B,Hq,Tq,Tk]
+        dzg = dz.reshape(B, Hkv, grp, T, -1)
+        pg = p.reshape(B, Hkv, grp, T, -1)
+        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", dzg,
+                             k_cur.astype(jnp.float32)).reshape(B, T, Hq, D)
+        dk = dk + jnp.einsum("bhgqk,bqhgd->bkhd", dzg, qg)
+        dv = dv + jnp.einsum("bhgqk,bqhgd->bkhd", pg, gg)
+        k_nxt = collectives.ppermute_next(k_cur, axis_name, sp)
+        v_nxt = collectives.ppermute_next(v_cur, axis_name, sp)
+        dk_nxt = collectives.ppermute_next(dk, axis_name, sp)
+        dv_nxt = collectives.ppermute_next(dv, axis_name, sp)
+        return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+    zero_q = jnp.zeros(q.shape, jnp.float32)
+    zero_kv = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, sp, step, (zero_q, k, v, zero_kv, zero_kv))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
@@ -134,10 +236,21 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
 
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     batch = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
-    spec = P(batch, axis_name, None, None)
+    # Heads ride tp when they tile it: attention is head-independent, so
+    # the ring runs per tp shard on its own head block -- no tp all-gather
+    # of q/k/v at the shard_map boundary, and the rotating KV blocks carry
+    # 1/tp of the bytes.  (Contiguous head blocks keep the GQA query->kv
+    # mapping local, as in flash_attention_sharded.)
+    tp = "tp" if "tp" in mesh.axis_names else None
+    if tp:
+        ntp = mesh.shape[tp]
+        if q.shape[2] % ntp or k.shape[2] % ntp:
+            tp = None
+    spec = P(batch, axis_name, tp, None)
 
+    # Positional call: custom_vjp functions reject keyword arguments.
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        lambda a, b, c: ring_attention(a, b, c, axis_name, causal, None),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **compat)
     return fn(q, k, v)
 

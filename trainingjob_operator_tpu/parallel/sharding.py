@@ -115,3 +115,49 @@ def constrain(x, mesh, *spec):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def precast_weights(layers: Any, rules: Rules, mesh, compute,
+                    pattern: str, prefix: str = "layers/") -> Any:
+    """Cast matmul weights to the compute dtype with explicit sharding
+    anchors (leaves whose path matches ``pattern``; others untouched).
+
+    XLA hoists per-layer ``astype`` casts out of the layer scan anyway, but
+    the hoisted stacked bf16 tensor then carries no user sharding, and on
+    many-axis meshes the SPMD partitioner can choose CLASHING shardings for
+    its forward and backward-scan uses -- an "Involuntary full
+    rematerialization" (replicate-then-repartition every step).  Doing the
+    cast up front under ``with_sharding_constraint`` anchors it; the
+    in-body casts become no-ops.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    def cast(kp, x):
+        path = prefix + path_of(kp)
+        if not re.search(pattern, path):
+            return x
+        y = x.astype(compute)
+        return jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, fit_spec(
+                spec_for_path(path, rules), y.shape, mesh)))
+
+    return jax.tree_util.tree_map_with_path(cast, layers)
+
+
+def pin_batch_act(y, mesh, *, sequence_parallel: bool = False):
+    """Pin a [B, T, ...] activation to the canonical batch sharding.
+
+    Also constrains the COTANGENT in the backward (the constraint is its
+    own transpose), which keeps custom-vjp backward passes (rmsnorm, flash
+    attention) sharding-consistent: without it the incoming grad can
+    arrive tp-sharded on the model dim against batch-sharded saved stats
+    and the partitioner resolves the clash with an involuntary full
+    rematerialization.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    # Same canonical layout as the input batches (trailing dims replicate).
+    spec = batch_spec(mesh, sequence_axis=sequence_parallel)
+    return jax.lax.with_sharding_constraint(y, NamedSharding(mesh, spec))

@@ -100,7 +100,11 @@ def main() -> int:
     params = shard_pytree(params, llama.sharding_rules(pipeline=pp > 1), mesh)
     tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = tx.init(params)
-    batch_sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=use_sp))
+    # Tokens are [B, seq+1] (targets shifted by one): the odd length cannot
+    # shard over sp, so the raw int tokens stay batch-sharded only -- GSPMD
+    # reshards the [B, T, D] activations onto sp at the ring attention's
+    # shard_map boundary, where the sequence split actually matters.
+    batch_sharding = NamedSharding(mesh, batch_spec(mesh))
 
     @jax.jit
     def step_fn(p, o, tokens):
