@@ -32,8 +32,14 @@ def apply_jax_platform_override():
     apply_platform_override(var="JAX_PLATFORMS")
 
 
-def wait_for(pred, timeout=15.0, interval=0.02):
-    """Poll until pred() is truthy; shared by the e2e suites."""
+def wait_for(pred, timeout=45.0, interval=0.02):
+    """Poll until pred() is truthy; shared by the e2e suites.
+
+    The default is sized for a LOADED single-core host (this box has
+    nproc=1; a concurrent compile starves subprocess pods for tens of
+    seconds -- a 15 s deadline produced load-induced flakes).  The happy
+    path returns at the first poll after the transition, so a generous
+    ceiling costs idle runs nothing."""
     deadline = _time.time() + timeout
     while _time.time() < deadline:
         if pred():

@@ -1,8 +1,11 @@
-"""On-chip perf sweep for the bench config: remat policy x flash block sizes.
+"""On-chip perf sweep: remat policy x flash blocks x ce-chunk (Llama),
+MoE router-group sizes, and decode throughput -- the full on-chip record in
+one command.
 
 Run on the real TPU (no args):  python tools/tune_perf.py
-Prints one line per variant -- ms/step and MFU -- and a final WINNER line.
-The winning settings get baked into bench.py / workloads as defaults.
+Prints one line per variant -- ms/step and MFU -- a WINNER line for the
+Llama leg, then moe_group and decode lines.  The winning settings get
+baked into bench.py / workloads as defaults.
 
 Reuses bench.py's _timed_steps so every trial inherits its guards: the
 forced device-to-host fence (jax.block_until_ready does not wait on this
@@ -83,6 +86,37 @@ def main():
     print(json.dumps({"winner": tag, "batch": b,
                       "step_ms": round(t * 1e3, 1),
                       "mfu_pct": round(mfu, 1)}), flush=True)
+
+    # 4) MoE router-group sweep at the bench MoE config (active-params MFU
+    # basis) and the serving-side decode numbers -- the rest of the on-chip
+    # record (VERDICT r4 #3/#6), printed as labeled JSON lines.
+    import dataclasses
+
+    from bench import _timed_steps_moe, bench_decode, moe_train_flops_per_step
+    from trainingjob_operator_tpu.models import moe as moe_mod
+
+    moe_cfg = moe_mod.MoEConfig(vocab_size=32000, dim=1024, n_layers=6,
+                                n_heads=16, n_kv_heads=8, ffn_dim=2816,
+                                n_experts=8, experts_per_token=2,
+                                max_seq_len=2048)
+    mb, mseq = 8, 2048
+    mflops = moe_train_flops_per_step(moe_cfg, mb, mseq)
+    for group in (256, 512, 1024, 0):
+        cfg_g = dataclasses.replace(moe_cfg, router_group=group)
+        try:
+            t = _timed_steps_moe(cfg_g, mb, mseq, steps=3, remat="attn",
+                                 min_plausible_s=mflops / peak)
+            print(json.dumps({"moe_group": group,
+                              "step_ms": round(t * 1e3, 1),
+                              "mfu_pct": round(
+                                  mflops / t / peak * 100, 1)}), flush=True)
+        except Exception as exc:
+            print(json.dumps({"moe_group": group,
+                              "error": type(exc).__name__}), flush=True)
+    try:
+        print(json.dumps({"decode": bench_decode(True)}), flush=True)
+    except Exception as exc:
+        print(json.dumps({"decode_error": type(exc).__name__}), flush=True)
 
 
 if __name__ == "__main__":
