@@ -340,16 +340,17 @@ def bench_decode(on_tpu: bool):
                                     (batch, prompt_len), 0, cfg.vocab_size)
         max_len = prompt_len + s_b
 
-        @functools.partial(jax.jit, static_argnums=(2,))
-        def gen(p, t, steps):
-            return decode.generate(p, t, cfg, steps=steps, max_len=max_len)
+        @functools.partial(jax.jit, static_argnums=(2, 3))
+        def gen(p, t, steps, quantize):
+            return decode.generate(p, t, cfg, steps=steps, max_len=max_len,
+                                   quantize=quantize)
 
-        def timed(steps, reps=3):
-            np.asarray(gen(params, prompt, steps))  # compile + fence
+        def timed(steps, quantize=False, reps=3):
+            np.asarray(gen(params, prompt, steps, quantize))  # compile+fence
             best = float("inf")
             for _ in range(reps):
                 t0 = time.perf_counter()
-                np.asarray(gen(params, prompt, steps))
+                np.asarray(gen(params, prompt, steps, quantize))
                 best = min(best, time.perf_counter() - t0)
             return best
 
@@ -360,12 +361,25 @@ def bench_decode(on_tpu: bool):
             out[f"batch{batch}"] = {"error": "decode timing not scaling "
                                              "with step count"}
             continue
-        out[f"batch{batch}"] = {
+        leg = {
             "prompt_len": prompt_len,
             "prefill_tokens_per_s": round(batch * prompt_len / prefill_s),
             "decode_ms_per_token": round(per_tok * 1e3, 2),
             "decode_tokens_per_s": round(batch / per_tok),
         }
+        # Weight-only int8 A/B (models/quant.py): decode streams every
+        # weight per token, so int8 halves the HBM bytes that bound it.
+        try:
+            q_a, q_b = timed(s_a, quantize=True), timed(s_b, quantize=True)
+            q_tok = (q_b - q_a) / (s_b - s_a)
+            if q_tok > 0:
+                leg["decode_ms_per_token_int8"] = round(q_tok * 1e3, 2)
+                leg["int8_speedup"] = round(per_tok / q_tok, 3)
+            else:
+                leg["int8_error"] = "timing not scaling with step count"
+        except Exception as exc:
+            leg["int8_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+        out[f"batch{batch}"] = leg
     return out
 
 

@@ -86,25 +86,36 @@ def prefill(params, tokens, config: llama.LlamaConfig, max_len: int, *,
 def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
                 mesh=None):
     """One token [B] at position ``t`` (scalar) -> (logits [B, vocab],
-    updated cache)."""
+    updated cache).
+
+    ``params`` may carry weight-only int8 leaves (models/quant.py
+    ``quantize_weights``): decode streams every weight per token, so int8
+    halves the HBM bytes that bound decode throughput; ``_w`` resolves
+    either form and XLA fuses the dequant into the matmul operand read.
+    """
     import jax
     import jax.numpy as jnp
+
+    from trainingjob_operator_tpu.models.quant import (
+        dequantize as _w,
+        dequantize_rows,
+    )
 
     c = config
     compute = jnp.dtype(c.dtype)
     B = token.shape[0]
     group = c.n_heads // c.n_kv_heads
-    h = params["tok_embed"].astype(compute)[token][:, None, :]  # [B,1,D]
+    h = dequantize_rows(params["tok_embed"], token, compute)[:, None, :]
     pos = jnp.broadcast_to(t[None, None], (B, 1))
 
     def layer_step(h, inputs):
         layer, k_cache, v_cache = inputs
         x = llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
-        q = (x @ layer["attn"]["wq"].astype(compute)).reshape(
+        q = (x @ _w(layer["attn"]["wq"], compute)).reshape(
             B, 1, c.n_heads, c.head_dim)
-        k = (x @ layer["attn"]["wk"].astype(compute)).reshape(
+        k = (x @ _w(layer["attn"]["wk"], compute)).reshape(
             B, 1, c.n_kv_heads, c.head_dim)
-        v = (x @ layer["attn"]["wv"].astype(compute)).reshape(
+        v = (x @ _w(layer["attn"]["wv"], compute)).reshape(
             B, 1, c.n_kv_heads, c.head_dim)
         q = llama._rope(q, pos, c.rope_theta)
         k = llama._rope(k, pos, c.rope_theta)
@@ -113,17 +124,17 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
         o = _attend_cache(q, k_cache, v_cache, t, group).astype(compute)
-        h = h + o.reshape(B, 1, c.dim) @ layer["attn"]["wo"].astype(compute)
+        h = h + o.reshape(B, 1, c.dim) @ _w(layer["attn"]["wo"], compute)
         x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
-        gate = jax.nn.silu(x @ layer["mlp"]["w_gate"].astype(compute))
-        up = x @ layer["mlp"]["w_up"].astype(compute)
-        h = h + (gate * up) @ layer["mlp"]["w_down"].astype(compute)
+        gate = jax.nn.silu(x @ _w(layer["mlp"]["w_gate"], compute))
+        up = x @ _w(layer["mlp"]["w_up"], compute)
+        h = h + (gate * up) @ _w(layer["mlp"]["w_down"], compute)
         return h, (k_cache, v_cache)
 
     h, (k_new, v_new) = jax.lax.scan(
         layer_step, h, (params["layers"], cache["k"], cache["v"]))
     h = llama._rmsnorm(h, params["final_norm"], c.norm_eps)
-    logits = (h[:, 0, :] @ params["lm_head"].astype(compute))
+    logits = (h[:, 0, :] @ _w(params["lm_head"], compute))
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
@@ -153,13 +164,22 @@ def _mask_logits(logits, top_k: int, top_p: float):
 
 def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             top_k: int = 0, top_p: float = 0.0, key=None, mesh=None):
+             top_k: int = 0, top_p: float = 0.0, key=None, mesh=None,
+             quantize: bool = False):
     """Sample ``steps`` tokens after ``prompt`` [B, T]; returns [B, steps].
 
     ``temperature`` 0 is greedy (argmax); otherwise requires ``key``, and
     ``top_k``/``top_p`` optionally restrict the sampling support (both may
     be combined; applied in that order).  The whole generation is one
     jit-able computation: prefill + ``lax.scan`` over decode steps.
+
+    ``quantize`` runs the decode loop on weight-only int8 (models/quant.py)
+    -- decode streams every weight per token, so int8 halves the HBM bytes
+    that bound its throughput.  Prefill stays full-precision (one
+    compute-bound pass over the prompt; also the KV cache source).  For a
+    serving deployment that must also drop the fp weights from HBM, call
+    ``quantize_weights`` once at load and pass the quantized pytree to
+    ``decode_step`` directly.
     """
     import jax
     import jax.numpy as jnp
@@ -179,6 +199,11 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
                          "already picks the single best token)")
 
     logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
+    step_params = params
+    if quantize:
+        from trainingjob_operator_tpu.models.quant import quantize_weights
+
+        step_params = quantize_weights(params)
 
     def pick(logits, k):
         if temperature <= 0.0:
@@ -193,7 +218,7 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
 
     def step(carry, i):
         token, cache = carry
-        logits, cache = decode_step(params, cache, token, T + i, config,
+        logits, cache = decode_step(step_params, cache, token, T + i, config,
                                     mesh=mesh)
         nxt = pick(logits, jax.random.fold_in(key0, i + 1))
         return (nxt, cache), nxt

@@ -1,0 +1,92 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound (models/decode.py: each step streams every
+weight for one token's worth of FLOPs), so halving weight bytes is the
+single biggest decode-throughput lever on TPU.  This module quantizes the
+matmul weights to int8 with float32 scales, symmetric, per OUTPUT channel
+-- the scale axis is the one NOT reduced by the matmul, so dequantization
+commutes with the contraction and XLA fuses the ``int8 -> bf16`` convert
+and the scale multiply into the dot's operand read (the HBM read is int8).
+
+Quantized leaves are ``{"q": int8, "s": f32}`` dicts; models/decode.py's
+``_w`` resolves either form, so fp and quantized weights interoperate
+leaf-by-leaf.  Embeddings quantize per ROW (the lookup gathers a row; its
+scale rides along).  Norm scales stay f32 (tiny, precision-sensitive).
+
+The reference operator has no serving stack at all (SURVEY.md §0); this
+extends the framework's own decode path (models/decode.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+#: Matmul-weight leaf names (quantize per output channel = axis -2 kept).
+_MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _quantize_leaf(w, axis: int):
+    """Symmetric int8 over ``axis`` (the reduction axis): q = round(w/s)."""
+    import jax.numpy as jnp
+
+    s = jnp.max(jnp.abs(w), axis=axis, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def quantize_weights(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Llama param pytree -> same structure with matmul weights, lm_head
+    and tok_embed as ``{"q": int8, "s": f32}``; norms untouched."""
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if name in _MATMUL_LEAVES or name == "lm_head":
+            # [..., in, out]: reduce over ``in`` (axis -2) at matmul time,
+            # so the scale lives per output channel.
+            return _quantize_leaf(node, axis=-2)
+        if name == "tok_embed":
+            # [vocab, D]: the lookup gathers a row; scale per row.
+            return _quantize_leaf(node, axis=-1)
+        return node
+
+    return walk(params)
+
+
+def dequantize(leaf, compute):
+    """``{"q", "s"}`` (or a plain array) -> a ``compute``-dtype array."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        return (leaf["q"].astype(compute) * leaf["s"].astype(compute))
+    return leaf.astype(compute)
+
+
+def dequantize_rows(leaf, idx, compute):
+    """Row lookup for plain or row-quantized tables: gathers the int8 rows
+    AND their per-row scales -- the full table is never dequantized (the
+    embedding path's whole point)."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        return leaf["q"][idx].astype(compute) * leaf["s"][idx].astype(compute)
+    return leaf.astype(compute)[idx]
+
+
+def quantization_error(params: Dict[str, Any]) -> Dict[str, float]:
+    """Relative Frobenius error per quantized leaf (sanity metric)."""
+    import jax.numpy as jnp
+
+    qp = quantize_weights(params)
+    out: Dict[str, float] = {}
+
+    def walk(orig, quant, path=""):
+        if isinstance(quant, dict) and "q" in quant:
+            deq = dequantize(quant, jnp.float32)
+            num = float(jnp.linalg.norm(orig.astype(jnp.float32) - deq))
+            den = float(jnp.linalg.norm(orig.astype(jnp.float32))) or 1.0
+            out[path] = num / den
+            return
+        if isinstance(orig, dict):
+            for k in orig:
+                walk(orig[k], quant[k], f"{path}/{k}" if path else k)
+
+    walk(params, qp)
+    return out

@@ -12,6 +12,8 @@ Env: LLAMA_CONFIG=tiny|7b, GEN_STEPS (tokens to sample, default 32),
 GEN_BATCH (parallel samples, default 1), GEN_TEMPERATURE (0 = greedy),
 GEN_TOP_K / GEN_TOP_P (restrict the sampling support; need temperature),
 GEN_SEED, GEN_PROMPT (comma-separated token ids; default "1"),
+GEN_QUANT=1 (weight-only int8 decode, models/quant.py -- halves the HBM
+bytes that bound decode throughput),
 TRAININGJOB_CHECKPOINT_DIR (the trainer's checkpoint root).
 """
 
@@ -40,6 +42,7 @@ def main() -> int:
     top_k = int(os.environ.get("GEN_TOP_K", "0"))
     top_p = float(os.environ.get("GEN_TOP_P", "0"))
     seed = int(os.environ.get("GEN_SEED", "0"))
+    quantize = os.environ.get("GEN_QUANT", "") in ("1", "true")
     prompt_ids = [int(x) for x in
                   os.environ.get("GEN_PROMPT", "1").split(",")]
 
@@ -62,9 +65,11 @@ def main() -> int:
 
     prompt = jnp.broadcast_to(jnp.asarray(prompt_ids, jnp.int32)[None, :],
                               (batch, len(prompt_ids)))
+    if quantize:
+        print("decoding with weight-only int8", flush=True)
     out = decode.generate(
         params, prompt, cfg, steps=steps, temperature=temperature,
-        top_k=top_k, top_p=top_p,
+        top_k=top_k, top_p=top_p, quantize=quantize,
         key=jax.random.PRNGKey(seed) if temperature > 0 else None)
     for row in out:
         print("tokens:", ",".join(str(int(t)) for t in row), flush=True)

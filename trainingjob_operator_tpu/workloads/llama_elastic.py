@@ -16,7 +16,8 @@ multislice DCN when present.  The global batch is kept constant across widths
 
 Run: ``python -m trainingjob_operator_tpu.workloads.llama_elastic``.
 Env: LLAMA_CONFIG=tiny|124m|7b, LLAMA_TP, LLAMA_SP, LLAMA_PP (pipeline
-stages),
+stages), LLAMA_PP_MICROBATCH (GPipe microbatches; default targets an ~11%
+bubble, models/llama.py choose_microbatches),
 LLAMA_ACCUM (gradient-accumulation microbatches), LLAMA_STEPS, LLAMA_BATCH
 (global), LLAMA_SEQ, LLAMA_LR, LLAMA_CKPT_EVERY, LLAMA_DATA (path to a
 ``.tokens`` corpus, data/tokens.py; default trains on synthetic tokens),
