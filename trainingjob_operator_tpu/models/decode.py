@@ -41,8 +41,9 @@ def init_cache(config: llama.LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _attend_cache(q, keys, values, t, group: int):
-    """q: [B, 1, Hq, Dh] vs cache [B, S, Hkv, Dh], slots <= t visible."""
+def _attend_cache(q, keys, values, t, group: int, window: int = 0):
+    """q: [B, 1, Hq, Dh] vs cache [B, S, Hkv, Dh], slots <= t visible
+    (and > t - window under sliding-window attention)."""
     import jax
     import jax.numpy as jnp
 
@@ -51,7 +52,10 @@ def _attend_cache(q, keys, values, t, group: int):
     kh = keys.transpose(0, 2, 1, 3).astype(jnp.float32)    # [B,Hkv,S,Dh]
     vh = values.transpose(0, 2, 1, 3).astype(jnp.float32)
     scores = jnp.einsum("bhgd,bhsd->bhgs", qh, kh) * (Dh ** -0.5)
-    mask = jnp.arange(S)[None, None, None, :] <= t
+    slots = jnp.arange(S)[None, None, None, :]
+    mask = slots <= t
+    if window:
+        mask = jnp.logical_and(mask, slots > t - window)
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgs,bhsd->bhgd", probs, vh)
@@ -123,7 +127,8 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
             k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
-        o = _attend_cache(q, k_cache, v_cache, t, group).astype(compute)
+        o = _attend_cache(q, k_cache, v_cache, t, group,
+                          window=c.sliding_window).astype(compute)
         h = h + o.reshape(B, 1, c.dim) @ _w(layer["attn"]["wo"], compute)
         x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
         gate = jax.nn.silu(x @ _w(layer["mlp"]["w_gate"], compute))

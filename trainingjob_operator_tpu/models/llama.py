@@ -31,6 +31,11 @@ class LlamaConfig:
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
+    #: Sliding-window attention (Mistral-style): 0 = full causal; W > 0
+    #: restricts row i to keys (i - W, i].  The flash kernels skip KV
+    #: blocks outside the band (O(W) work per query); unsupported with
+    #: sequence_parallel (ring attention is the full-context long path).
+    sliding_window: int = 0
     dtype: str = "bfloat16"  # compute dtype; params stay float32
 
     @classmethod
@@ -289,7 +294,8 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
             from trainingjob_operator_tpu.ops.flash_attention import (
                 flash_attention_pp)
 
-            o = flash_attention_pp(q, k, v, mesh, causal=True)
+            o = flash_attention_pp(q, k, v, mesh, causal=True,
+                                   window=c.sliding_window)
         elif sequence_parallel and mesh is not None and "sp" in mesh.axis_names:
             # Ring attention is GQA-aware: the narrow kv blocks travel the
             # ring un-repeated (ICI bytes scale with n_kv_heads).
@@ -307,9 +313,11 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
                 flash_attention_sharded)
 
             if mesh is not None and mesh.devices.size > 1:
-                o = flash_attention_sharded(q, k, v, mesh, causal=True)
+                o = flash_attention_sharded(q, k, v, mesh, causal=True,
+                                            window=c.sliding_window)
             else:
-                o = flash_attention(q, k, v, causal=True)
+                o = flash_attention(q, k, v, causal=True,
+                                    window=c.sliding_window)
         o = o.reshape(Bh, T, c.dim)
         # The "attn" remat anchors live on the flash kernel's RESIDUALS
         # (ops/flash_attention.py _flash_fwd): tagging here, downstream of
@@ -351,6 +359,10 @@ def forward(params: Dict[str, Any], tokens, config: LlamaConfig, *,
 
     block = _remat_wrap(block, remat)
 
+    if sequence_parallel and c.sliding_window:
+        raise ValueError("sliding_window is not supported with "
+                         "sequence_parallel (ring attention is the "
+                         "full-context long path)")
     if return_kv and sequence_parallel:
         # Under sp the k/v are shard-local ring chunks, not the full-sequence
         # cache the decode contract promises -- padding them into a cache

@@ -39,7 +39,7 @@ LANE = 128
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             block_k: int, padded_len: int, kv_len: int, scale: float,
-            causal: bool):
+            causal: bool, window: int):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -59,6 +59,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         num_kb = pl.cdiv((qi + 1) * block_q, block_k)
     else:
         num_kb = padded_len // block_k
+    # Sliding window: row i attends cols (i - window, i]; KV blocks wholly
+    # left of the window never enter the loop -- attention work per query
+    # becomes O(window), not O(T).
+    start_kb = (jnp.maximum(qi * block_q - window + 1, 0) // block_k
+                if (causal and window) else 0)
 
     def body(kb, carry):
         m, l, acc = carry
@@ -74,6 +79,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             valid = jnp.logical_and(valid, cols <= rows)
+            if window:
+                valid = jnp.logical_and(valid, cols > rows - window)
         s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         correction = jnp.exp(m - m_new)
@@ -84,7 +91,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(start_kb, num_kb, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Log-sum-exp per query row: the only softmax statistic the backward
     # kernels need to recompute probabilities exactly.  Lane-replicated to
@@ -113,7 +120,8 @@ def _padded_len(T: int, block_q: int, block_k: int) -> int:
 
 
 def _flash_forward(q, k, v, *, scale: float, causal: bool,
-                   block_q: int, block_k: int, interpret: bool):
+                   block_q: int, block_k: int, interpret: bool,
+                   window: int = 0):
     """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> (out [B, Hq, T, D],
     lse [B, Hq, T] f32)."""
     import jax.numpy as jnp
@@ -135,7 +143,7 @@ def _flash_forward(q, k, v, *, scale: float, causal: bool,
     grid = (B, H, padded // block_q)
     kernel = functools.partial(_kernel, block_q=block_q, block_k=block_k,
                                padded_len=padded, kv_len=T, scale=scale,
-                               causal=causal)
+                               causal=causal, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -161,7 +169,7 @@ def _flash_forward(q, k, v, *, scale: float, causal: bool,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, block_q: int, block_k: int, padded_len: int,
-                   kv_len: int, scale: float, causal: bool):
+                   kv_len: int, scale: float, causal: bool, window: int):
     """dQ for one query block: stream KV blocks, recompute p from lse."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -177,6 +185,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         num_kb = pl.cdiv((qi + 1) * block_q, block_k)
     else:
         num_kb = padded_len // block_k
+    start_kb = (jnp.maximum(qi * block_q - window + 1, 0) // block_k
+                if (causal and window) else 0)
 
     def body(kb, dq):
         k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -191,6 +201,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             valid = jnp.logical_and(valid, cols <= rows)
+            if window:
+                valid = jnp.logical_and(valid, cols > rows - window)
         p = jnp.where(valid, jnp.exp(z - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -199,14 +211,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         return dq + jax.lax.dot_general(
             dz, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(start_kb, num_kb, body,
+                           jnp.zeros((bq, d), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, block_k: int,
                     padded_len: int, kv_len: int, scale: float, causal: bool,
-                    group: int):
+                    window: int, group: int):
     """dK/dV for one KV block: stream query blocks from the causal diagonal
     down.  The grid runs over KV heads; the GQA group's query heads are
     accumulated here in VMEM, so only [B, Hkv, T, D] ever reaches HBM."""
@@ -222,6 +235,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # First query block intersecting the diagonal: earlier blocks are fully
     # above it (all rows < first col of this KV block) and contribute 0.
     qb_start = (ki * block_k) // block_q if causal else 0
+    if causal and window:
+        # Last query row this KV block can serve is its last col + window-1;
+        # later q blocks are wholly outside the band.
+        num_qb = jnp.minimum(
+            num_qb, (ki * block_k + block_k + window - 2) // block_q + 1)
 
     def body(qb, carry):
         dk, dv = carry
@@ -232,6 +250,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             valid = jnp.logical_and(valid, cols <= rows)
+            if window:
+                valid = jnp.logical_and(valid, cols > rows - window)
         for g in range(group):  # static unroll over the GQA group
             q = q_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(
                 jnp.float32)
@@ -262,7 +282,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
-                    block_q: int, block_k: int, interpret: bool, delta):
+                    block_q: int, block_k: int, interpret: bool, delta,
+                    window: int = 0):
     """Pallas backward: q/g [B, H, T, D], k/v [B, Hkv, T, D], lse/delta
     [B, H, T] f32 -> (dq, dk, dv) in the input dtypes/shapes."""
     import jax.numpy as jnp
@@ -285,7 +306,7 @@ def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
                               (B, H, padded, LANE))
 
     common = dict(block_q=block_q, block_k=block_k, padded_len=padded,
-                  kv_len=T, scale=scale, causal=causal)
+                  kv_len=T, scale=scale, causal=causal, window=window)
 
     q_blocked = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
     kv_full = pl.BlockSpec((1, 1, padded, D),
@@ -325,7 +346,7 @@ def _flash_backward(q, k, v, lse, g, *, scale: float, causal: bool,
     return dq[:, :, :T, :], dk[:, :, :T, :], dv[:, :, :T, :]
 
 
-def _scores(q, k, *, scale: float, causal: bool):
+def _scores(q, k, *, scale: float, causal: bool, window: int = 0):
     """Masked f32 score matrix [B, H, Tq, Tk] (GQA keys repeated)."""
     import jax.numpy as jnp
 
@@ -337,11 +358,15 @@ def _scores(q, k, *, scale: float, causal: bool):
                    preferred_element_type=jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
+        if window:
+            # Banded: row i sees cols (i - window, i].
+            mask = jnp.logical_and(mask, ~jnp.tril(
+                jnp.ones((T, T), bool), -window))
         s = jnp.where(mask[None, None], s, NEG_INF)
     return s
 
 
-def _reference(q, k, v, *, scale: float, causal: bool):
+def _reference(q, k, v, *, scale: float, causal: bool, window: int = 0):
     """Same math in plain XLA (f32 softmax statistics); [B, H, T, D]."""
     import jax.numpy as jnp
 
@@ -349,45 +374,47 @@ def _reference(q, k, v, *, scale: float, causal: bool):
     Hkv = v.shape[1]
     if H != Hkv:
         v = jnp.repeat(v, H // Hkv, axis=1)
-    s = _scores(q, k, scale=scale, causal=causal)
+    s = _scores(q, k, scale=scale, causal=causal, window=window)
     p = jnp.exp(s - s.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _reference_lse(q, k, *, scale: float, causal: bool):
+def _reference_lse(q, k, *, scale: float, causal: bool, window: int = 0):
     """Log-sum-exp rows of the reference scores -- [B, H, T] f32 (matches the
     forward kernel's second output)."""
     import jax.numpy as jnp
 
-    s = _scores(q, k, scale=scale, causal=causal)
+    s = _scores(q, k, scale=scale, causal=causal, window=window)
     m = s.max(-1)
     return m + jnp.log(jnp.exp(s - m[..., None]).sum(-1))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, window):
     from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
 
     if use_pallas():
         out, _ = _flash_forward(q, k, v, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k,
-                                interpret=pallas_interpret())
+                                interpret=pallas_interpret(), window=window)
         return out
-    return _reference(q, k, v, scale=scale, causal=causal)
+    return _reference(q, k, v, scale=scale, causal=causal, window=window)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, window):
     from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
 
     if use_pallas():
         out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
                                   block_q=block_q, block_k=block_k,
-                                  interpret=pallas_interpret())
+                                  interpret=pallas_interpret(),
+                                  window=window)
     else:
-        out = _reference(q, k, v, scale=scale, causal=causal)
-        lse = _reference_lse(q, k, scale=scale, causal=causal)
+        out = _reference(q, k, v, scale=scale, causal=causal, window=window)
+        lse = _reference_lse(q, k, scale=scale, causal=causal,
+                             window=window)
     # Remat anchors ON THE RESIDUALS: under save_only_these_names("attn_out")
     # the backward reloads (out, lse) instead of re-running the quadratic
     # attention forward.  Tagging a tensor derived downstream of this
@@ -400,7 +427,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, window, res, g):
     from trainingjob_operator_tpu.ops import pallas_interpret, use_pallas
 
     q, k, v, out, lse = res
@@ -412,10 +439,12 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g):
         delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
         return _flash_backward(q, k, v, lse, g, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               interpret=pallas_interpret(), delta=delta)
+                               interpret=pallas_interpret(), delta=delta,
+                               window=window)
     # Off TPU: rematerialize through the reference (identical math).
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference(q_, k_, v_, scale=scale, causal=causal),
+        lambda q_, k_, v_: _reference(q_, k_, v_, scale=scale, causal=causal,
+                                      window=window),
         q, k, v)
     return vjp(g)
 
@@ -436,11 +465,18 @@ def default_blocks() -> "tuple[int, int]":
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
-                    block_k: Optional[int] = None):
+                    block_k: Optional[int] = None,
+                    window: int = 0):
     """Flash attention over [B, T, H, D] tensors (GQA: k/v may have fewer
-    heads).  Pallas on TPU, XLA reference elsewhere; differentiable."""
+    heads).  Pallas on TPU, XLA reference elsewhere; differentiable.
+
+    ``window`` > 0 (causal only) restricts row i to keys (i - window, i]
+    -- Mistral-style sliding-window attention.  The kernels skip KV blocks
+    wholly outside the band, so attention work per query is O(window)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window and not causal:
+        raise ValueError("window requires causal attention")
     dq, dk = default_blocks()
     block_q = block_q or dq
     block_k = block_k or dk
@@ -448,12 +484,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, float(scale), causal, block_q, block_k)
+    out = _flash(qt, kt, vt, float(scale), causal, block_q, block_k,
+                 int(window))
     return out.transpose(0, 2, 1, 3)
 
 
 def attention_xla(q, k, v, *, causal: bool = True,
-                  scale: Optional[float] = None):
+                  scale: Optional[float] = None, window: int = 0):
     """Identical-math attention on the pure-XLA path, [B, T, H, D].
 
     For contexts where a Pallas custom call cannot appear: inside shard_map
@@ -463,17 +500,22 @@ def attention_xla(q, k, v, *, causal: bool = True,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window and not causal:
+        # Same contract as flash_attention: a silently ignored window would
+        # compute the wrong attention pattern with no error.
+        raise ValueError("window requires causal attention")
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _reference(qt, kt, vt, scale=float(scale), causal=causal)
+    out = _reference(qt, kt, vt, scale=float(scale), causal=causal,
+                     window=window)
     return out.transpose(0, 2, 1, 3)
 
 
 def flash_attention_pp(q, k, v, mesh, *, causal: bool = True,
                        scale: Optional[float] = None,
                        block_q: Optional[int] = None,
-                       block_k: Optional[int] = None):
+                       block_k: Optional[int] = None, window: int = 0):
     """Flash attention inside the gpipe stage body (models/llama.py pp path).
 
     The stage body already runs under a shard_map manual over ONLY ``pp``
@@ -503,20 +545,22 @@ def flash_attention_pp(q, k, v, mesh, *, causal: bool = True,
         # pp is the only partitioned axis: the outer shard_map already made
         # everything per-shard, the kernel can run directly.
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               window=window)
     shmap = partial_manual_shard_map()
     n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
     n_tp = mesh.shape[tp] if tp else 1
     sp_sharded = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
     if (shmap is None or sp_sharded or q.shape[0] % n_data
             or q.shape[2] % n_tp or k.shape[2] % n_tp):
-        return attention_xla(q, k, v, causal=causal, scale=scale)
+        return attention_xla(q, k, v, causal=causal, scale=scale,
+                             window=window)
     batch = (data_axes if len(data_axes) > 1
              else (data_axes[0] if data_axes else None))
     spec = P(batch, None, tp, None)
     fn = shmap(
         functools.partial(flash_attention, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=manual, check_vma=False)
     return fn(q, k, v)
@@ -525,7 +569,8 @@ def flash_attention_pp(q, k, v, mesh, *, causal: bool = True,
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                             scale: Optional[float] = None,
                             block_q: Optional[int] = None,
-                            block_k: Optional[int] = None):
+                            block_k: Optional[int] = None,
+                            window: int = 0):
     """Flash attention under a dp/fsdp x tp mesh via shard_map.
 
     A Pallas kernel is an opaque custom call to GSPMD, so it must run
@@ -552,6 +597,6 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = True,
 
     fn = shard_map(
         functools.partial(flash_attention, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **compat)
     return fn(q, k, v)

@@ -13,7 +13,8 @@ GEN_BATCH (parallel samples, default 1), GEN_TEMPERATURE (0 = greedy),
 GEN_TOP_K / GEN_TOP_P (restrict the sampling support; need temperature),
 GEN_SEED, GEN_PROMPT (comma-separated token ids; default "1"),
 GEN_QUANT=1 (weight-only int8 decode, models/quant.py -- halves the HBM
-bytes that bound decode throughput),
+bytes that bound decode throughput), LLAMA_WINDOW (sliding-window span;
+MUST match the value the checkpoint was trained with),
 TRAININGJOB_CHECKPOINT_DIR (the trainer's checkpoint root).
 """
 
@@ -36,6 +37,13 @@ def main() -> int:
     cfg = (llama.LlamaConfig.llama2_7b()
            if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
            else llama.LlamaConfig.tiny())
+    window = int(os.environ.get("LLAMA_WINDOW", "0"))
+    if window:
+        # Decode with the same attention pattern the checkpoint was
+        # trained with (llama_elastic's LLAMA_WINDOW).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, sliding_window=window)
     steps = int(os.environ.get("GEN_STEPS", "32"))
     batch = int(os.environ.get("GEN_BATCH", "1"))
     temperature = float(os.environ.get("GEN_TEMPERATURE", "0"))

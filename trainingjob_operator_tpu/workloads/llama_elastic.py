@@ -25,7 +25,8 @@ LLAMA_SEED, LLAMA_EVAL_EVERY (held-out eval cadence in steps; 0 = off),
 LLAMA_EVAL_BATCHES, LLAMA_EVAL_FRACTION (corpus tail reserved for eval
 when eval is on; default 0.1), LLAMA_REMAT (rematerialization policy
 none/full/attn/dots; default attn for 7b, none for tiny), LLAMA_CE_CHUNK
-(chunked cross-entropy; 0 = monolithic logits).
+(chunked cross-entropy; 0 = monolithic logits), LLAMA_WINDOW
+(sliding-window attention span; 0 = full causal).
 """
 
 from __future__ import annotations
@@ -80,6 +81,11 @@ def main() -> int:
     # vocab] logits from materializing (models/llama.py loss_fn).
     remat = os.environ.get("LLAMA_REMAT", train.default_remat(cfg.n_layers))
     ce_chunk = int(os.environ.get("LLAMA_CE_CHUNK", "0"))
+    window = int(os.environ.get("LLAMA_WINDOW", "0"))
+    if window:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, sliding_window=window)
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp,
                                 pipeline_parallel=pp)
