@@ -551,3 +551,26 @@ class TestQuantizedDecode:
         # tiny model has near-uniform logits, and one near-tie argmax flip
         # diverges the whole autoregressive rollout; the logit-cosine test
         # above is the correctness check.)
+
+
+class TestMoESlidingWindow:
+    def test_windowed_moe_trains(self, monkeypatch):
+        import dataclasses
+
+        from trainingjob_operator_tpu.models import moe
+
+        monkeypatch.setenv("TRAININGJOB_PALLAS", "interpret")
+        cfg = dataclasses.replace(moe.MoEConfig.tiny(), sliding_window=8)
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+        loss, grads = jax.value_and_grad(lambda p: moe.loss_fn(
+            p, {"tokens": tokens}, cfg))(params)
+        assert np.isfinite(float(loss))
+        assert all(np.all(np.isfinite(np.asarray(g)))
+                   for g in jax.tree.leaves(grads))
+        # The window changes the attention pattern (different loss than
+        # full causal).
+        full = float(moe.loss_fn(params, {"tokens": tokens},
+                                 dataclasses.replace(cfg, sliding_window=0)))
+        assert abs(float(loss) - full) > 1e-6

@@ -40,6 +40,8 @@ class MoEConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    #: Sliding-window attention span (Mixtral uses 4096); 0 = full causal.
+    sliding_window: int = 0
     #: Routing group size (tokens), 0 = the whole sequence.  The dense
     #: dispatch/combine einsums cost O(B*T*C*E*D) with C ~ T/E -- QUADRATIC
     #: in sequence length.  Routing in groups of ``router_group`` tokens
@@ -281,9 +283,11 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
             flash_attention_sharded)
 
         if mesh is not None and mesh.devices.size > 1:
-            o = flash_attention_sharded(q, k, v, mesh, causal=True)
+            o = flash_attention_sharded(q, k, v, mesh, causal=True,
+                                        window=c.sliding_window)
         else:
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True,
+                                window=c.sliding_window)
         # "attn" remat anchors are on the flash kernel's residuals
         # (ops/flash_attention.py _flash_fwd).
         return o.reshape(B, T, c.dim) @ layer["attn"]["wo"].astype(compute)
