@@ -5,13 +5,17 @@ The reference operator serves no models (it is a control plane, SURVEY.md
 checkpoints cannot be sampled from is half a framework.  Design is
 XLA-first, mirroring the training side's constraints:
 
-- **Static shapes everywhere**: the KV cache is allocated at ``max_len`` up
-  front and written with ``lax.dynamic_update_slice``; the decode loop is a
-  ``lax.scan`` over positions (one compiled step, no Python loop, no
-  recompilation as the sequence grows).
-- **Causality via position masking**, not shape: step t attends to cache
-  slots ``< t`` through a mask computed from the loop counter -- the
-  data-dependent part stays in predicates, where XLA wants it.
+- **Static shapes everywhere**: the KV cache is allocated up front --
+  ``max_len`` slots for full causal attention, a RING of ``window`` slots
+  under sliding-window attention (slot = position % size; memory O(window)
+  for any generation length) -- and written with
+  ``lax.dynamic_update_slice``; the decode loop is a ``lax.scan`` over
+  positions (one compiled step, no Python loop, no recompilation as the
+  sequence grows).
+- **Causality via position masking**, not shape: visibility is decided per
+  slot from the loop counter (full mode: slot <= t; ring mode: the slot's
+  absolute position is inside the window) -- the data-dependent part stays
+  in predicates, where XLA wants it.
 - **Same params, same shardings**: decode reuses the training pytree and
   SHARDING_RULES; under a mesh the per-step attention/matmuls partition over
   tp/fsdp exactly like training (decode attention is a [B, H, 1, t] matvec,
@@ -30,20 +34,37 @@ from typing import Any, Dict, Optional
 from trainingjob_operator_tpu.models import llama
 
 
+def cache_len(config: llama.LlamaConfig, max_len: int) -> int:
+    """Cache slots actually needed: ``max_len`` for full causal attention,
+    min(max_len, window) under a sliding window -- positions older than the
+    window can never be attended again, so the cache is a RING over the
+    last ``window`` positions (slot = position % size) and its memory is
+    O(window) regardless of generation length."""
+    w = config.sliding_window
+    return min(max_len, w) if w else max_len
+
+
 def init_cache(config: llama.LlamaConfig, batch: int, max_len: int,
                dtype=None) -> Dict[str, Any]:
-    """Zeroed KV cache: k/v of [L, B, max_len, Hkv, Dh]."""
+    """Zeroed KV cache: k/v of [L, B, cache_len, Hkv, Dh] (``cache_len`` =
+    ``max_len``, or the sliding window when one is configured)."""
     import jax.numpy as jnp
 
     c = config
     dtype = dtype or jnp.dtype(c.dtype)
-    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    shape = (c.n_layers, batch, cache_len(c, max_len), c.n_kv_heads,
+             c.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def _attend_cache(q, keys, values, t, group: int, window: int = 0):
-    """q: [B, 1, Hq, Dh] vs cache [B, S, Hkv, Dh], slots <= t visible
-    (and > t - window under sliding-window attention)."""
+    """q: [B, 1, Hq, Dh] vs cache [B, S, Hkv, Dh].
+
+    Full mode (window == 0): slot == position, slots <= t visible.  Ring
+    mode (window > 0): slot s holds position p = t - ((t - s) mod S), the
+    newest position congruent to s; visible iff p >= 0 (written) and
+    p > t - window (inside the band).  RoPE is applied at write time with
+    the ABSOLUTE position, so wrapped slots need no re-rotation."""
     import jax
     import jax.numpy as jnp
 
@@ -53,9 +74,11 @@ def _attend_cache(q, keys, values, t, group: int, window: int = 0):
     vh = values.transpose(0, 2, 1, 3).astype(jnp.float32)
     scores = jnp.einsum("bhgd,bhsd->bhgs", qh, kh) * (Dh ** -0.5)
     slots = jnp.arange(S)[None, None, None, :]
-    mask = slots <= t
     if window:
-        mask = jnp.logical_and(mask, slots > t - window)
+        pos = t - jnp.mod(t - slots, S)
+        mask = jnp.logical_and(pos >= 0, pos > t - window)
+    else:
+        mask = slots <= t
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgs,bhsd->bhgd", probs, vh)
@@ -81,6 +104,19 @@ def prefill(params, tokens, config: llama.LlamaConfig, max_len: int, *,
                                        return_kv=True)
 
     dtype = jnp.dtype(c.dtype)
+    S = cache_len(c, max_len)
+    if S < max_len:
+        # Ring cache: keep the last min(T, S) positions at slot = pos % S.
+        keep = min(T, S)
+        kk, vv = k[:, :, T - keep:], v[:, :, T - keep:]
+        pad = ((0, 0), (0, 0), (0, S - keep), (0, 0), (0, 0))
+        kk, vv = jnp.pad(kk, pad), jnp.pad(vv, pad)
+        # Element at array index i holds position T - keep + i; its slot is
+        # that position mod S -- a cyclic shift by (T - keep) % S.
+        shift = (T - keep) % S
+        cache = {"k": jnp.roll(kk, shift, axis=2).astype(dtype),
+                 "v": jnp.roll(vv, shift, axis=2).astype(dtype)}
+        return logits_all[:, -1, :], cache
     pad = ((0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0))
     cache = {"k": jnp.pad(k, pad).astype(dtype),
              "v": jnp.pad(v, pad).astype(dtype)}
@@ -123,10 +159,13 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
             B, 1, c.n_kv_heads, c.head_dim)
         q = llama._rope(q, pos, c.rope_theta)
         k = llama._rope(k, pos, c.rope_theta)
+        # Ring cache under a sliding window: slot = position mod size.
+        S = k_cache.shape[1]
+        slot = jnp.mod(t, S) if c.sliding_window else t
         k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
+            k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
+            v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
         o = _attend_cache(q, k_cache, v_cache, t, group,
                           window=c.sliding_window).astype(compute)
         h = h + o.reshape(B, 1, c.dim) @ _w(layer["attn"]["wo"], compute)
