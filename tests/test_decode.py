@@ -209,3 +209,67 @@ class TestRingKVCache:
             np.testing.assert_allclose(np.asarray(lg),
                                        np.asarray(full[:, t - 1]),
                                        rtol=2e-3, atol=2e-3)
+
+
+class TestMoEDecode:
+    """KV-cache decoding for the MoE family (models/moe_decode.py): the
+    routed single-token MLP gathers only the top-k experts' weights, and
+    teacher-forced logits match the training forward when no tokens drop."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+
+        from trainingjob_operator_tpu.models import moe
+
+        base = moe.MoEConfig.tiny(n_layers=2)
+        # Ample capacity: no training-time token drops, so the (dropless)
+        # decode math must match the forward exactly.
+        return dataclasses.replace(
+            base, dtype="float32", capacity_factor=float(
+                base.n_experts / base.experts_per_token), **kw)
+
+    def test_teacher_forced_matches_forward(self):
+        from trainingjob_operator_tpu.models import moe, moe_decode
+
+        cfg = self._cfg()
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        T = 12
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0,
+                                    cfg.vocab_size)
+        full, _aux = moe.forward(params, tokens, cfg)
+        _, cache = moe_decode.prefill(params, tokens[:, :4], cfg, max_len=T)
+        for t in range(4, T):
+            lg, cache = moe_decode.decode_step(
+                params, cache, tokens[:, t - 1], jnp.int32(t - 1), cfg)
+            np.testing.assert_allclose(np.asarray(lg),
+                                       np.asarray(full[:, t - 1]),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_windowed_ring_cache_matches_forward(self):
+        from trainingjob_operator_tpu.models import moe, moe_decode
+
+        cfg = self._cfg(sliding_window=6)
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        T = 20
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0,
+                                    cfg.vocab_size)
+        full, _aux = moe.forward(params, tokens, cfg)
+        _, cache = moe_decode.prefill(params, tokens[:, :8], cfg, max_len=T)
+        assert cache["k"].shape[2] == 6  # ring, not max_len
+        for t in range(8, T):
+            lg, cache = moe_decode.decode_step(
+                params, cache, tokens[:, t - 1], jnp.int32(t - 1), cfg)
+            np.testing.assert_allclose(np.asarray(lg),
+                                       np.asarray(full[:, t - 1]),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_generate_runs(self):
+        from trainingjob_operator_tpu.models import moe, moe_decode
+
+        cfg = self._cfg()
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0,
+                                    cfg.vocab_size)
+        out = np.asarray(moe_decode.generate(params, prompt, cfg, steps=6))
+        assert out.shape == (2, 6)
+        assert out.min() >= 0 and out.max() < cfg.vocab_size

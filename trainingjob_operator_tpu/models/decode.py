@@ -44,6 +44,32 @@ def cache_len(config: llama.LlamaConfig, max_len: int) -> int:
     return min(max_len, w) if w else max_len
 
 
+def pack_cache(k, v, config, max_len: int):
+    """Stacked per-layer K/V from prefill ([L, B, T, Hkv, Dh]) -> the cache
+    dict, ring-packed when the window cache is smaller than ``max_len``
+    (keep the last min(T, S) positions at slot = position % S via a cyclic
+    shift).  Shared by the Llama and MoE prefills -- the slot math must
+    stay identical across families."""
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    T = k.shape[2]
+    S = cache_len(config, max_len)
+    if S < max_len:
+        keep = min(T, S)
+        kk, vv = k[:, :, T - keep:], v[:, :, T - keep:]
+        pad = ((0, 0), (0, 0), (0, S - keep), (0, 0), (0, 0))
+        kk, vv = jnp.pad(kk, pad), jnp.pad(vv, pad)
+        # Element at array index i holds position T - keep + i; its slot is
+        # that position mod S -- a cyclic shift by (T - keep) % S.
+        shift = (T - keep) % S
+        return {"k": jnp.roll(kk, shift, axis=2).astype(dtype),
+                "v": jnp.roll(vv, shift, axis=2).astype(dtype)}
+    pad = ((0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0))
+    return {"k": jnp.pad(k, pad).astype(dtype),
+            "v": jnp.pad(v, pad).astype(dtype)}
+
+
 def init_cache(config: llama.LlamaConfig, batch: int, max_len: int,
                dtype=None) -> Dict[str, Any]:
     """Zeroed KV cache: k/v of [L, B, cache_len, Hkv, Dh] (``cache_len`` =
@@ -102,25 +128,7 @@ def prefill(params, tokens, config: llama.LlamaConfig, max_len: int, *,
         raise ValueError(f"prompt {T} exceeds max_len {max_len}")
     logits_all, (k, v) = llama.forward(params, tokens, c, mesh=mesh,
                                        return_kv=True)
-
-    dtype = jnp.dtype(c.dtype)
-    S = cache_len(c, max_len)
-    if S < max_len:
-        # Ring cache: keep the last min(T, S) positions at slot = pos % S.
-        keep = min(T, S)
-        kk, vv = k[:, :, T - keep:], v[:, :, T - keep:]
-        pad = ((0, 0), (0, 0), (0, S - keep), (0, 0), (0, 0))
-        kk, vv = jnp.pad(kk, pad), jnp.pad(vv, pad)
-        # Element at array index i holds position T - keep + i; its slot is
-        # that position mod S -- a cyclic shift by (T - keep) % S.
-        shift = (T - keep) % S
-        cache = {"k": jnp.roll(kk, shift, axis=2).astype(dtype),
-                 "v": jnp.roll(vv, shift, axis=2).astype(dtype)}
-        return logits_all[:, -1, :], cache
-    pad = ((0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0))
-    cache = {"k": jnp.pad(k, pad).astype(dtype),
-             "v": jnp.pad(v, pad).astype(dtype)}
-    return logits_all[:, -1, :], cache
+    return logits_all[:, -1, :], pack_cache(k, v, c, max_len)
 
 
 def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,

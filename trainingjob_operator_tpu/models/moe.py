@@ -229,16 +229,23 @@ def _moe_mlp(h, layer, config: MoEConfig, compute):
 
 
 def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
-            mesh=None, remat=False, return_hidden: bool = False):
+            mesh=None, remat=False, return_hidden: bool = False,
+            return_kv: bool = False):
     """Logits [B, T, vocab] plus the mean auxiliary load-balancing loss.
 
     With ``return_hidden`` returns the final-norm hidden states [B, T, D]
     instead of logits (the chunked cross-entropy path; mirrors
-    models/llama.py).
+    models/llama.py).  With ``return_kv`` returns ``(logits, aux, (k, v))``
+    where k/v are post-rope per-layer projections stacked
+    [L, B, T, Hkv, Dh] -- the decode prefill contract (models/moe_decode.py
+    reuses THIS forward so sampling cannot desynchronize from training).
     """
     import jax
     import jax.numpy as jnp
 
+    if return_hidden and return_kv:
+        raise ValueError("return_hidden and return_kv are mutually "
+                         "exclusive (the hidden path drops the kv stack)")
     c = config
     compute = jnp.dtype(c.dtype)
     B, T = tokens.shape
@@ -290,26 +297,32 @@ def forward(params: Dict[str, Any], tokens, config: MoEConfig, *,
                                 window=c.sliding_window)
         # "attn" remat anchors are on the flash kernel's residuals
         # (ops/flash_attention.py _flash_fwd).
-        return o.reshape(B, T, c.dim) @ layer["attn"]["wo"].astype(compute)
+        o = o.reshape(B, T, c.dim) @ layer["attn"]["wo"].astype(compute)
+        return o, (k, v)
 
     def block(carry, layer):
         h, aux = carry
-        h = h + attn(pin_act(_llama._rmsnorm(h, layer["attn_norm"],
+        a, kv = attn(pin_act(_llama._rmsnorm(h, layer["attn_norm"],
                                              c.norm_eps)), layer)
+        h = h + a
         y, layer_aux = _moe_mlp(
             pin_act(_llama._rmsnorm(h, layer["moe_norm"], c.norm_eps)),
             layer, c, compute)
-        return (h + y, aux + layer_aux), None
+        return (h + y, aux + layer_aux), (kv if return_kv else None)
 
     # Same policy surface as the Llama family (bool or "full"/"attn"/
     # "dots"/"none"; _remat_wrap docs the trade-offs).
     block = _llama._remat_wrap(block, remat)
-    (h, aux), _ = jax.lax.scan(block, (h, jnp.float32(0.0)), layers)
+    (h, aux), kv = jax.lax.scan(block, (h, jnp.float32(0.0)), layers)
     h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
     if return_hidden:
         return h, aux / c.n_layers
-    logits = h @ params["lm_head"].astype(compute)
-    return logits.astype(jnp.float32), aux / c.n_layers
+    logits = (h @ params["lm_head"].astype(compute)).astype(jnp.float32)
+    if return_kv:
+        # Post-rope per-layer K/V stacked [L, B, T, Hkv, Dh] -- the decode
+        # cache layout (models/moe_decode.py prefill).
+        return logits, aux / c.n_layers, kv
+    return logits, aux / c.n_layers
 
 
 def loss_fn(params, batch, config: MoEConfig, *, mesh=None,

@@ -1,0 +1,153 @@
+"""Autoregressive decoding for the MoE family: KV cache + routed experts.
+
+Same architecture as models/decode.py (static shapes, ring KV cache under a
+sliding window, prefill delegating to the training forward), with the dense
+MLP replaced by per-token top-k expert routing.  The serving win MoE
+promises -- compute (and weight reads, via the gathered expert slices) for
+only k of E experts per token -- is kept at decode time: the router picks
+top-k per token and ``jnp.take`` gathers exactly those experts' weight
+slices, so HBM streams k expert FFNs per token, not E.
+
+The reference operator serves no models (SURVEY.md §0); this completes the
+train -> checkpoint -> sample loop for the second model family.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from trainingjob_operator_tpu.models import decode as _decode
+from trainingjob_operator_tpu.models import llama as _llama
+from trainingjob_operator_tpu.models import moe
+
+
+def prefill(params, tokens, config: moe.MoEConfig, max_len: int, *,
+            mesh=None):
+    """Prompt [B, T] -> (last-position logits [B, vocab], KV cache).
+
+    Delegates to the training ``moe.forward`` (``return_kv=True``) -- one
+    implementation of the layer math, so sampling cannot desynchronize
+    from what was trained (routing decisions included)."""
+    c = config
+    B, T = tokens.shape
+    if T > max_len:
+        raise ValueError(f"prompt {T} exceeds max_len {max_len}")
+    logits_all, _aux, (k, v) = moe.forward(params, tokens, c, mesh=mesh,
+                                           return_kv=True)
+    return logits_all[:, -1, :], _decode.pack_cache(k, v, c, max_len)
+
+
+def _routed_mlp_token(x, layer, config: moe.MoEConfig, compute):
+    """Top-k routed expert MLP for single-token rows x [B, 1, D].
+
+    Gathers the k chosen experts' weight slices per token (``jnp.take``
+    along the expert dim), so only k expert FFNs' bytes stream from HBM --
+    the capacity machinery of training-time dense dispatch is pointless
+    for one token and is skipped entirely (a single token can never
+    overflow an expert)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    B = x.shape[0]
+    xf = x[:, 0]                                            # [B, D]
+    logits = xf.astype(jnp.float32) @ layer["moe"]["router"]  # [B, E]
+    gates, idx = jax.lax.top_k(jax.nn.softmax(logits, -1),
+                               c.experts_per_token)          # [B, k]
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    # [B, k, D, F] gathered expert weights; k small (2 for Mixtral).
+    wg = jnp.take(layer["moe"]["w_gate"], idx, axis=0).astype(compute)
+    wu = jnp.take(layer["moe"]["w_up"], idx, axis=0).astype(compute)
+    wd = jnp.take(layer["moe"]["w_down"], idx, axis=0).astype(compute)
+    gate = jax.nn.silu(jnp.einsum("bd,bkdf->bkf", xf, wg))
+    up = jnp.einsum("bd,bkdf->bkf", xf, wu)
+    y = jnp.einsum("bkf,bkfd->bkd", gate * up, wd)           # [B, k, D]
+    y = jnp.einsum("bkd,bk->bd", y, gates.astype(compute))
+    return y[:, None, :]
+
+
+def decode_step(params, cache, token, t, config: moe.MoEConfig, *,
+                mesh=None):
+    """One token [B] at position ``t`` -> (logits [B, vocab], new cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B = token.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = params["tok_embed"].astype(compute)[token][:, None, :]
+    pos = jnp.broadcast_to(t[None, None], (B, 1))
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = _llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = (x @ layer["attn"]["wq"].astype(compute)).reshape(
+            B, 1, c.n_heads, c.head_dim)
+        k = (x @ layer["attn"]["wk"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        v = (x @ layer["attn"]["wv"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        q = _llama._rope(q, pos, c.rope_theta)
+        k = _llama._rope(k, pos, c.rope_theta)
+        S = k_cache.shape[1]
+        slot = jnp.mod(t, S) if c.sliding_window else t
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
+        o = _decode._attend_cache(q, k_cache, v_cache, t, group,
+                                  window=c.sliding_window).astype(compute)
+        h = h + o.reshape(B, 1, c.dim) @ layer["attn"]["wo"].astype(compute)
+        x = _llama._rmsnorm(h, layer["moe_norm"], c.norm_eps)
+        h = h + _routed_mlp_token(x, layer, c, compute)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = (h[:, 0, :] @ params["lm_head"].astype(compute))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def generate(params, prompt, config: moe.MoEConfig, *, steps: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 0.0, key=None, mesh=None):
+    """Sample ``steps`` tokens after ``prompt`` [B, T]; returns [B, steps].
+    Same sampling surface as the Llama path (models/decode.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T = prompt.shape
+    max_len = max_len or (T + steps)
+    if T + steps > max_len:
+        raise ValueError(f"{T} prompt + {steps} steps > max_len {max_len}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    top_k = 0 if top_k >= config.vocab_size else top_k
+    top_p = 0.0 if top_p >= 1.0 else top_p
+    if (top_k or top_p > 0.0) and temperature <= 0.0:
+        raise ValueError("top_k/top_p require temperature > 0")
+
+    logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _decode._mask_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+
+    key0 = key if key is not None else jax.random.PRNGKey(0)
+    first = pick(logits, jax.random.fold_in(key0, 0))
+
+    def step(carry, i):
+        token, cache = carry
+        logits, cache = decode_step(params, cache, token, T + i, config,
+                                    mesh=mesh)
+        nxt = pick(logits, jax.random.fold_in(key0, i + 1))
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(step, (first, cache),
+                                jnp.arange(steps - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -8,7 +8,9 @@ elastic trainer is demonstrably usable, end to end, inside the same
 framework.
 
 Run: ``python -m trainingjob_operator_tpu.workloads.generate``.
-Env: LLAMA_CONFIG=tiny|7b, GEN_STEPS (tokens to sample, default 32),
+Env: GEN_FAMILY=llama|moe (which trainer's checkpoint to sample --
+llama_elastic's or moe_pretrain's), LLAMA_CONFIG=tiny|7b /
+MOE_CONFIG=tiny|8x7b, GEN_STEPS (tokens to sample, default 32),
 GEN_BATCH (parallel samples, default 1), GEN_TEMPERATURE (0 = greedy),
 GEN_TOP_K / GEN_TOP_P (restrict the sampling support; need temperature),
 GEN_SEED, GEN_PROMPT (comma-separated token ids; default "1"),
@@ -32,15 +34,28 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from trainingjob_operator_tpu.models import decode, llama
+    family = os.environ.get("GEN_FAMILY", "llama")
+    if family == "moe":
+        from trainingjob_operator_tpu.models import moe
+        from trainingjob_operator_tpu.models import moe_decode as decode_mod
 
-    cfg = (llama.LlamaConfig.llama2_7b()
-           if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
-           else llama.LlamaConfig.tiny())
-    window = int(os.environ.get("LLAMA_WINDOW", "0"))
+        cfg = (moe.MoEConfig.mixtral_8x7b()
+               if os.environ.get("MOE_CONFIG", "tiny") == "8x7b"
+               else moe.MoEConfig.tiny())
+        init_params, subdir = moe.init_params, "moe"
+        window = int(os.environ.get("MOE_WINDOW", "0"))
+    else:
+        from trainingjob_operator_tpu.models import decode as decode_mod
+        from trainingjob_operator_tpu.models import llama
+
+        cfg = (llama.LlamaConfig.llama2_7b()
+               if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
+               else llama.LlamaConfig.tiny())
+        init_params, subdir = llama.init_params, "llama"
+        window = int(os.environ.get("LLAMA_WINDOW", "0"))
     if window:
         # Decode with the same attention pattern the checkpoint was
-        # trained with (llama_elastic's LLAMA_WINDOW).
+        # trained with (the trainer's {P}_WINDOW).
         import dataclasses
 
         cfg = dataclasses.replace(cfg, sliding_window=window)
@@ -60,9 +75,9 @@ def main() -> int:
     # ~2x the params in optimizer state the sampler never uses -- restoring
     # it would triple restore IO and can OOM a host that fits params alone.
     state = train.CheckpointState.restore_or_init(
-        rdv, {"params": llama.init_params(cfg, jax.random.PRNGKey(0)),
+        rdv, {"params": init_params(cfg, jax.random.PRNGKey(0)),
               "opt_state": ocp.PLACEHOLDER, "step": 0},
-        subdir="llama")
+        subdir=subdir)
     step = int(state.value["step"])
     params = state.value["params"]
     if step == 0:
@@ -73,12 +88,19 @@ def main() -> int:
 
     prompt = jnp.broadcast_to(jnp.asarray(prompt_ids, jnp.int32)[None, :],
                               (batch, len(prompt_ids)))
-    if quantize:
-        print("decoding with weight-only int8", flush=True)
-    out = decode.generate(
-        params, prompt, cfg, steps=steps, temperature=temperature,
-        top_k=top_k, top_p=top_p, quantize=quantize,
-        key=jax.random.PRNGKey(seed) if temperature > 0 else None)
+    gen_kwargs = dict(steps=steps, temperature=temperature, top_k=top_k,
+                      top_p=top_p,
+                      key=jax.random.PRNGKey(seed) if temperature > 0
+                      else None)
+    if family != "moe":
+        # Weight-only int8 is the Llama decode path's knob (models/quant.py)
+        gen_kwargs["quantize"] = quantize
+        if quantize:
+            print("decoding with weight-only int8", flush=True)
+    elif quantize:
+        print("warning: GEN_QUANT is not supported for GEN_FAMILY=moe; "
+              "decoding in full precision", flush=True)
+    out = decode_mod.generate(params, prompt, cfg, **gen_kwargs)
     for row in out:
         print("tokens:", ",".join(str(int(t)) for t in row), flush=True)
     return 0
