@@ -1,11 +1,17 @@
-# CI recipe: `make ci` = the full gate (tests + multichip dryrun + compile
-# check).  The virtual 8-device CPU mesh stands in for multi-chip TPU
+# CI recipe: `make ci` = the full gate (lint + tests + multichip dryrun +
+# compile check).  The virtual 8-device CPU mesh stands in for multi-chip TPU
 # (SURVEY.md §7); bench runs on real hardware out-of-band.
 
 PY ?= python
 VDEV ?= 8
 
-.PHONY: test dryrun bench install ci
+.PHONY: lint test dryrun bench install ci
+
+# AST-based operator lint (docs/STATIC_ANALYSIS.md): milliseconds, runs
+# before the tests so a grammar/race/contract bug fails fast with a
+# file:line annotation instead of 5 modules of collection errors.
+lint:
+	$(PY) -m tools.analyze trainingjob_operator_tpu/ tools/ tests/ --format=github
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -20,4 +26,4 @@ bench:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: test dryrun
+ci: lint test dryrun

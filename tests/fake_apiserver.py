@@ -88,9 +88,10 @@ class FakeApiServer:
 
     # -- store ---------------------------------------------------------------
 
-    def _commit(self, key: Key, obj: Optional[Dict[str, Any]],
+    def _commit_locked(self, key: Key, obj: Optional[Dict[str, Any]],
                 etype: str) -> Dict[str, Any]:
-        """Mutate under lock; stamp rv; append to watch log; wake watchers."""
+        """Caller holds self._lock: stamp rv, append to the watch log,
+        wake watchers."""
         self._rv += 1
         if obj is not None:
             obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
@@ -120,7 +121,7 @@ class FakeApiServer:
         ns = meta.get("namespace", "") if plural != "nodes" else ""
         meta.setdefault("uid", str(uuid.uuid4()))
         with self._lock:
-            self._commit((plural, ns, meta["name"]), obj, "ADDED")
+            self._commit_locked((plural, ns, meta["name"]), obj, "ADDED")
 
     def get_obj(self, plural: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -160,7 +161,7 @@ class FakeApiServer:
                                 "reason": "Unschedulable",
                                 "message": "0/1 nodes available: "
                                            "insufficient google.com/tpu"}]
-                            self._commit(key, pod, "MODIFIED")
+                            self._commit_locked(key, pod, "MODIFIED")
                         continue
                     if phase == "Pending":
                         pod.setdefault("spec", {})["nodeName"] = node_name
@@ -173,7 +174,7 @@ class FakeApiServer:
                                 for c in pod["spec"].get("containers", [])],
                         }
                         started[uid] = time.time()
-                        self._commit(key, pod, "MODIFIED")
+                        self._commit_locked(key, pod, "MODIFIED")
                     elif phase == "Running" and self.RUN_SECONDS in ann:
                         t0 = started.setdefault(uid, time.time())
                         if time.time() - t0 >= float(ann[self.RUN_SECONDS]):
@@ -188,7 +189,7 @@ class FakeApiServer:
                             pod["status"]["containerStatuses"] = [
                                 {"name": c["name"], "state": state}
                                 for c in pod["spec"].get("containers", [])]
-                            self._commit(key, pod, "MODIFIED")
+                            self._commit_locked(key, pod, "MODIFIED")
 
     # -- HTTP plumbing -------------------------------------------------------
 
@@ -378,7 +379,7 @@ class FakeApiServer:
                         return
                     meta.setdefault("uid", str(uuid.uuid4()))
                     meta.setdefault("creationTimestamp", _now_iso())
-                    out = server._commit(key, obj, "ADDED")
+                    out = server._commit_locked(key, obj, "ADDED")
                 self._json(201, out)
 
             def do_PUT(self):
@@ -424,7 +425,7 @@ class FakeApiServer:
                         # the wrong half of the object).
                         if "status" in cur:
                             nxt["status"] = cur["status"]
-                    out = server._commit(key, nxt, "MODIFIED")
+                    out = server._commit_locked(key, nxt, "MODIFIED")
                 self._json(200, out)
 
             def do_DELETE(self):
@@ -441,7 +442,7 @@ class FakeApiServer:
                         self._status(404, "NotFound",
                                      f"{plural} {ns}/{name} not found")
                         return
-                    server._commit(key, None, "DELETED")
+                    server._commit_locked(key, None, "DELETED")
                 self._json(200, {"kind": "Status", "status": "Success"})
 
         return Handler

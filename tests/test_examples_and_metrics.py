@@ -145,3 +145,63 @@ class TestMetrics:
         assert tc.metrics.snapshot()["trainingjob_jobs"] >= 1.0
         tc.stop()
         assert "trainingjob_jobs" not in tc.metrics.snapshot()
+
+
+class TestPrometheusExposition:
+    """The text-format code path the seed's metrics.py:147 SyntaxError lived
+    in: histogram bucket lines with escaped ``le="..."`` labels, label
+    sorting, the +Inf bucket, and label-value escaping."""
+
+    def test_labeled_histogram_bucket_lines(self):
+        reg = MetricsRegistry()
+        reg.observe("sync_seconds", 0.003, component="controller")
+        reg.observe("sync_seconds", 0.7, component="controller")
+        text = reg.render_prometheus()
+        # le= is appended inside the existing label braces, comma-separated.
+        assert 'sync_seconds_bucket{component="controller",le="0.005"} 1' in text
+        assert 'sync_seconds_bucket{component="controller",le="1.0"} 2' in text
+        assert 'sync_seconds_sum{component="controller"} 0.703' in text
+        assert 'sync_seconds_count{component="controller"} 2' in text
+
+    def test_bucket_counts_are_cumulative(self):
+        reg = MetricsRegistry()
+        for v in (0.002, 0.002, 0.02, 2.0):
+            reg.observe("lat", v)
+        text = reg.render_prometheus()
+        assert 'lat_bucket{le="0.005"} 2' in text   # both 2ms observations
+        assert 'lat_bucket{le="0.05"} 3' in text    # + the 20ms one
+        assert 'lat_bucket{le="30.0"} 4' in text    # + the 2s one
+
+    def test_plus_inf_bucket_always_equals_count(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.001)
+        reg.observe("lat", 1e9)  # beyond every finite bucket
+        text = reg.render_prometheus()
+        assert 'lat_bucket{le="+Inf"} 2' in text
+        assert "lat_count 2" in text
+
+    def test_label_keys_sorted(self):
+        reg = MetricsRegistry()
+        reg.inc("c_total", zone="a", alpha="b", mid="c")
+        text = reg.render_prometheus()
+        assert 'c_total{alpha="b",mid="c",zone="a"} 1.0' in text
+
+    def test_label_value_escaping(self):
+        # Prometheus text format: backslash, double quote, and newline must
+        # be escaped inside label values.
+        reg = MetricsRegistry()
+        reg.inc("err_total", msg='pod "a\\b"\nfailed')
+        text = reg.render_prometheus()
+        assert 'err_total{msg="pod \\"a\\\\b\\"\\nfailed"} 1.0' in text
+
+    def test_labeled_histogram_survives_http_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.003, job="default/a")
+        server = serve_metrics(0, reg)
+        try:
+            port = server.server_address[1]
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert 'lat_bucket{job="default/a",le="+Inf"} 1' in text
+        finally:
+            server.shutdown()

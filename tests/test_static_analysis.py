@@ -1,0 +1,534 @@
+"""tools/analyze: one positive (fires on seeded-bad code) and one negative
+(quiet on good code) fixture per check, the baseline/waiver machinery, output
+formats, and the tier-1 gate -- zero non-baselined findings on the real tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.analyze import runner
+from tools.analyze.findings import Finding, fingerprint_all
+from tools.analyze.runner import (
+    apply_baseline,
+    format_findings,
+    load_baseline,
+    run_checks,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "trainingjob_operator_tpu"
+
+
+def analyze(tmp_path, rel, source, only=None):
+    """Write ``source`` at ``rel`` under tmp_path and run the checks."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_checks([str(path)], root=str(tmp_path), only=only)
+
+
+def ids(findings):
+    return sorted({f.check_id for f in findings})
+
+
+# -- TJA001 py-compat --------------------------------------------------------
+
+class TestPyCompat:
+    def test_fires_on_reintroduced_metrics_bug(self, tmp_path):
+        """Re-introduce the exact seed bug: utils/metrics.py:147's escaped
+        le-label inside an f-string expression."""
+        src = open(os.path.join(REPO_ROOT, PKG, "utils", "metrics.py")).read()
+        good = (
+            '                # Escaped label hoisted out of the f-string: a backslash\n'
+            '                # inside an f-string expression is a SyntaxError before 3.12.\n'
+            "                le_label = f'le=\"{ub}\"'\n"
+            '                lines.append(f"{base}_bucket{lbl(le_label)} {cum}")\n'
+        )
+        bad = (
+            '                lines.append(f\'{base}_bucket{lbl(f"le=\\"{ub}\\"")} {cum}\')\n'
+        )
+        assert good in src, "metrics.py render loop changed; update fixture"
+        broken = src.replace(good, bad)
+        findings = analyze(tmp_path, "utils/metrics.py", broken,
+                           only=["py-compat"])
+        assert ids(findings) == ["TJA001"]
+        # On a 3.10/3.11 interpreter the parse gate reports the SyntaxError;
+        # the token scan must give the same verdict on 3.12+.
+        assert any("3.10" in f.message or "f-string" in f.message
+                   for f in findings)
+
+    def test_fires_on_plain_syntax_error(self, tmp_path):
+        findings = analyze(tmp_path, "m.py", "def broken(:\n    pass\n",
+                           only=["py-compat"])
+        assert ids(findings) == ["TJA001"]
+
+    def test_quiet_on_hoisted_fix_and_current_tree_file(self, tmp_path):
+        fixed = '''
+        def render(lbl, ub, cum):
+            le_label = f'le="{ub}"'
+            return f"bucket{lbl(le_label)} {cum}"
+        '''
+        assert analyze(tmp_path, "m.py", fixed, only=["py-compat"]) == []
+        real = open(os.path.join(REPO_ROOT, PKG, "utils", "metrics.py")).read()
+        assert analyze(tmp_path, "utils/metrics.py", real,
+                       only=["py-compat"]) == []
+
+    def test_backslash_at_depth_zero_is_fine(self, tmp_path):
+        src = 'X = f"a\\n{1 + 2}\\t"\n'
+        assert analyze(tmp_path, "m.py", src, only=["py-compat"]) == []
+
+
+# -- TJA002 lock-discipline --------------------------------------------------
+
+BAD_LOCK = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self.count = 0
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+                self.count += 1
+
+        def racy_clear(self):
+            self._items.clear()
+            self.count = 0
+"""
+
+GOOD_LOCK = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def clear(self):
+            with self._lock:
+                self._items.clear()
+
+        def _drop_locked(self, k):
+            # caller-holds-lock helper convention: exempt
+            self._items.pop(k, None)
+"""
+
+
+class TestLockDiscipline:
+    def test_fires_on_unguarded_mutation(self, tmp_path):
+        findings = analyze(tmp_path, "m.py", BAD_LOCK,
+                           only=["lock-discipline"])
+        assert ids(findings) == ["TJA002"]
+        assert {f.line for f in findings} == {16, 17}
+        assert any("racy_clear" in f.message and "_items" in f.message
+                   for f in findings)
+
+    def test_quiet_on_disciplined_class(self, tmp_path):
+        assert analyze(tmp_path, "m.py", GOOD_LOCK,
+                       only=["lock-discipline"]) == []
+
+    def test_init_is_exempt_and_lockless_class_ignored(self, tmp_path):
+        src = """
+        class Plain:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+        """
+        assert analyze(tmp_path, "m.py", src, only=["lock-discipline"]) == []
+
+    def test_condition_counts_as_lock(self, tmp_path):
+        src = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._queue = []
+
+            def add(self, x):
+                with self._cond:
+                    self._queue.append(x)
+
+            def racy_drain(self):
+                self._queue.clear()
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["lock-discipline"])
+        assert ids(findings) == ["TJA002"]
+
+    def test_quiet_on_real_workqueue_and_expectations(self, tmp_path):
+        for rel in ("client/workqueue.py", "client/expectations.py",
+                    "client/informers.py", "utils/metrics.py"):
+            src = open(os.path.join(REPO_ROOT, PKG, *rel.split("/"))).read()
+            assert analyze(tmp_path, rel, src,
+                           only=["lock-discipline"]) == [], rel
+
+
+# -- TJA003 reconcile-purity -------------------------------------------------
+
+BAD_PURITY = """
+    import time
+    import requests
+
+    def sync(key, queue, thread):
+        time.sleep(1.0)
+        requests.get("http://apiserver/jobs")
+        queue.get()
+        thread.join()
+"""
+
+
+class TestReconcilePurity:
+    def test_fires_inside_controller_dir(self, tmp_path):
+        findings = analyze(tmp_path, "controller/sync.py", BAD_PURITY,
+                           only=["reconcile-purity"])
+        assert ids(findings) == ["TJA003"]
+        assert len(findings) == 4
+
+    def test_out_of_scope_dir_is_quiet(self, tmp_path):
+        assert analyze(tmp_path, "runtime/sync.py", BAD_PURITY,
+                       only=["reconcile-purity"]) == []
+
+    def test_bounded_waits_and_local_names_are_quiet(self, tmp_path):
+        src = """
+        def sync(key, queue, stop):
+            item, _ = queue.get(timeout=0.5)
+            stop.wait(1.0)
+            # a k8s resources dict named "requests" is not the module
+            requests = {}
+            requests.setdefault("cpu", "1")
+        """
+        assert analyze(tmp_path, "controller/sync.py", src,
+                       only=["reconcile-purity"]) == []
+
+    def test_from_import_sleep_detected(self, tmp_path):
+        src = """
+        from time import sleep
+
+        def sync(key):
+            sleep(0.1)
+        """
+        findings = analyze(tmp_path, "controller/sync.py", src,
+                           only=["reconcile-purity"])
+        assert ids(findings) == ["TJA003"]
+
+    def test_waiver_suppresses(self, tmp_path):
+        src = """
+        def run(stop):
+            # analyzer: allow[reconcile-purity]: parks the caller thread
+            stop.wait()
+        """
+        assert analyze(tmp_path, "controller/run.py", src,
+                       only=["reconcile-purity"]) == []
+
+
+# -- TJA004 broad-except -----------------------------------------------------
+
+class TestBroadExcept:
+    def test_fires_on_silent_swallow(self, tmp_path):
+        src = """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+
+        def h():
+            try:
+                g()
+            except:
+                return None
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["broad-except"])
+        assert ids(findings) == ["TJA004"]
+        assert len(findings) == 2
+        assert any("bare except" in f.message for f in findings)
+
+    def test_logging_reraise_and_narrow_are_quiet(self, tmp_path):
+        src = """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def logged():
+            try:
+                g()
+            except Exception:
+                log.exception("g failed")
+
+        def reraised():
+            try:
+                g()
+            except Exception:
+                cleanup()
+                raise
+
+        def narrow():
+            try:
+                g()
+            except (KeyError, ValueError):
+                pass
+        """
+        assert analyze(tmp_path, "m.py", src, only=["broad-except"]) == []
+
+    def test_waiver_comment_suppresses(self, tmp_path):
+        src = """
+        def f():
+            try:
+                g()
+            # analyzer: allow[broad-except]: best-effort cleanup, failure
+            # here must never mask the original exception being handled.
+            except Exception:
+                pass
+        """
+        assert analyze(tmp_path, "m.py", src, only=["broad-except"]) == []
+
+    def test_forwarding_the_bound_exception_is_accountable(self, tmp_path):
+        src = """
+        def forwarded(q):
+            try:
+                g()
+            except Exception as exc:
+                q.put(exc)          # surfaced to the consumer: fine
+
+        def bound_but_dropped():
+            try:
+                g()
+            except Exception as exc:
+                return None         # bound name unused: still swallowing
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["broad-except"])
+        assert len(findings) == 1
+        assert findings[0].line == 11
+
+
+# -- TJA005 constant-drift ---------------------------------------------------
+
+FAKE_CONSTANTS = """
+    JOB_NAME_LABEL = "TrainingJobName"
+    TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+    PRIORITY_LABEL = "priority"
+"""
+
+
+class TestConstantDrift:
+    def _write_constants(self, tmp_path):
+        p = tmp_path / PKG / "api" / "constants.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(FAKE_CONSTANTS))
+
+    def test_fires_on_duplicated_and_undefined_contract_strings(self, tmp_path):
+        self._write_constants(tmp_path)
+        src = """
+        def build(pod):
+            pod.labels["TrainingJobName"] = pod.name      # dup of constant
+            pod.env["TRAININGJOB_NEW_KNOB"] = "1"          # undefined contract
+        """
+        findings = analyze(tmp_path, f"{PKG}/controller/pod.py", src,
+                           only=["constant-drift"])
+        assert ids(findings) == ["TJA005"]
+        msgs = " | ".join(f.message for f in findings)
+        assert "JOB_NAME_LABEL" in msgs
+        assert "TRAININGJOB_NEW_KNOB" in msgs
+
+    def test_quiet_on_constant_usage_and_generic_words(self, tmp_path):
+        self._write_constants(tmp_path)
+        src = """
+        from trainingjob_operator_tpu.api import constants
+
+        def build(pod):
+            pod.labels[constants.JOB_NAME_LABEL] = pod.name
+            pod.labels["priority"] = "high"   # generic word: not contract-shaped
+        """
+        assert analyze(tmp_path, f"{PKG}/controller/pod.py", src,
+                       only=["constant-drift"]) == []
+
+    def test_docstrings_and_out_of_scope_dirs_are_quiet(self, tmp_path):
+        self._write_constants(tmp_path)
+        src = '''
+        """Mentions TPU_WORKER_ID and TrainingJobName in prose."""
+
+        def f():
+            """Also TRAININGJOB_UNDEFINED_IN_DOCSTRING."""
+        '''
+        assert analyze(tmp_path, f"{PKG}/controller/doc.py", src,
+                       only=["constant-drift"]) == []
+        bad = 'X = "TrainingJobName"\n'
+        # models/ is outside the constant-drift scope
+        assert analyze(tmp_path, f"{PKG}/models/m.py", bad,
+                       only=["constant-drift"]) == []
+
+
+# -- TJA006 tracer-safety ----------------------------------------------------
+
+BAD_JIT = """
+    import jax
+
+    @jax.jit
+    def step(x, lr):
+        if lr > 0.5:
+            x = x * lr
+        while x > 0:
+            x = x - 1
+        loss = float(x)
+        print("loss", loss)
+        return x.item()
+"""
+
+GOOD_JIT = """
+    from functools import partial
+    import jax
+    from jax import lax
+
+    @partial(jax.jit, static_argnames=("n",))
+    def step(x, n, mask=None):
+        if n > 2:              # static: fine
+            x = x + n
+        if mask is None:       # concrete at trace time: fine
+            mask = x * 0
+        return lax.cond(x.sum() > 0, lambda v: v, lambda v: -v, x)
+
+    def helper(x):             # not traced at all
+        if x > 0:
+            print(x)
+        return float(x)
+"""
+
+
+class TestTracerSafety:
+    def test_fires_on_all_three_bug_classes(self, tmp_path):
+        findings = analyze(tmp_path, "models/step.py", BAD_JIT,
+                           only=["tracer-safety"])
+        assert ids(findings) == ["TJA006"]
+        msgs = " | ".join(f.message for f in findings)
+        assert "Python 'if' on traced" in msgs
+        assert "Python 'while' on traced" in msgs
+        assert "float()" in msgs
+        assert ".item()" in msgs
+        assert "jax.debug.print" in msgs
+
+    def test_statics_none_checks_and_untraced_are_quiet(self, tmp_path):
+        assert analyze(tmp_path, "models/step.py", GOOD_JIT,
+                       only=["tracer-safety"]) == []
+
+    def test_assignment_wrapped_function_detected(self, tmp_path):
+        src = """
+        import jax
+
+        def body(q):
+            if q > 0:
+                q = -q
+            return q
+
+        wrapped = jax.jit(body)
+        """
+        findings = analyze(tmp_path, "ops/m.py", src, only=["tracer-safety"])
+        assert ids(findings) == ["TJA006"]
+
+    def test_out_of_scope_dir_is_quiet(self, tmp_path):
+        assert analyze(tmp_path, "controller/m.py", BAD_JIT,
+                       only=["tracer-safety"]) == []
+
+
+# -- runner: baseline, waivers, formats, CLI ---------------------------------
+
+class TestRunnerMachinery:
+    def test_baseline_roundtrip_suppresses_old_reports_new(self, tmp_path):
+        bad = tmp_path / "m.py"
+        bad.write_text("def f():\n    try:\n        g()\n"
+                       "    except Exception:\n        pass\n")
+        first = run_checks([str(bad)], root=str(tmp_path))
+        assert len(first) == 1
+        baseline_path = tmp_path / "baseline.json"
+        assert write_baseline(str(baseline_path), first) == 1
+        fresh, suppressed = apply_baseline(
+            run_checks([str(bad)], root=str(tmp_path)),
+            load_baseline(str(baseline_path)))
+        assert fresh == [] and suppressed == 1
+        # A *new* finding elsewhere in the file still surfaces -- and the
+        # old fingerprint survives the line shift above it.
+        bad.write_text("def z():\n    try:\n        g()\n"
+                       "    except Exception:\n        return 1\n\n"
+                       + bad.read_text())
+        fresh, suppressed = apply_baseline(
+            run_checks([str(bad)], root=str(tmp_path)),
+            load_baseline(str(baseline_path)))
+        assert len(fresh) == 1 and suppressed == 1
+
+    def test_allow_star_waives_any_check(self, tmp_path):
+        src = """
+        import time
+
+        def sync(key):
+            # analyzer: allow[*]: fixture
+            time.sleep(1)
+        """
+        assert analyze(tmp_path, "controller/m.py", src) == []
+
+    def test_unknown_check_name_raises(self, tmp_path):
+        (tmp_path / "m.py").write_text("x = 1\n")
+        with pytest.raises(ValueError, match="unknown check"):
+            run_checks([str(tmp_path)], root=str(tmp_path), only=["nope"])
+
+    def test_formats(self):
+        f = Finding("TJA001", "py-compat", "a/b.py", 3, 4, "error", "boom")
+        text = format_findings([f], "text")
+        assert text == "a/b.py:3:4: TJA001[py-compat] error: boom\n"
+        gh = format_findings([f], "github")
+        assert gh.startswith("::error file=a/b.py,line=3,col=4,")
+        js = json.loads(format_findings([f], "json"))
+        assert js[0]["check_id"] == "TJA001" and js[0]["line"] == 3
+
+    def test_fingerprints_disambiguate_identical_messages(self):
+        a = Finding("TJA004", "broad-except", "m.py", 3, 0, "warning", "same")
+        b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
+        assert len(fingerprint_all([a, b])) == 2
+
+    def test_all_six_checks_registered(self):
+        runner._load_checks()
+        assert {cid for cid, _fn in runner.REGISTRY.values()} == {
+            "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006"}
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+class TestRepoIsClean:
+    def test_zero_non_baselined_findings_on_the_repo(self):
+        """The contract ``make lint`` enforces: the analyzer exits 0 on the
+        tree, with every finding either fixed, waived, or baselined."""
+        findings = run_checks([os.path.join(REPO_ROOT, PKG)], root=REPO_ROOT)
+        if os.path.exists(runner.DEFAULT_BASELINE):
+            findings, _ = apply_baseline(
+                findings, load_baseline(runner.DEFAULT_BASELINE))
+        assert findings == [], format_findings(findings, "text")
+
+    def test_cli_exits_zero_on_repo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", PKG, "--format=github"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_exits_nonzero_on_seeded_bug(self, tmp_path):
+        (tmp_path / "bad.py").write_text(
+            "def f():\n    try:\n        g()\n"
+            "    except Exception:\n        pass\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", str(tmp_path),
+             "--no-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        assert "TJA004" in proc.stdout

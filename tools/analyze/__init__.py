@@ -1,0 +1,39 @@
+"""AST-based operator-lint suite (docs/STATIC_ANALYSIS.md).
+
+Six repo-specific passes over stdlib ``ast``:
+
+=======  =================  =====================================================
+ID       name               what it catches
+=======  =================  =====================================================
+TJA001   py-compat          files that don't parse under the oldest supported
+                            grammar (Python 3.10), e.g. f-string backslashes
+TJA002   lock-discipline    attribute mutations outside ``with self._lock:`` in
+                            classes that create a Lock/RLock/Condition
+TJA003   reconcile-purity   time.sleep / blocking HTTP-socket calls / unbounded
+                            waits inside controller reconcile paths
+TJA004   broad-except       ``except Exception:`` / bare ``except:`` that neither
+                            logs, re-raises, nor carries a waiver comment
+TJA005   constant-drift     label/annotation/env-var contract strings used inline
+                            instead of via api/constants.py
+TJA006   tracer-safety      Python control flow on traced values, float()/.item()
+                            host syncs, and print() inside jit/pmap/shard_map
+=======  =================  =====================================================
+
+Run: ``python -m tools.analyze trainingjob_operator_tpu/`` (see __main__.py).
+"""
+
+from tools.analyze.findings import ERROR, WARNING, FileContext, Finding
+from tools.analyze.runner import (
+    REGISTRY,
+    apply_baseline,
+    format_findings,
+    load_baseline,
+    run_checks,
+    write_baseline,
+)
+
+__all__ = [
+    "ERROR", "WARNING", "FileContext", "Finding", "REGISTRY",
+    "apply_baseline", "format_findings", "load_baseline", "run_checks",
+    "write_baseline",
+]

@@ -1,0 +1,74 @@
+"""CLI: ``python -m tools.analyze [paths...]``.
+
+Exit code 0 when every finding is baselined (or none exist), 1 otherwise --
+the contract tests/test_static_analysis.py and ``make lint`` rely on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.analyze import runner
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="AST-based operator lint (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=["trainingjob_operator_tpu"],
+                    help="files or directories to analyze "
+                         "(default: trainingjob_operator_tpu)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of grandfathered findings "
+                         f"(default: {runner.DEFAULT_BASELINE} if it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring any baseline")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="snapshot current findings as the baseline and exit 0")
+    ap.add_argument("--checks", default=None,
+                    help="comma-separated subset of check names or IDs")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        runner._load_checks()
+        for name, (cid, _fn) in sorted(runner.REGISTRY.items(),
+                                       key=lambda kv: kv[1][0]):
+            print(f"{cid}  {name}")
+        return 0
+
+    only = args.checks.split(",") if args.checks else None
+    paths = args.paths or ["trainingjob_operator_tpu"]
+    findings = runner.run_checks(paths, root=os.getcwd(), only=only)
+
+    if args.write_baseline:
+        n = runner.write_baseline(args.write_baseline, findings)
+        print(f"wrote {n} baselined finding(s) to {args.write_baseline}",
+              file=sys.stderr)
+        return 0
+
+    suppressed = 0
+    if not args.no_baseline:
+        baseline_path = args.baseline or (
+            runner.DEFAULT_BASELINE
+            if os.path.exists(runner.DEFAULT_BASELINE) else None)
+        if baseline_path:
+            findings, suppressed = runner.apply_baseline(
+                findings, runner.load_baseline(baseline_path))
+
+    out = runner.format_findings(findings, args.format)
+    if out.strip():
+        print(out, end="")
+    summary = f"{len(findings)} finding(s)"
+    if suppressed:
+        summary += f", {suppressed} baselined"
+    print(summary, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

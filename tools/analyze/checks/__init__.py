@@ -1,0 +1,1 @@
+"""Analyzer passes.  Each module @registers itself with the runner."""

@@ -1,0 +1,88 @@
+"""TJA004 broad-except: swallowing ``Exception`` must be a decision, not a
+default.
+
+In a restart state machine, an ``except Exception: pass`` around a status
+write silently corrupts job state -- the job looks Running while its pods are
+gone (the failure class ISSUE.md cites from Singularity).  A broad handler is
+accepted only when it visibly does one of:
+
+- re-raises (``raise`` anywhere in the handler);
+- logs through a recognized logging call (``log.exception(...)``,
+  ``logger.warning(...)``, ``logging.error(...)``, ``traceback.*``);
+- binds the exception (``as exc``) and actually *uses* the bound name --
+  forwarding it to a queue, a result payload, or an error report is
+  surfacing, not swallowing; or
+- carries an explicit waiver: ``# analyzer: allow[broad-except]: <reason>``
+  on the ``except`` line or in the comment block above (the generic waiver
+  the runner honors for every pass -- here it is the *documented* escape
+  hatch).
+
+Narrow handlers (``except (ConflictError, NotFoundError):``) are never
+flagged: catching what you can name is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.findings import FileContext, Finding, WARNING
+from tools.analyze.runner import register
+
+LOGGING_METHODS = {"exception", "error", "warning", "critical", "info",
+                   "debug", "log"}
+BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD_NAMES:
+            return True
+    return False
+
+
+def _handler_is_accountable(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (handler.name is not None and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True  # the bound exception is forwarded somewhere
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in LOGGING_METHODS:
+                    return True
+                root = fn.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "traceback":
+                    return True
+    return False
+
+
+@register("TJA004", "broad-except")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+            continue
+        if _handler_is_accountable(node):
+            continue
+        what = "bare except" if node.type is None else "except Exception"
+        findings.append(Finding(
+            "TJA004", "broad-except", ctx.path, node.lineno, node.col_offset,
+            WARNING,
+            f"{what} neither logs nor re-raises; add logging, narrow the "
+            "exception, or waive with "
+            "'# analyzer: allow[broad-except]: <reason>'"))
+    return findings

@@ -1,0 +1,145 @@
+"""TJA005 constant-drift: the label/annotation/env contract lives in
+``api/constants.py`` -- nowhere else.
+
+The operator's contract with workloads is a set of magic strings: pod label
+keys, annotation keys, and injected env-var names (``TPU_WORKER_ID``, the
+``TRAININGJOB_*`` identity set, ``MEGASCALE_*`` rendezvous hosts).  A typo'd
+inline copy in ``controller/``/``runtime/``/``workloads/`` doesn't fail --
+it silently mismatches: the pod gets one label, the selector looks for
+another, and reconcile sees orphans.  Two failure shapes are flagged:
+
+1. an inline literal exactly equal to a constant defined in
+   ``api/constants.py`` (use the constant); and
+2. a new ``TRAININGJOB_*`` / ``TPU_WORKER_*`` / ``MEGASCALE_*`` contract
+   string that is *not* defined there (define it first).
+
+Only "contract-shaped" constants participate in (1): values containing an
+upper-case letter, a dot, or a slash.  Generic lowercase words
+(``"priority"``) would otherwise flood the pass with coincidences.
+Docstrings and f-string literal segments are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+SCOPE_DIRS = ("/controller/", "/runtime/", "/workloads/")
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+CONTRACT_ENV_RE = re.compile(
+    r"^(TRAININGJOB_[A-Z0-9_]+|TPU_WORKER_[A-Z0-9_]+|MEGASCALE_[A-Z0-9_]+)$")
+
+_cache: Dict[str, Tuple[float, Dict[str, str], Set[str]]] = {}
+
+
+def _contract_shaped(value: str) -> bool:
+    return bool(re.search(r"[A-Z./]", value)) and 3 <= len(value) <= 120
+
+
+def _load_constants(repo_root: str) -> Tuple[Dict[str, str], Set[str]]:
+    """(value -> constant name) plus the set of every defined string value
+    (including non-contract-shaped ones, for pattern check 2)."""
+    path = os.path.join(repo_root, CONSTANTS_REL)
+    if not os.path.exists(path):
+        return {}, set()
+    mtime = os.path.getmtime(path)
+    cached = _cache.get(path)
+    if cached and cached[0] == mtime:
+        return cached[1], cached[2]
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    by_value: Dict[str, str] = {}
+    all_values: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        name = node.targets[0].id if (
+            len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)) else None
+        if name is None:
+            continue
+        values: List[str] = []
+        if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+            values = [node.value.value]
+        elif isinstance(node.value, (ast.Tuple, ast.List)):
+            values = [el.value for el in node.value.elts
+                      if isinstance(el, ast.Constant)
+                      and isinstance(el.value, str)]
+        elif isinstance(node.value, ast.JoinedStr):
+            # e.g. API_VERSION = f"{GROUP_NAME}/{GROUP_VERSION}" -- the value
+            # is derived; skip rather than evaluate.
+            continue
+        for v in values:
+            all_values.add(v)
+            if _contract_shaped(v) and v not in by_value:
+                by_value[v] = name
+    _cache[path] = (mtime, by_value, all_values)
+    return by_value, all_values
+
+
+def _docstring_and_fstring_nodes(tree: ast.Module) -> Set[int]:
+    skip: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                skip.add(id(body[0].value))
+        elif isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.Constant):
+                    skip.add(id(part))
+    return skip
+
+
+def _repo_root(ctx: FileContext) -> Optional[str]:
+    # abs_path ends with the repo-relative path; strip it off.
+    suffix = ctx.path.replace("/", os.sep)
+    if ctx.abs_path.endswith(suffix):
+        return ctx.abs_path[:-len(suffix)].rstrip(os.sep) or os.sep
+    return None
+
+
+@register("TJA005", "constant-drift")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    marked = f"/{ctx.path}"
+    if not any(d in marked for d in SCOPE_DIRS):
+        return []
+    root = _repo_root(ctx)
+    if root is None:
+        return []
+    by_value, all_values = _load_constants(root)
+    if not by_value and not all_values:
+        return []
+    skip = _docstring_and_fstring_nodes(ctx.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+            continue
+        if id(node) in skip:
+            continue
+        value = node.value
+        const_name = by_value.get(value)
+        if const_name is not None:
+            findings.append(Finding(
+                "TJA005", "constant-drift", ctx.path, node.lineno,
+                node.col_offset, ERROR,
+                f"inline literal {value!r} duplicates "
+                f"api/constants.py:{const_name}; import the constant "
+                "(a typo'd copy silently breaks the label/env contract)"))
+        elif CONTRACT_ENV_RE.match(value) and value not in all_values:
+            findings.append(Finding(
+                "TJA005", "constant-drift", ctx.path, node.lineno,
+                node.col_offset, ERROR,
+                f"contract env var {value!r} is not defined in "
+                "api/constants.py; define it there and import it"))
+    return findings

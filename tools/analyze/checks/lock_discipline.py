@@ -1,0 +1,176 @@
+"""TJA002 lock-discipline: a static race detector for the reconcile plane.
+
+In any class that creates a ``threading.Lock``/``RLock``/``Condition`` (the
+workqueue, informers, expectations, tracker, metrics registry), an attribute
+is *guarded* when some method mutates it inside ``with self._lock:``.  Mixed
+discipline -- the same attribute also mutated outside the lock elsewhere --
+is exactly the latent race ISSUE.md cites: it works until two workqueue
+threads interleave, then silently corrupts controller state.
+
+Heuristics that keep the pass quiet on correct code:
+
+- ``__init__`` is exempt (the object is not yet shared during construction).
+- Methods whose name ends in ``_locked`` are exempt (the caller-holds-lock
+  helper convention).
+- Only attributes *sometimes* guarded are checked; a field never touched
+  under the lock is assumed single-threaded by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: Method names on a ``self.X`` receiver that mutate X in place.
+MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "setdefault", "push", "heappush", "heappop", "sort", "reverse",
+}
+
+
+def _is_lock_factory(call: ast.expr) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name in LOCK_FACTORIES
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' when node is exactly ``self.x``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutated_attrs(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    """(attr name, node) for every ``self.X`` mutated by this statement
+    (not descending into nested statements -- the walker handles nesting)."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def target_attrs(target: ast.expr):
+        # self.x = ..., self.x[k] = ..., and tuple-unpack combinations
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                target_attrs(el)
+            return
+        if isinstance(target, (ast.Subscript, ast.Starred)):
+            target_attrs(target.value)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            out.append((attr, target))
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            target_attrs(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if stmt.target is not None:
+            target_attrs(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            target_attrs(t)
+    elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        fn = stmt.value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            attr = _self_attr(fn.value)
+            if attr is not None:
+                out.append((attr, stmt.value))
+    return out
+
+
+class _MethodWalker:
+    """Walk one method body tracking whether each statement runs under a
+    ``with self.<lock>:`` for any of the class's lock attributes."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.guarded: List[Tuple[str, ast.AST]] = []    # mutations under lock
+        self.unguarded: List[Tuple[str, ast.AST]] = []  # mutations outside
+
+    def _holds_lock(self, node: ast.With) -> bool:
+        for item in node.items:
+            expr = item.context_expr
+            # ``with self._lock:`` and ``with self._cond:`` -- also accept
+            # ``with self._lock.acquire_timeout(...)``-style wrappers.
+            attr = _self_attr(expr)
+            if attr is None and isinstance(expr, ast.Call):
+                fn = expr.func
+                if isinstance(fn, ast.Attribute):
+                    attr = _self_attr(fn.value)
+            if attr in self.lock_attrs:
+                return True
+        return False
+
+    def walk(self, stmts: List[ast.stmt], locked: bool) -> None:
+        for stmt in stmts:
+            for attr, node in _mutated_attrs(stmt):
+                (self.guarded if locked else self.unguarded).append((attr, node))
+            if isinstance(stmt, ast.With):
+                self.walk(stmt.body, locked or self._holds_lock(stmt))
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A closure defined here may run on another thread later:
+                # treat its body as NOT holding the lock.
+                self.walk(stmt.body, False)
+            else:
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    children = getattr(stmt, field, None)
+                    if not children:
+                        continue
+                    for child in children:
+                        if isinstance(child, ast.ExceptHandler):
+                            self.walk(child.body, locked)
+                        elif isinstance(child, ast.stmt):
+                            self.walk([child], locked)
+
+
+@register("TJA002", "lock-discipline")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        lock_attrs: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            lock_attrs.add(attr)
+        if not lock_attrs:
+            continue
+
+        guarded: Set[str] = set()
+        per_method: Dict[str, _MethodWalker] = {}
+        for m in methods:
+            w = _MethodWalker(lock_attrs)
+            w.walk(m.body, locked=False)
+            per_method[m.name] = w
+            guarded.update(attr for attr, _node in w.guarded)
+        guarded -= lock_attrs  # reassigning the lock itself is not data
+
+        for m in methods:
+            if m.name == "__init__" or m.name.endswith("_locked"):
+                continue
+            for attr, node in per_method[m.name].unguarded:
+                if attr not in guarded:
+                    continue
+                findings.append(Finding(
+                    "TJA002", "lock-discipline", ctx.path,
+                    getattr(node, "lineno", m.lineno),
+                    getattr(node, "col_offset", 0), ERROR,
+                    f"{cls.name}.{m.name} mutates self.{attr} outside "
+                    f"'with self.{sorted(lock_attrs)[0]}:' but other code "
+                    f"mutates it under the lock (data race)"))
+    return findings

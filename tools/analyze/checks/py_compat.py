@@ -1,0 +1,123 @@
+"""TJA001 py-compat: every file must parse under the oldest supported grammar.
+
+We support Python 3.10+.  The seed's motivating bug: a backslash inside an
+f-string replacement field (``f'{lbl(f"le=\\"{ub}\\"")}'``,
+utils/metrics.py:147) is a SyntaxError on 3.10/3.11 but *legal* on 3.12+
+(PEP 701) -- so a dev on 3.12 commits it green and every 3.10 runner fails at
+import time, taking out all five test modules that transitively import the
+controller package.
+
+Two layers:
+
+1. Parse gate -- the shared ``ast.parse`` already ran; a file that failed it
+   is reported with the SyntaxError position.  On a 3.10 interpreter this is
+   the full grammar check.
+2. F-string backslash scan -- token-level, so it also fires when the analyzer
+   itself runs on 3.12+ where the parse would succeed.
+"""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import List, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+MIN_GRAMMAR = (3, 10)
+
+
+def _string_prefix(tok_text: str) -> str:
+    for i, ch in enumerate(tok_text):
+        if ch in "\"'":
+            return tok_text[:i].lower()
+    return ""
+
+
+def _body_of(tok_text: str) -> Tuple[str, int]:
+    """(string body, offset of body start within the token text)."""
+    prefix = len(_string_prefix(tok_text))
+    rest = tok_text[prefix:]
+    quote = rest[:3] if rest[:3] in ('"""', "'''") else rest[:1]
+    return rest[len(quote):-len(quote)], prefix + len(quote)
+
+
+def _scan_fstring_token(tok: tokenize.TokenInfo) -> List[Tuple[int, int]]:
+    """Backslash positions inside replacement fields of one f-string token
+    (pre-3.12 tokenizer: the whole literal is a single STRING token)."""
+    body, body_off = _body_of(tok.string)
+    hits: List[Tuple[int, int]] = []
+    depth = 0
+    line, col = tok.start[0], tok.start[1] + body_off
+    i = 0
+    while i < len(body):
+        ch = body[i]
+        nxt = body[i + 1] if i + 1 < len(body) else ""
+        if ch in "{}" and nxt == ch:       # literal {{ or }}
+            i, col = i + 2, col + 2
+            continue
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(depth - 1, 0)
+        elif ch == "\\" and depth > 0:
+            hits.append((line, col))
+        if ch == "\n":
+            line, col = line + 1, 0
+        else:
+            col += 1
+        i += 1
+    return hits
+
+
+def _fstring_backslash_positions(source: str) -> List[Tuple[int, int]]:
+    hits: List[Tuple[int, int]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return hits  # unreadable source: the parse gate already reported it
+    fstring_start = getattr(tokenize, "FSTRING_START", None)
+    fstring_parts = {t for t in (fstring_start,
+                                 getattr(tokenize, "FSTRING_MIDDLE", None),
+                                 getattr(tokenize, "FSTRING_END", None))
+                     if t is not None}
+    depth = 0
+    for tok in tokens:
+        if tok.type == tokenize.STRING and "f" in _string_prefix(tok.string):
+            hits.extend(_scan_fstring_token(tok))
+        elif fstring_start is not None:
+            # 3.12+ tokenizer: expression tokens stream between START/END.
+            if tok.type == fstring_start:
+                depth += 1
+            elif tok.type == getattr(tokenize, "FSTRING_END", -1):
+                depth = max(depth - 1, 0)
+            elif (depth > 0 and tok.type not in fstring_parts
+                  and "\\" in tok.string):
+                hits.append(tok.start)
+    return hits
+
+
+@register("TJA001", "py-compat")
+def check(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    if ctx.tree is None:
+        try:
+            compile(ctx.source, ctx.path, "exec", dont_inherit=True)
+            line, col, msg = 1, 0, "file does not parse"
+        except SyntaxError as exc:
+            line, col = exc.lineno or 1, (exc.offset or 1) - 1
+            msg = exc.msg or "syntax error"
+        findings.append(Finding(
+            "TJA001", "py-compat", ctx.path, line, col, ERROR,
+            f"does not parse under Python "
+            f"{MIN_GRAMMAR[0]}.{MIN_GRAMMAR[1]} grammar: {msg}"))
+        return findings
+    for line, col in _fstring_backslash_positions(ctx.source):
+        findings.append(Finding(
+            "TJA001", "py-compat", ctx.path, line, col, ERROR,
+            "backslash inside f-string replacement field is a SyntaxError "
+            f"before Python 3.12 (oldest supported grammar is "
+            f"{MIN_GRAMMAR[0]}.{MIN_GRAMMAR[1]}); hoist the escaped text "
+            "into a variable"))
+    return findings

@@ -1,0 +1,98 @@
+"""TJA003 reconcile-purity: no blocking inside the reconcile plane.
+
+Controller reconcile paths (``controller/*.py``) run on a small fixed pool of
+workqueue workers.  One ``time.sleep`` or blocking HTTP/socket call stalls a
+worker and, because the workqueue guarantees one-writer-per-key, stalls every
+job hashed behind it; an *unbounded* wait can wedge the worker forever.  The
+correct idiom is always to return and re-enqueue with
+``work_queue.add_after/add_rate_limited`` (SURVEY.md §5.2, Singularity
+arxiv 2202.07848 makes the same argument for preemptive schedulers).
+
+Flags, within ``controller/`` modules only:
+
+- ``time.sleep(...)`` (module attribute or from-imported name);
+- any call into ``requests``/``urllib``/``socket``/``http``/``subprocess``
+  *when that module is imported by the file* (a local variable named
+  ``requests`` -- e.g. a k8s resource dict -- is not confused for the module);
+- ``.wait()`` / ``.join()`` / ``.acquire()`` / ``.get()`` calls with no
+  positional argument and no ``timeout=`` keyword: unbounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+BLOCKING_MODULES = {"requests", "urllib", "socket", "http", "subprocess"}
+UNBOUNDED_METHODS = {"wait", "join", "acquire", "get"}
+
+
+def in_scope(path: str) -> bool:
+    return "/controller/" in f"/{path}"
+
+
+def _root_name(node: ast.expr) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _imported_names(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _sleep_imported_from_time(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            if any((a.asname or a.name) == "sleep" for a in node.names):
+                return True
+    return False
+
+
+@register("TJA003", "reconcile-purity")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None or not in_scope(ctx.path):
+        return []
+    imported = _imported_names(ctx.tree)
+    bare_sleep = _sleep_imported_from_time(ctx.tree)
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str) -> None:
+        findings.append(Finding("TJA003", "reconcile-purity", ctx.path,
+                                node.lineno, node.col_offset, ERROR, msg))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            root = _root_name(fn.value)
+            if fn.attr == "sleep" and root == "time" and "time" in imported:
+                emit(node, "time.sleep in a reconcile path blocks a workqueue "
+                           "worker; return and re-enqueue with add_after")
+                continue
+            if root in BLOCKING_MODULES and root in imported:
+                emit(node, f"blocking {root}.* call in a reconcile path; "
+                           "controllers must not do I/O inline -- re-enqueue "
+                           "and let a runtime/background thread block")
+                continue
+            if (fn.attr in UNBOUNDED_METHODS and not node.args
+                    and not any(kw.arg == "timeout" for kw in node.keywords)):
+                emit(node, f".{fn.attr}() with no timeout is an unbounded "
+                           "wait inside the reconcile plane; pass a timeout "
+                           "or restructure via the workqueue")
+        elif isinstance(fn, ast.Name) and fn.id == "sleep" and bare_sleep:
+            emit(node, "time.sleep in a reconcile path blocks a workqueue "
+                       "worker; return and re-enqueue with add_after")
+    return findings

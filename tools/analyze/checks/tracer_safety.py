@@ -1,0 +1,183 @@
+"""TJA006 tracer-safety: traced values are not Python values.
+
+Inside a function staged out by ``jit``/``pmap``/``shard_map`` (Podracer,
+arxiv 2104.06272: the whole TPU program is one traced computation), the
+arguments are tracers.  Three bug classes:
+
+- ``if x > 0:`` / ``while err > tol:`` on a traced value raises a
+  ``ConcretizationTypeError`` at trace time *if you're lucky* -- or, when the
+  value happens to be concrete during tracing (weak types, consts), silently
+  bakes one branch into the compiled program;
+- ``float(x)`` / ``int(x)`` / ``x.item()`` / ``x.tolist()`` force a host
+  sync, a device round-trip per call inside the hot step function;
+- ``print(...)`` runs at *trace* time, once, not per step -- use
+  ``jax.debug.print``.
+
+Scope: ``models/``, ``ops/``, ``parallel/``.  A function counts as traced
+when decorated with ``jit``/``pmap`` (bare, ``jax.``-qualified, or under
+``partial(...)``) or when its name is passed to ``jax.jit(...)`` /
+``pmap(...)`` / ``shard_map(...)`` in the same file.  Parameters named in
+``static_argnames``/``static_argnums`` are exempt, as are ``x is None``
+checks (concrete at trace time).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analyze.findings import ERROR, FileContext, Finding, WARNING
+from tools.analyze.runner import register
+
+SCOPE_DIRS = ("/models/", "/ops/", "/parallel/")
+TRACING_WRAPPERS = {"jit", "pmap", "shard_map"}
+HOST_SYNC_METHODS = {"item", "tolist"}
+HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    """'jit' for ``jit``, ``jax.jit``, ``jax.experimental.shard_map``..."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _tracing_call(call: ast.Call) -> Optional[ast.Call]:
+    """The jit/pmap/shard_map Call when ``call`` is one (possibly inside
+    partial(...)), else None."""
+    name = _base_name(call.func)
+    if name in TRACING_WRAPPERS:
+        return call
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if _base_name(inner) in TRACING_WRAPPERS:
+            return call  # statics live on the partial call itself
+    return None
+
+
+def _static_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        parts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for p in parts:
+            if isinstance(p, ast.Constant) and isinstance(p.value, str):
+                out.add(p.value)
+    return out
+
+
+def _static_nums(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        parts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for p in parts:
+            if isinstance(p, ast.Constant) and isinstance(p.value, int):
+                out.add(p.value)
+    return out
+
+
+def _traced_functions(tree: ast.Module) -> Dict[str, ast.Call]:
+    """function name -> the tracing Call that wraps it (for statics)."""
+    wrapped: Dict[str, ast.Call] = {}
+    funcs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    tc = _tracing_call(dec)
+                    if tc is not None:
+                        wrapped[node.name] = tc
+                elif _base_name(dec) in TRACING_WRAPPERS:
+                    wrapped[node.name] = ast.Call(func=dec, args=[],
+                                                  keywords=[])
+        elif isinstance(node, ast.Call):
+            tc = _tracing_call(node)
+            # jax.jit(fn, ...) / shard_map(fn, mesh=...) with a named fn
+            if tc is node and node.args and isinstance(node.args[0], ast.Name):
+                wrapped.setdefault(node.args[0].id, node)
+    return {name: call for name, call in wrapped.items() if name in funcs}
+
+
+def _traced_params(fn: ast.FunctionDef, wrap: ast.Call) -> Set[str]:
+    statics = _static_names(wrap)
+    nums = _static_nums(wrap)
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    traced = {name for i, name in enumerate(pos)
+              if i not in nums and name not in statics}
+    traced.update(a.arg for a in fn.args.kwonlyargs if a.arg not in statics)
+    traced.discard("self")
+    return traced
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_none_check(test: ast.expr) -> bool:
+    """``x is None`` / ``x is not None`` -- concrete at trace time."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+            and any(isinstance(c, ast.Constant) and c.value is None
+                    for c in [test.left, *test.comparators]))
+
+
+@register("TJA006", "tracer-safety")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    marked = f"/{ctx.path}"
+    if not any(d in marked for d in SCOPE_DIRS):
+        return []
+    findings: List[Finding] = []
+    funcs = {n.name: n for n in ast.walk(ctx.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def emit(node: ast.AST, severity: str, msg: str) -> None:
+        findings.append(Finding("TJA006", "tracer-safety", ctx.path,
+                                node.lineno, node.col_offset, severity, msg))
+
+    for name, wrap in _traced_functions(ctx.tree).items():
+        fn = funcs[name]
+        traced = _traced_params(fn, wrap)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and not _is_none_check(node.test):
+                if (isinstance(node.test, ast.Compare)
+                        and _names_in(node.test) & traced):
+                    emit(node.test, ERROR,
+                         f"Python 'if' on traced value(s) "
+                         f"{sorted(_names_in(node.test) & traced)} inside "
+                         f"jit-wrapped '{name}'; use lax.cond/lax.select or "
+                         "mark the argument static")
+            elif isinstance(node, ast.While):
+                hits = _names_in(node.test) & traced
+                if hits:
+                    emit(node.test, ERROR,
+                         f"Python 'while' on traced value(s) {sorted(hits)} "
+                         f"inside jit-wrapped '{name}'; use lax.while_loop")
+            elif isinstance(node, ast.Call):
+                cf = node.func
+                if (isinstance(cf, ast.Name) and cf.id in HOST_SYNC_BUILTINS
+                        and node.args and _names_in(node.args[0]) & traced):
+                    emit(node, ERROR,
+                         f"{cf.id}() on a traced value inside jit-wrapped "
+                         f"'{name}' forces a host sync (ConcretizationError "
+                         "under jit); keep it on-device")
+                elif (isinstance(cf, ast.Attribute)
+                        and cf.attr in HOST_SYNC_METHODS
+                        and _names_in(cf.value) & traced):
+                    emit(node, ERROR,
+                         f".{cf.attr}() on a traced value inside jit-wrapped "
+                         f"'{name}' forces a device->host round-trip per call")
+                elif isinstance(cf, ast.Name) and cf.id == "print":
+                    emit(node, WARNING,
+                         f"print() inside jit-wrapped '{name}' runs at trace "
+                         "time, not per step; use jax.debug.print")
+    return findings

@@ -1,0 +1,91 @@
+"""Finding model shared by all analyzer passes.
+
+A finding is one defect at one source location.  Its *fingerprint*
+deliberately excludes the line number: baselines must survive unrelated edits
+above a grandfathered finding, so identity is (check, file, message, index-
+among-identical-messages-in-file) -- the scheme flake8/ratchet-style baselines
+converge on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+ERROR = "error"
+WARNING = "warning"
+
+_SEVERITY_RANK = {ERROR: 0, WARNING: 1}
+
+
+@dataclass(frozen=True)
+class Finding:
+    check_id: str       # e.g. "TJA001"
+    check_name: str     # e.g. "py-compat"
+    path: str           # repo-relative, forward slashes
+    line: int
+    col: int
+    severity: str       # ERROR | WARNING
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.col,
+                _SEVERITY_RANK.get(self.severity, 9), self.check_id)
+
+
+def fingerprint(f: Finding, occurrence: int) -> str:
+    """Stable identity for baselining: line-number independent."""
+    raw = f"{f.check_id}|{f.path}|{f.message}|{occurrence}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def fingerprint_all(findings: List[Finding]) -> Dict[str, Finding]:
+    """Fingerprint a finding list, disambiguating identical messages in the
+    same file by occurrence index (document order)."""
+    seen: Dict[str, int] = {}
+    out: Dict[str, Finding] = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        key = f"{f.check_id}|{f.path}|{f.message}"
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out[fingerprint(f, occ)] = f
+    return out
+
+
+@dataclass
+class FileContext:
+    """Everything a check needs about one source file, parsed once."""
+    path: str                 # repo-relative
+    abs_path: str
+    source: str
+    lines: List[str] = field(default_factory=list)
+    tree: object = None       # ast.Module | None when the file doesn't parse
+
+    def waived(self, line: int, check_name: str) -> bool:
+        """True when ``line`` (or the line above) carries an explicit waiver:
+
+            # analyzer: allow[<check-name>] <reason>
+
+        ``allow[*]`` waives every check on that line.  The tag may sit on the
+        flagged line itself or anywhere in the contiguous comment block
+        immediately above it (waiver rationales are encouraged to span
+        lines).  The reason text is required by convention but not enforced.
+        """
+        def tagged(text: str) -> bool:
+            return (f"analyzer: allow[{check_name}]" in text
+                    or "analyzer: allow[*]" in text)
+
+        if not 1 <= line <= len(self.lines):
+            return False
+        if tagged(self.lines[line - 1]):
+            return True
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            if tagged(self.lines[ln - 1]):
+                return True
+            ln -= 1
+        return False

@@ -1,0 +1,156 @@
+"""Check registry, file walking, baseline handling, and output formats.
+
+The analyzer is a milliseconds-scale pre-test gate (docs/STATIC_ANALYSIS.md):
+every pass works off one shared ``ast`` parse per file, so the whole repo is
+analyzed in well under a second -- cheap enough to run before every pytest
+invocation via tests/test_static_analysis.py and ``make lint``.
+
+Baseline protocol: ``--write-baseline`` snapshots the current findings as
+grandfathered; subsequent runs report only *new* findings (and exit 0 when
+there are none).  Fingerprints are line-number independent (findings.py) so
+edits elsewhere in a file don't invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding, fingerprint_all
+
+#: check_name -> (check_id, run callable).  Populated by @register.
+REGISTRY: Dict[str, Tuple[str, Callable[[FileContext], List[Finding]]]] = {}
+
+#: Directories never analyzed (vendored/output trees).
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
+             ".eggs", "node_modules"}
+
+#: Default baseline location, loaded when --baseline is not given.
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def register(check_id: str, check_name: str):
+    """Decorator: install ``fn(FileContext) -> List[Finding]`` in REGISTRY."""
+    def wrap(fn):
+        REGISTRY[check_name] = (check_id, fn)
+        fn.check_id, fn.check_name = check_id, check_name
+        return fn
+    return wrap
+
+
+def _load_checks() -> None:
+    # Import for side effect: each module @register's its pass.
+    from tools.analyze.checks import (  # noqa: F401
+        broad_except, constant_drift, lock_discipline,
+        py_compat, reconcile_purity, tracer_safety,
+    )
+
+
+def iter_py_files(paths: Iterable[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def make_context(abs_path: str, root: str) -> FileContext:
+    with open(abs_path, "r", encoding="utf-8", errors="replace") as fh:
+        source = fh.read()
+    rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+    ctx = FileContext(path=rel, abs_path=abs_path, source=source,
+                      lines=source.splitlines())
+    try:
+        ctx.tree = ast.parse(source, filename=rel)
+    except SyntaxError:
+        ctx.tree = None  # py_compat reports it; other passes skip the file
+    return ctx
+
+
+def run_checks(paths: Iterable[str], root: Optional[str] = None,
+               only: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every registered pass (or the ``only`` subset, by name or id)
+    over the .py files under ``paths``.  Waived findings are dropped here so
+    every pass gets the same waiver semantics for free."""
+    _load_checks()
+    root = root or os.getcwd()
+    selected = REGISTRY
+    if only:
+        wanted = set(only)
+        selected = {name: pair for name, pair in REGISTRY.items()
+                    if name in wanted or pair[0] in wanted}
+        unknown = wanted - set(selected) - {pair[0] for pair in selected.values()}
+        if unknown:
+            raise ValueError(f"unknown check(s): {sorted(unknown)}; "
+                             f"known: {sorted(REGISTRY)}")
+    findings: List[Finding] = []
+    for abs_path in iter_py_files(paths, root):
+        ctx = make_context(abs_path, root)
+        for name, (_cid, fn) in selected.items():
+            for f in fn(ctx):
+                if not ctx.waived(f.line, name):
+                    findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return data.get("findings", {})
+
+
+def write_baseline(path: str, findings: List[Finding]) -> int:
+    entries = {
+        fp: {"check": f.check_id, "path": f.path, "message": f.message}
+        for fp, f in fingerprint_all(findings).items()
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, dict]) -> Tuple[List[Finding], int]:
+    """Split into (new findings, count of grandfathered ones suppressed)."""
+    fresh = [f for fp, f in fingerprint_all(findings).items()
+             if fp not in baseline]
+    fresh.sort(key=Finding.sort_key)
+    return fresh, len(findings) - len(fresh)
+
+
+# -- output ------------------------------------------------------------------
+
+def format_findings(findings: List[Finding], fmt: str) -> str:
+    if fmt == "json":
+        return json.dumps([{
+            "check_id": f.check_id, "check": f.check_name, "path": f.path,
+            "line": f.line, "col": f.col, "severity": f.severity,
+            "message": f.message,
+        } for f in findings], indent=2) + "\n"
+    if fmt == "github":
+        # GitHub Actions workflow-command annotations.
+        lines = []
+        for f in findings:
+            kind = "error" if f.severity == ERROR else "warning"
+            lines.append(f"::{kind} file={f.path},line={f.line},"
+                         f"col={f.col},title={f.check_id} {f.check_name}::"
+                         f"{f.message}")
+        return "\n".join(lines) + ("\n" if lines else "")
+    # text
+    lines = [f"{f.location()}: {f.check_id}[{f.check_name}] "
+             f"{f.severity}: {f.message}" for f in findings]
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -74,6 +74,15 @@ PROFILE_DIR_ENV = "TRAININGJOB_PROFILE_DIR"
 PROFILE_STEPS_ENV = "TRAININGJOB_PROFILE_STEPS"
 # "1" -> log per-step wall time (diagnosable throughput, not one scalar).
 STEP_TIMES_ENV = "TRAININGJOB_STEP_TIMES"
+# Which runtime launched the workload process ("localproc", "kube", "sim");
+# injected so a workload can adapt (e.g. skip node-local tmpfs on sim).
+RUNTIME_ENV = "TRAININGJOB_RUNTIME"
+# Per-replica-group JAX platform override (e.g. "cpu" so CPU groups on a TPU
+# host don't claim the chip); read by workloads/rendezvous.py.
+JAX_PLATFORM_ENV = "TRAININGJOB_JAX_PLATFORM"
+# "1"/"true" opts back in to the Shardy partitioner (default: classic GSPMD;
+# rationale in workloads/rendezvous.py configure_partitioner).
+SHARDY_ENV = "TRAININGJOB_SHARDY"
 # Virtual multislice geometry for platforms without a slice notion (CPU test
 # meshes): device.id // k becomes the slice id, letting the DCN-aware paths
 # run end-to-end on a forced-host-device mesh.

@@ -166,6 +166,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             name="trainingjob-gc")
         gc_thread.start()
         if wait:
+            # analyzer: allow[reconcile-purity]: parks the *caller's* thread
+            # until stop(); reconcile runs on the workqueue workers above.
             self._stop.wait()
 
     def stop(self) -> None:

@@ -78,6 +78,8 @@ class GarbageCollector:
             node = self._cs.nodes.get_node(pod.spec.node_name)
         except NotFoundError:
             return False
+        # analyzer: allow[broad-except]: transient apiserver error -> treat
+        # the node as healthy; GC must never delete pods on a flaky read.
         except Exception:
             return True
         return node.is_ready()

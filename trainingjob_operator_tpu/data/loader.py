@@ -67,6 +67,8 @@ class Prefetcher:
                 return
             try:
                 item = (step, self._fetch(step), None)
+            # analyzer: allow[broad-except]: forwarded through
+            # the queue and re-raised in __next__ on the consumer.
             except BaseException as exc:  # surfaced at next()
                 self._put((step, None, exc))
                 return

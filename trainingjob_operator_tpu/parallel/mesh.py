@@ -120,6 +120,8 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
         from jax.experimental import mesh_utils
 
         arr = mesh_utils.create_device_mesh(spec.shape, devices=devs)
+    # analyzer: allow[broad-except]: mesh_utils needs real topology info;
+    # on CPU test meshes any failure falls back to flat device order.
     except Exception:
         arr = np.array(devs).reshape(spec.shape)
     return Mesh(arr, spec.names)

@@ -29,7 +29,9 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Optional, Tuple
 
+from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.tracker import AlreadyExistsError
 from trainingjob_operator_tpu.core.objects import (
     Condition,
     ConditionStatus,
@@ -123,8 +125,8 @@ class LocalProcRuntime(PodStateRuntime):
         for name in self._node_names:
             try:
                 self._cs.nodes.create(make_ready_node(name))
-            except Exception:
-                pass
+            except AlreadyExistsError:
+                pass  # node survives from a previous runtime on this tracker
         super().start()
 
     def stop(self) -> None:
@@ -264,7 +266,7 @@ class LocalProcRuntime(PodStateRuntime):
         env = dict(os.environ)
         env["PYTHONPATH"] = (str(Path(__file__).resolve().parents[2])
                              + os.pathsep + env.get("PYTHONPATH", ""))
-        env["TRAININGJOB_RUNTIME"] = "localproc"
+        env[constants.RUNTIME_ENV] = "localproc"
         for e in container.env:
             env[e.name] = self._rewrite_value(e.value, pod.namespace)
 

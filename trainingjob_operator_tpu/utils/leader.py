@@ -239,7 +239,9 @@ class KubeLeaderElector:
             if (lease.get("spec") or {}).get("holderIdentity") == self.identity:
                 lease["spec"]["holderIdentity"] = ""
                 self._rest.request("PUT", self._path, body=lease)
-        except Exception:  # NotFound, conflict, connection loss: best effort
+        # analyzer: allow[broad-except]: NotFound/conflict/connection
+        # loss -- release is best effort; the lease expires anyway.
+        except Exception:
             pass
 
     def stop(self) -> None:

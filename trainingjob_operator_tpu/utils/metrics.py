@@ -22,10 +22,18 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote, LF."""
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
 def _key(name: str, labels: Dict[str, str]) -> str:
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return f"{name}{{{inner}}}"
 
 
@@ -112,6 +120,8 @@ class MetricsRegistry:
         for k, fn in gauges.items():
             try:
                 out[k] = fn()
+            # analyzer: allow[broad-except]: gauge callbacks are arbitrary
+            # component code; one bad gauge must not fail the whole scrape.
             except Exception:
                 out[k] = None
         for k, stats in hists.items():
@@ -130,6 +140,8 @@ class MetricsRegistry:
         for key, fn in gauges:
             try:
                 lines.append(f"{key} {fn()}")
+            # analyzer: allow[broad-except]: a failing gauge drops its own
+            # line only; the exposition endpoint itself must stay up.
             except Exception:
                 pass
         for key, h in hists:
@@ -144,8 +156,12 @@ class MetricsRegistry:
             cum = 0
             for ub, c in zip(h.buckets, h.counts[:-1]):
                 cum += c
-                lines.append(f'{base}_bucket{lbl(f"le=\"{ub}\"")} {cum}')
-            lines.append(f'{base}_bucket{lbl("le=\"+Inf\"")} {h.count}')
+                # Escaped label hoisted out of the f-string: a backslash
+                # inside an f-string expression is a SyntaxError before 3.12.
+                le_label = f'le="{ub}"'
+                lines.append(f"{base}_bucket{lbl(le_label)} {cum}")
+            inf_label = 'le="+Inf"'
+            lines.append(f"{base}_bucket{lbl(inf_label)} {h.count}")
             lines.append(f"{base}_sum{labels} {h.total}")
             lines.append(f"{base}_count{labels} {h.count}")
         return "\n".join(lines) + "\n"

@@ -148,7 +148,7 @@ def enable_compile_cache(rdv: Rendezvous) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
-def apply_platform_override(var: str = "TRAININGJOB_JAX_PLATFORM") -> None:
+def apply_platform_override(var: str = constants.JAX_PLATFORM_ENV) -> None:
     """Honor a platform request from env (e.g. "cpu" for CPU replica groups).
 
     A config update after import wins even where a site hook pins the
@@ -181,7 +181,7 @@ def configure_partitioner() -> None:
     passes its parity suite under it.  Flip the default once XLA's
     b/433785288 (per the warning text) ships.
     """
-    shardy = os.environ.get("TRAININGJOB_SHARDY", "")
+    shardy = os.environ.get(constants.SHARDY_ENV, "")
     if shardy not in ("1", "true"):
         import jax
 
