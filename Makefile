@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test dryrun bench install ci
+.PHONY: lint test dryrun bench install ci trace-demo
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): milliseconds, runs
 # before the tests so a grammar/race/contract bug fails fast with a
@@ -22,6 +22,11 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# One simulated job end to end; writes the reconcile trace as Chrome
+# trace_event JSON (docs/OBSERVABILITY.md) -- load it in Perfetto.
+trace-demo:
+	$(PY) -m tools.trace_demo --out /tmp/trace.json
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation

@@ -1,0 +1,650 @@
+"""obs/ subsystem: span tracer, structured logs, goodput accounting, the
+debug HTTP endpoints, and the end-to-end reconcile traces.
+
+Unit layer first (tracer semantics, exporters, the no-op fast path's
+zero-lock guarantee), then HTTP via the scrape pattern of
+test_examples_and_metrics.py, then e2e: a localproc job whose reconcile
+trace has a root ``sync_job`` span with children, and a sim job whose
+completed goodput ratio lands on /metrics.
+"""
+
+import contextvars
+import io
+import json
+import logging
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.goodput import GOODPUT, GoodputTracker
+from trainingjob_operator_tpu.obs.logs import (
+    ContextTextFormatter,
+    JsonFormatter,
+    configure_logging,
+    get_logger,
+)
+from trainingjob_operator_tpu.obs.trace import (
+    ERROR,
+    NOOP_SPAN,
+    TRACER,
+    Tracer,
+    current_context,
+    current_span,
+    group_traces,
+    spans_from_jsonl,
+    tracer_from_env,
+)
+from trainingjob_operator_tpu.utils.metrics import (
+    METRICS,
+    MetricsRegistry,
+    _Histogram,
+    serve_metrics,
+)
+
+from conftest import wait_for  # noqa: E402
+
+
+# -- tracer unit layer -------------------------------------------------------
+
+class TestSpanParenting:
+    def test_nested_spans_auto_parent_and_flush_one_trace(self):
+        t = Tracer()
+        with t.span("root", job="default/j1") as root:
+            assert current_span() is root
+            assert current_context() == f"{root.trace_id}:{root.span_id}"
+            with t.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                with t.span("grandchild") as gc:
+                    assert gc.parent_id == child.span_id
+        assert current_span() is None
+        assert current_context() == ""
+        traces = t.traces()
+        assert len(traces) == 1
+        tr = traces[0]
+        assert tr["root"] == "root"
+        assert tr["trace_id"] == root.trace_id
+        assert [s["name"] for s in tr["spans"]] == [
+            "grandchild", "child", "root"]
+        root_rec = tr["spans"][-1]
+        assert root_rec["parent_id"] is None
+        assert root_rec["attributes"]["job"] == "default/j1"
+
+    def test_sibling_roots_make_separate_traces(self):
+        t = Tracer()
+        with t.span("a"):
+            pass
+        with t.span("b"):
+            pass
+        assert [tr["root"] for tr in t.traces()] == ["b", "a"]  # newest first
+
+    def test_exception_marks_error_and_propagates(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("nope")
+        span = t.traces()[0]["spans"][0]
+        assert span["status"] == ERROR
+        assert span["attributes"]["exception"] == "ValueError: nope"
+
+    def test_set_attribute_and_status_chain(self):
+        t = Tracer()
+        with t.span("x") as sp:
+            sp.set_attribute("k", 1).set_status(ERROR)
+        span = t.traces()[0]["spans"][0]
+        assert span["attributes"]["k"] == 1 and span["status"] == ERROR
+
+
+class TestCrossThread:
+    def test_fresh_thread_does_not_inherit_context(self):
+        t = Tracer()
+        seen = {}
+
+        def worker():
+            seen["current"] = current_span()
+            with t.span("detached"):
+                pass
+
+        with t.span("root") as root:
+            th = threading.Thread(target=worker, daemon=True)
+            th.start()
+            th.join(5)
+        traces = {tr["root"]: tr for tr in t.traces()}
+        assert seen["current"] is None
+        assert traces["detached"]["trace_id"] != traces["root"]["trace_id"]
+
+    def test_explicit_parent_joins_the_trace_across_threads(self):
+        t = Tracer()
+
+        def worker(parent):
+            with t.span("cross", parent=parent):
+                pass
+
+        with t.span("root") as root:
+            th = threading.Thread(target=worker, args=(root,), daemon=True)
+            th.start()
+            th.join(5)
+        tr = t.traces()[0]
+        names = {s["name"]: s for s in tr["spans"]}
+        assert set(names) == {"root", "cross"}
+        assert names["cross"]["parent_id"] == names["root"]["span_id"]
+
+    def test_copied_context_carries_the_current_span(self):
+        t = Tracer()
+        seen = {}
+
+        def worker():
+            seen["current"] = current_span()
+
+        with t.span("root") as root:
+            ctx = contextvars.copy_context()
+            th = threading.Thread(target=lambda: ctx.run(worker), daemon=True)
+            th.start()
+            th.join(5)
+        assert seen["current"] is root
+
+
+class TestRingAndCaps:
+    def test_finished_ring_evicts_oldest(self):
+        t = Tracer(max_traces=3)
+        for i in range(5):
+            with t.span(f"r{i}"):
+                pass
+        assert [tr["root"] for tr in t.traces()] == ["r4", "r3", "r2"]
+        assert t.traces(limit=1)[0]["root"] == "r4"
+        t.clear()
+        assert t.traces() == []
+
+    def test_span_cap_drops_descendants_but_keeps_root(self):
+        t = Tracer()
+        t.MAX_SPANS_PER_TRACE = 3
+        with t.span("root"):
+            for i in range(5):
+                with t.span(f"c{i}"):
+                    pass
+        tr = t.traces()[0]
+        names = [s["name"] for s in tr["spans"]]
+        assert names == ["c0", "c1", "c2", "root"]
+        assert tr["dropped_spans"] == 2
+
+    def test_env_style_parent_adopts_trace_id_as_local_root(self):
+        t = Tracer()
+        with t.span("remote", parent="aaaa:bbbb"):
+            pass
+        tr = t.traces()[0]
+        assert tr["trace_id"] == "aaaa"
+        assert tr["spans"][0]["parent_id"] == "bbbb"
+
+
+class TestExporters:
+    def _sample(self):
+        t = Tracer()
+        with t.span("root", job="default/j1"):
+            with t.span("child"):
+                pass
+        with t.span("other"):
+            pass
+        return t
+
+    def test_jsonl_round_trip(self):
+        t = self._sample()
+        spans = spans_from_jsonl(t.export_jsonl())
+        grouped = group_traces(spans)
+        original = {tr["trace_id"]: tr["spans"] for tr in t.traces()}
+        assert set(grouped) == set(original)
+        for tid, sp in grouped.items():
+            assert [s["name"] for s in sp] == [s["name"] for s in original[tid]]
+
+    def test_chrome_export_event_shape(self):
+        t = self._sample()
+        doc = json.loads(t.export_chrome())
+        events = doc["traceEvents"]
+        assert len(events) == 3
+        for ev in events:
+            # The Chrome trace_event contract Perfetto needs.
+            assert {"ph", "ts", "pid", "tid", "name"} <= set(ev)
+            assert ev["ph"] == "X"
+            assert ev["dur"] >= 0
+            assert "trace_id" in ev["args"]
+        assert {ev["name"] for ev in events} == {"root", "child", "other"}
+
+    def test_empty_exports(self):
+        t = Tracer()
+        assert t.export_jsonl() == ""
+        assert json.loads(t.export_chrome())["traceEvents"] == []
+
+
+class _CountingLock:
+    """Lock wrapper counting acquisitions -- proves the no-op fast path."""
+
+    def __init__(self):
+        self.acquisitions = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        self.acquisitions += 1
+        return self._lock.__enter__()
+
+    def __exit__(self, *exc):
+        return self._lock.__exit__(*exc)
+
+
+class TestDisabledTracer:
+    def test_disabled_span_is_the_shared_noop_singleton(self):
+        t = Tracer(enabled=False)
+        sp = t.span("x", a=1)
+        assert sp is NOOP_SPAN
+        assert sp.set_attribute("k", 1).set_status("error") is NOOP_SPAN
+
+    def test_disabled_span_path_takes_zero_lock_acquisitions(self):
+        t = Tracer(enabled=False)
+        t._lock = _CountingLock()
+        for _ in range(100):
+            with t.span("reconcile", job="default/j1") as sp:
+                sp.set_attribute("pods", 3)
+        assert t._lock.acquisitions == 0
+        assert current_span() is None  # contextvar untouched too
+
+    def test_tracer_from_env(self):
+        t, parent = tracer_from_env({})
+        assert not t.enabled and parent == ""
+        t, parent = tracer_from_env(
+            {constants.TRACE_CONTEXT_ENV: "aaaa:bbbb"})
+        assert t.enabled and parent == "aaaa:bbbb"
+        assert t.service == "trainingjob-workload"
+        with t.span("train.run", parent=parent):
+            pass
+        tr = t.traces()[0]
+        assert tr["trace_id"] == "aaaa"
+        assert tr["spans"][0]["parent_id"] == "bbbb"
+
+
+# -- structured logging ------------------------------------------------------
+
+def _capture(formatter):
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(formatter)
+    logger = logging.getLogger("trainingjob.test_obs")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    logger.handlers = [handler]
+    return logger, buf
+
+
+class TestStructuredLogs:
+    def test_json_lines_carry_bound_fields_and_live_trace_id(self):
+        base, buf = _capture(JsonFormatter())
+        log = get_logger("trainingjob.test_obs", job="default/j1",
+                         rtype="trainer")
+        t = Tracer()
+        with t.span("sync_job") as sp:
+            log.info("reconciled %d pods", 3)
+        rec = json.loads(buf.getvalue())
+        assert rec["message"] == "reconciled 3 pods"
+        assert rec["job"] == "default/j1" and rec["rtype"] == "trainer"
+        assert rec["trace_id"] == sp.trace_id
+        assert rec["span_id"] == sp.span_id
+        assert rec["level"] == "INFO"
+
+    def test_no_span_means_no_trace_fields(self):
+        base, buf = _capture(JsonFormatter())
+        get_logger("trainingjob.test_obs", job="default/j2").info("hi")
+        rec = json.loads(buf.getvalue())
+        assert rec["job"] == "default/j2" and "trace_id" not in rec
+
+    def test_bind_merges_without_mutating_parent(self):
+        log = get_logger("trainingjob.test_obs", job="default/j1")
+        child = log.bind(rtype="worker")
+        assert child.extra == {"job": "default/j1", "rtype": "worker"}
+        assert log.extra == {"job": "default/j1"}
+
+    def test_text_formatter_appends_context_suffix(self):
+        base, buf = _capture(ContextTextFormatter("%(message)s"))
+        get_logger("trainingjob.test_obs", job="default/j1").info("hello")
+        assert buf.getvalue().strip() == "hello [job=default/j1]"
+        buf.truncate(0), buf.seek(0)
+        base.info("plain")
+        assert buf.getvalue().strip() == "plain"  # no fields, no suffix
+
+    def test_configure_logging_installs_removable_handler(self):
+        root = logging.getLogger()
+        handler = configure_logging(json_output=True, stream=io.StringIO())
+        try:
+            assert handler in root.handlers
+            assert isinstance(handler.formatter, JsonFormatter)
+        finally:
+            root.removeHandler(handler)
+
+
+# -- goodput accounting ------------------------------------------------------
+
+class TestGoodputTracker:
+    def test_ledger_and_final_ratio(self):
+        reg = MetricsRegistry()
+        g = GoodputTracker(metrics=reg)
+        k = "default/j1"
+        g.on_running(k, now=100.0, start_time=90.0)     # created at 90
+        g.on_interruption(k, "all", now=110.0)          # 10 s productive
+        g.on_running(k, now=115.0)                      # 5 s downtime
+        g.on_complete(k, now=120.0)                     # + 5 s productive
+        snap = reg.snapshot()
+        assert snap['trainingjob_goodput_ratio{job="default/j1"}'] == \
+            pytest.approx(15.0 / 30.0)
+        assert snap["trainingjob_time_to_first_step_seconds_count"] == 1
+        assert snap["trainingjob_time_to_first_step_seconds_sum"] == \
+            pytest.approx(10.0)
+        assert snap['trainingjob_restart_downtime_seconds{scope="all"}_count'] == 1
+        assert snap['trainingjob_restart_downtime_seconds{scope="all"}_sum'] == \
+            pytest.approx(5.0)
+
+    def test_complete_is_idempotent_and_forget_drops_gauge(self):
+        reg = MetricsRegistry()
+        g = GoodputTracker(metrics=reg)
+        g.on_running("k", now=10.0)
+        g.on_complete("k", now=20.0)
+        g.on_complete("k", now=99.0)  # revisited terminal branch: no-op
+        assert reg.snapshot()['trainingjob_goodput_ratio{job="k"}'] == 1.0
+        g.on_running("k", now=30.0)   # post-completion transitions ignored
+        assert g.ratio("k") == 1.0
+        g.forget("k")
+        assert 'trainingjob_goodput_ratio{job="k"}' not in reg.snapshot()
+        assert g.ratio("k") is None
+
+    def test_repeated_running_syncs_do_not_double_count(self):
+        reg = MetricsRegistry()
+        g = GoodputTracker(metrics=reg)
+        g.on_running("k", now=10.0)
+        g.on_running("k", now=12.0)   # resync while already Running
+        g.on_complete("k", now=20.0)
+        assert reg.snapshot()['trainingjob_goodput_ratio{job="k"}'] == 1.0
+        assert reg.snapshot()["trainingjob_time_to_first_step_seconds_count"] == 1
+
+    def test_live_ratio_between_transitions(self):
+        g = GoodputTracker(metrics=MetricsRegistry())
+        g.on_running("k")
+        ratio = g.ratio("k")
+        assert ratio is not None and 0.0 <= ratio <= 1.0
+
+
+class TestHistogramQuantile:
+    def test_empty_histogram_returns_zero(self):
+        h = _Histogram((1.0, 5.0))
+        assert h.quantile(0.5) == 0.0
+
+    def test_nonpositive_q_returns_zero_not_first_bucket(self):
+        h = _Histogram((1.0, 5.0))
+        h.observe(4.0)
+        assert h.quantile(0.0) == 0.0
+        assert h.quantile(-1.0) == 0.0
+        # The pre-fix bias: q=0 used to answer 1.0 (first bucket's bound)
+        # even though all mass sits in the second bucket.
+        assert h.quantile(0.5) == 5.0
+
+    def test_q_above_one_clamps(self):
+        h = _Histogram((1.0, 5.0))
+        h.observe(0.5)
+        assert h.quantile(7.0) == h.quantile(1.0) == 1.0
+
+    def test_overflow_bucket_answers_vmax(self):
+        h = _Histogram((1.0,))
+        h.observe(30.0)
+        assert h.quantile(0.99) == 30.0
+
+
+# -- debug HTTP endpoints ----------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestDebugEndpoints:
+    def test_traces_events_and_readyz(self):
+        from trainingjob_operator_tpu.core.objects import Event
+
+        reg = MetricsRegistry()
+        tracer = Tracer()
+        with tracer.span("sync_job", job="default/j1"):
+            with tracer.span("reconcile_pods"):
+                pass
+        events = [
+            Event(involved_namespace="default", involved_name="j1",
+                  reason="TrainingJobRunning", message="m1", timestamp=2.0),
+            Event(involved_namespace="default", involved_name="other",
+                  reason="TrainingJobPending", message="m2", timestamp=1.0),
+        ]
+        ready = {"ok": False}
+        server = serve_metrics(0, reg, tracer=tracer,
+                               events_fn=lambda: events,
+                               ready_fn=lambda: ready["ok"])
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(port, "/readyz")
+            assert exc.value.code == 503
+            ready["ok"] = True
+            assert _get(port, "/readyz") == (200, "ok\n")
+
+            status, body = _get(port, "/debug/traces")
+            doc = json.loads(body)
+            assert status == 200 and doc["count"] == 1
+            assert doc["traces"][0]["root"] == "sync_job"
+
+            _, body = _get(port, "/debug/traces?format=chrome")
+            chrome = json.loads(body)
+            assert {ev["name"] for ev in chrome["traceEvents"]} == {
+                "sync_job", "reconcile_pods"}
+            for ev in chrome["traceEvents"]:
+                assert {"ph", "ts", "pid", "tid", "name"} <= set(ev)
+
+            _, body = _get(port, "/debug/events?job=default/j1")
+            doc = json.loads(body)
+            assert doc["count"] == 1
+            assert doc["events"][0]["reason"] == "TrainingJobRunning"
+            _, body = _get(port, "/debug/events")
+            doc = json.loads(body)
+            # Unfiltered: all events, oldest first.
+            assert [e["message"] for e in doc["events"]] == ["m2", "m1"]
+        finally:
+            server.shutdown()
+
+    def test_debug_endpoints_404_without_providers(self):
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            for path in ("/debug/traces", "/debug/events"):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    _get(port, path)
+                assert exc.value.code == 404
+            # No ready_fn: always ready.
+            assert _get(port, "/readyz") == (200, "ok\n")
+        finally:
+            server.shutdown()
+
+
+# -- e2e: reconcile traces (localproc) and goodput (sim) ---------------------
+
+from trainingjob_operator_tpu.api.types import (  # noqa: E402
+    ReplicaSpec,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset  # noqa: E402
+from trainingjob_operator_tpu.cmd.options import OperatorOptions  # noqa: E402
+from trainingjob_operator_tpu.controller.controller import (  # noqa: E402
+    TrainingJobController,
+)
+from trainingjob_operator_tpu.core.objects import (  # noqa: E402
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+
+
+def _phase(cs, name):
+    return cs.trainingjobs.get("default", name).status.phase
+
+
+class TestReconcileTraceE2E:
+    @pytest.fixture
+    def cluster(self, tmp_path):
+        from trainingjob_operator_tpu.runtime.localproc import LocalProcRuntime
+
+        cs = Clientset()
+        tc = TrainingJobController(
+            cs, options=OperatorOptions(resync_period=0.05))
+        rt = LocalProcRuntime(cs, nodes=2, log_dir=str(tmp_path),
+                              termination_grace=0.5)
+        rt.start()
+        tc.run(workers=2)
+        yield cs, tc, rt
+        tc.stop()
+        rt.stop()
+
+    def test_reconcile_trace_root_has_children_and_env_propagates(
+            self, cluster, tmp_path):
+        cs, tc, rt = cluster
+        TRACER.clear()
+        out = tmp_path / "ctx.txt"
+        code = (
+            "import os\n"
+            f"open({str(out)!r}, 'w').write("
+            f"os.environ.get({constants.TRACE_CONTEXT_ENV!r}, ''))\n")
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name="traced", namespace="default"))
+        job.spec.replica_specs["worker"] = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="aitj-w",
+                          command=[sys.executable, "-u", "-c", code],
+                          ports=[ContainerPort(name="aitj-7741",
+                                               container_port=7741)])])))
+        cs.trainingjobs.create(job)
+        assert wait_for(
+            lambda: _phase(cs, "traced") == TrainingJobPhase.SUCCEEDED), \
+            _phase(cs, "traced")
+
+        # The acceptance shape: some reconcile of this job produced a root
+        # sync_job span with >= 3 children.
+        best = None
+        for tr in TRACER.traces():
+            roots = [s for s in tr["spans"]
+                     if s["parent_id"] is None and s["name"] == "sync_job"]
+            if not roots:
+                continue
+            root = roots[0]
+            if root["attributes"].get("job") != "default/traced":
+                continue
+            children = [s for s in tr["spans"]
+                        if s["parent_id"] == root["span_id"]]
+            if best is None or len(children) > len(best[1]):
+                best = (tr, children)
+        assert best is not None, "no sync_job trace recorded"
+        tr, children = best
+        names = {s["name"] for s in children}
+        assert len(children) >= 3, names
+        assert {"check_expectations", "reconcile_pods",
+                "update_status"} <= names, names
+        # The pod-create reconcile nests create_pod under reconcile_pods and
+        # localproc.launch adopts the env context: same trace end to end.
+        all_names = {s["name"] for tr2 in TRACER.traces()
+                     for s in tr2["spans"]}
+        assert "create_pod" in all_names
+        assert "localproc.launch" in all_names
+
+        # Cross-process propagation: the subprocess saw "trace_id:span_id".
+        ctx = out.read_text()
+        assert ctx and ":" in ctx
+        tid, _, sid = ctx.partition(":")
+        assert len(tid) == 16 and len(sid) == 16
+        known_traces = {tr2["trace_id"] for tr2 in TRACER.traces()}
+        assert tid in known_traces
+
+    def test_chrome_export_of_live_reconcile_ring_validates(self, cluster):
+        cs, tc, rt = cluster
+        TRACER.clear()
+        code = "import time; time.sleep(0.1)"
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name="chrome", namespace="default"))
+        job.spec.replica_specs["worker"] = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="aitj-w",
+                          command=[sys.executable, "-u", "-c", code],
+                          ports=[ContainerPort(name="aitj-7742",
+                                               container_port=7742)])])))
+        cs.trainingjobs.create(job)
+        assert wait_for(
+            lambda: _phase(cs, "chrome") == TrainingJobPhase.SUCCEEDED)
+        doc = json.loads(TRACER.export_chrome())
+        assert doc["traceEvents"], "reconcile produced no events"
+        for ev in doc["traceEvents"]:
+            assert {"ph", "ts", "pid", "tid", "name"} <= set(ev)
+            assert ev["ph"] == "X"
+
+
+class TestGoodputE2E:
+    @pytest.fixture
+    def cluster(self):
+        from trainingjob_operator_tpu.runtime.sim import SimRuntime
+
+        cs = Clientset()
+        tc = TrainingJobController(
+            cs, options=OperatorOptions(resync_period=0.05))
+        sim = SimRuntime(cs)
+        sim.start()
+        tc.run(workers=2)
+        yield cs, tc, sim
+        tc.stop()
+        sim.stop()
+
+    def test_completed_sim_job_publishes_goodput_ratio(self, cluster):
+        from trainingjob_operator_tpu.runtime.sim import (
+            RUN_SECONDS_ANNOTATION,
+        )
+
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        key = "default/goodjob"
+        GOODPUT.forget(key)  # other suites may have used the key
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name="goodjob", namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(
+                    annotations={RUN_SECONDS_ANNOTATION: "0.5"}),
+                spec=PodSpec(containers=[
+                    Container(name="aitj-main",
+                              ports=[ContainerPort(name="aitj-7743",
+                                                   container_port=7743)])])))
+        cs.trainingjobs.create(job)
+        try:
+            assert wait_for(
+                lambda: _phase(cs, "goodjob") == TrainingJobPhase.RUNNING, 10)
+            assert wait_for(
+                lambda: _phase(cs, "goodjob") == TrainingJobPhase.SUCCEEDED,
+                10)
+            assert wait_for(
+                lambda: GOODPUT.ratio(key) is not None, 5)
+            # The acceptance bound: ratio in (0, 1] for a job that ran.
+            ratio = GOODPUT.ratio(key)
+            assert 0.0 < ratio <= 1.0, ratio
+            # And it is scrapeable from the Prometheus text endpoint.
+            line = next(
+                (ln for ln in METRICS.render_prometheus().splitlines()
+                 if ln.startswith(
+                     'trainingjob_goodput_ratio{job="default/goodjob"}')),
+                None)
+            assert line is not None
+            assert 0.0 < float(line.split()[-1]) <= 1.0
+        finally:
+            GOODPUT.forget(key)

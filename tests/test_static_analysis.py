@@ -444,6 +444,138 @@ class TestTracerSafety:
                        only=["tracer-safety"]) == []
 
 
+# -- TJA007 event-reason-drift -----------------------------------------------
+
+FAKE_REASON_CONSTANTS = """
+    OK_REASON = "JobOk"
+    UNREGISTERED_REASON = "JobUnregistered"
+    EVENT_REASONS = frozenset((
+        OK_REASON,
+    ))
+"""
+
+
+class TestEventReasonDrift:
+    def _write_constants(self, tmp_path):
+        p = tmp_path / PKG / "api" / "constants.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(FAKE_REASON_CONSTANTS))
+
+    def test_fires_on_adhoc_and_unregistered_reasons(self, tmp_path):
+        self._write_constants(tmp_path)
+        src = """
+        from trainingjob_operator_tpu.api import constants
+
+        def f(recorder, job):
+            recorder.event(job, "Normal", "JobOkk", "typo'd literal")
+            recorder.event(job, "Normal", constants.UNREGISTERED_REASON, "m")
+        """
+        findings = analyze(tmp_path, f"{PKG}/controller/x.py", src,
+                           only=["event-reason-drift"])
+        assert ids(findings) == ["TJA007"]
+        msgs = " | ".join(f.message for f in findings)
+        assert "JobOkk" in msgs
+        assert "UNREGISTERED_REASON" in msgs
+
+    def test_quiet_on_registered_dynamic_and_non_recorder(self, tmp_path):
+        self._write_constants(tmp_path)
+        src = """
+        from trainingjob_operator_tpu.api import constants
+
+        def f(recorder, bus, job, reason):
+            recorder.event(job, "Normal", constants.OK_REASON, "m")
+            recorder.event(job, "Normal", "JobOk", "registry value literal")
+            recorder.event(job, "Normal", reason, "dynamic: skipped")
+            bus.event(job, "Normal", "NotARecorder", "receiver out of scope")
+        """
+        assert analyze(tmp_path, f"{PKG}/controller/x.py", src,
+                       only=["event-reason-drift"]) == []
+
+    def test_real_tree_call_sites_are_clean(self, tmp_path):
+        for rel in ("controller/control.py", "controller/pod.py",
+                    "controller/controller.py"):
+            src = open(os.path.join(REPO_ROOT, PKG, *rel.split("/"))).read()
+            assert analyze(tmp_path, f"{PKG}/{rel}", src,
+                           only=["event-reason-drift"]) == [], rel
+
+
+# -- TJA008 orphaned-thread --------------------------------------------------
+
+class TestOrphanedThread:
+    def test_fires_on_leaked_and_unbound_threads(self, tmp_path):
+        src = """
+        import threading
+
+        def leak(work):
+            t = threading.Thread(target=work)
+            t.start()
+
+        def unbound(work):
+            threading.Thread(target=work).start()
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["orphaned-thread"])
+        assert ids(findings) == ["TJA008"]
+        assert len(findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "'t'" in msgs
+        assert "never bound" in msgs
+
+    def test_quiet_on_daemon_join_sweep_and_late_daemon(self, tmp_path):
+        src = """
+        import threading
+
+        def daemonized(work):
+            threading.Thread(target=work, daemon=True).start()
+
+        def joined(work):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join(1)
+
+        def swept(work):
+            threads = [threading.Thread(target=work) for _ in range(3)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+
+        def appended(work):
+            threads = []
+            for _ in range(2):
+                threads.append(threading.Thread(target=work))
+            [t.join() for t in threads]
+
+        class C:
+            def start(self, work):
+                self._th = threading.Thread(target=work)
+                self._th.daemon = True
+                self._th.start()
+        """
+        assert analyze(tmp_path, "m.py", src, only=["orphaned-thread"]) == []
+
+    def test_explicit_daemon_false_still_needs_join(self, tmp_path):
+        src = """
+        import threading
+
+        def f(work):
+            t = threading.Thread(target=work, daemon=False)
+            t.start()
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["orphaned-thread"])
+        assert ids(findings) == ["TJA008"]
+
+    def test_waiver_suppresses(self, tmp_path):
+        src = """
+        import threading
+
+        def f(work):
+            # analyzer: allow[orphaned-thread]: joined by the caller
+            t = threading.Thread(target=work)
+            return t
+        """
+        assert analyze(tmp_path, "m.py", src, only=["orphaned-thread"]) == []
+
+
 # -- runner: baseline, waivers, formats, CLI ---------------------------------
 
 class TestRunnerMachinery:
@@ -498,10 +630,11 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_six_checks_registered(self):
+    def test_all_eight_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
-            "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006"}
+            "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
+            "TJA007", "TJA008"}
 
 
 # -- the tier-1 gate ---------------------------------------------------------

@@ -1,0 +1,148 @@
+"""TJA007 event-reason-drift: every ``recorder.event()`` reason comes from
+the ``EVENT_REASONS`` registry in ``api/constants.py``.
+
+Event reasons are an operational API: dashboards group on them and
+``kubectl get events --field-selector reason=...`` filters on them, so an
+ad-hoc reason string at one call site is invisible to every consumer keyed
+on the registry.  Two failure shapes are flagged:
+
+1. a string literal reason that is not a registry value (either a typo'd
+   copy of a registered reason or a brand-new reason that must be declared
+   in ``EVENT_REASONS`` first); and
+2. a ``constants.X_REASON``-style attribute whose name is a declared
+   constant but is *not* listed in the ``EVENT_REASONS`` frozenset (declared
+   but unregistered -- the registry is meant to be the closed set).
+
+Only calls whose receiver looks like an event recorder participate
+(``recorder`` / ``_recorder`` / ``self.recorder`` / ``rec``): ``.event()``
+is too generic a method name to match unconditionally.  Dynamic reasons
+(names, f-strings, function calls) are skipped -- this is a drift check,
+not a taint analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+REGISTRY_NAME = "EVENT_REASONS"
+
+#: Receiver leaf names accepted as "an event recorder".
+_RECORDER_NAMES = ("recorder", "rec")
+
+_cache: Dict[str, Tuple[float, Set[str], Set[str]]] = {}
+
+
+def _load_registry(repo_root: str) -> Tuple[Set[str], Set[str]]:
+    """(registered constant names, registered string values) from the
+    ``EVENT_REASONS`` frozenset in api/constants.py (mtime-cached)."""
+    path = os.path.join(repo_root, CONSTANTS_REL)
+    if not os.path.exists(path):
+        return set(), set()
+    mtime = os.path.getmtime(path)
+    cached = _cache.get(path)
+    if cached and cached[0] == mtime:
+        return cached[1], cached[2]
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    by_name: Dict[str, str] = {}
+    member_names: Set[str] = set()
+    member_values: Set[str] = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            by_name[target] = node.value.value
+        elif (target == REGISTRY_NAME and isinstance(node.value, ast.Call)
+              and isinstance(node.value.func, ast.Name)
+              and node.value.func.id == "frozenset" and node.value.args):
+            seq = node.value.args[0]
+            if isinstance(seq, (ast.Tuple, ast.List, ast.Set)):
+                for el in seq.elts:
+                    if isinstance(el, ast.Name) and el.id in by_name:
+                        member_names.add(el.id)
+                        member_values.add(by_name[el.id])
+                    elif (isinstance(el, ast.Constant)
+                          and isinstance(el.value, str)):
+                        member_values.add(el.value)
+    _cache[path] = (mtime, member_names, member_values)
+    return member_names, member_values
+
+
+def _repo_root(ctx: FileContext) -> Optional[str]:
+    suffix = ctx.path.replace("/", os.sep)
+    if ctx.abs_path.endswith(suffix):
+        return ctx.abs_path[:-len(suffix)].rstrip(os.sep) or os.sep
+    return None
+
+
+def _leaf_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_recorder_call(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == "event"):
+        return False
+    leaf = _leaf_name(call.func.value).lower().lstrip("_")
+    return any(leaf == n or leaf.endswith(n) for n in _RECORDER_NAMES)
+
+
+def _reason_arg(call: ast.Call) -> Optional[ast.expr]:
+    # EventRecorder.event(obj, etype, reason, message): positional index 2.
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+@register("TJA007", "event-reason-drift")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None or ".event(" not in ctx.source:
+        return []
+    root = _repo_root(ctx)
+    if root is None:
+        return []
+    member_names, member_values = _load_registry(root)
+    if not member_values:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_recorder_call(node)):
+            continue
+        reason = _reason_arg(node)
+        if reason is None:
+            continue
+        if isinstance(reason, ast.Constant) and isinstance(reason.value, str):
+            if reason.value not in member_values:
+                findings.append(Finding(
+                    "TJA007", "event-reason-drift", ctx.path, reason.lineno,
+                    reason.col_offset, ERROR,
+                    f"event reason {reason.value!r} is not in the "
+                    "EVENT_REASONS registry (api/constants.py); declare it "
+                    "there and pass the constant (ad-hoc reasons are "
+                    "invisible to reason-keyed dashboards and filters)"))
+        elif isinstance(reason, ast.Attribute):
+            name = reason.attr
+            if (name.isupper() and name not in member_names
+                    and name.endswith("_REASON")):
+                findings.append(Finding(
+                    "TJA007", "event-reason-drift", ctx.path, reason.lineno,
+                    reason.col_offset, ERROR,
+                    f"event reason constant {name} is not listed in "
+                    "EVENT_REASONS (api/constants.py); add it to the "
+                    "registry frozenset so the closed set stays closed"))
+    return findings

@@ -1,0 +1,143 @@
+"""TJA008 orphaned-thread: every ``threading.Thread`` is either a daemon or
+provably joined.
+
+A non-daemon thread with no ``join()`` outlives its owner silently: process
+shutdown blocks in the interpreter's thread-join teardown (the operator
+hangs on SIGTERM until the kubelet SIGKILLs it), and under pytest a leaked
+thread keeps running into later tests.  Compliance evidence, per
+construction site:
+
+1. a ``daemon=True`` keyword on the constructor;
+2. ``<name>.join(`` somewhere in the same file, where ``<name>`` is the
+   variable or attribute the thread was assigned to (``self._th`` matches
+   ``_th.join``); or
+3. threads collected in a container that is join-swept -- ``for t in
+   threads: t.join()`` / ``[t.join() for t in threads]`` credits
+   ``threads``.
+
+The analysis is file-local and name-based by design: a thread handed across
+modules for someone else to join is exactly the ownership ambiguity the
+pass exists to flag -- waive it with a reason if the cross-module join is
+intentional.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+
+def _leaf_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    """``threading.Thread(...)`` / ``th.Thread(...)`` / bare ``Thread(...)``;
+    leaf-name match so module aliases work without import resolution."""
+    return _leaf_name(call.func) == "Thread"
+
+
+def _daemon_kwarg_ok(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            # daemon=<expr> counts unless it is literally False.
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+def _collect_evidence(tree: ast.Module) -> Set[str]:
+    """Names credited with a join (directly, via a join-sweep over them, or
+    via an explicit ``<name>.daemon = True`` after construction)."""
+    # comprehension/for variable -> iterated container name
+    var_to_iter: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            tgt, it = node.target, node.iter
+            if isinstance(tgt, ast.Name) and isinstance(it, ast.Name):
+                var_to_iter[tgt.id] = it.id
+        elif isinstance(node, ast.comprehension):
+            tgt, it = node.target, node.iter
+            if isinstance(tgt, ast.Name) and isinstance(it, ast.Name):
+                var_to_iter[tgt.id] = it.id
+    credited: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            leaf = _leaf_name(node.func.value)
+            if leaf:
+                credited.add(leaf)
+                if leaf in var_to_iter:
+                    credited.add(var_to_iter[leaf])
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+              and isinstance(node.targets[0], ast.Attribute)
+              and node.targets[0].attr == "daemon"
+              and isinstance(node.value, ast.Constant)
+              and node.value.value is True):
+            leaf = _leaf_name(node.targets[0].value)
+            if leaf:
+                credited.add(leaf)
+    return credited
+
+
+def _bindings(tree: ast.Module) -> Dict[int, str]:
+    """id(Thread Call) -> leaf name it is bound to, covering direct
+    assignment, assignment of a comprehension building threads, and
+    ``container.append(Thread(...))``."""
+    bound: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            leaf = _leaf_name(node.targets[0])
+            if not leaf:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and _is_thread_ctor(value):
+                bound[id(value)] = leaf
+            elif isinstance(value, (ast.ListComp, ast.SetComp)):
+                if (isinstance(value.elt, ast.Call)
+                        and _is_thread_ctor(value.elt)):
+                    bound[id(value.elt)] = leaf
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "append" and len(node.args) == 1
+              and isinstance(node.args[0], ast.Call)
+              and _is_thread_ctor(node.args[0])):
+            leaf = _leaf_name(node.func.value)
+            if leaf:
+                bound[id(node.args[0])] = leaf
+    return bound
+
+
+@register("TJA008", "orphaned-thread")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None or "Thread(" not in ctx.source:
+        return []
+    credited = _collect_evidence(ctx.tree)
+    bound = _bindings(ctx.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        if _daemon_kwarg_ok(node):
+            continue
+        name: Optional[str] = bound.get(id(node))
+        if name is not None and name in credited:
+            continue
+        hint = (f"bound to {name!r} which is never joined" if name
+                else "never bound to a name, so it cannot be joined")
+        findings.append(Finding(
+            "TJA008", "orphaned-thread", ctx.path, node.lineno,
+            node.col_offset, ERROR,
+            f"threading.Thread without daemon=True and no join ({hint}); "
+            "a leaked non-daemon thread blocks interpreter shutdown -- "
+            "pass daemon=True, join it, or waive with the ownership "
+            "rationale"))
+    return findings

@@ -43,8 +43,8 @@ def register(check_id: str, check_name: str):
 def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
-        broad_except, constant_drift, lock_discipline,
-        py_compat, reconcile_purity, tracer_safety,
+        broad_except, constant_drift, event_reasons, lock_discipline,
+        orphaned_thread, py_compat, reconcile_purity, tracer_safety,
     )
 
 

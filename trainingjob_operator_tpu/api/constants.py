@@ -80,6 +80,14 @@ RUNTIME_ENV = "TRAININGJOB_RUNTIME"
 # Per-replica-group JAX platform override (e.g. "cpu" so CPU groups on a TPU
 # host don't claim the chip); read by workloads/rendezvous.py.
 JAX_PLATFORM_ENV = "TRAININGJOB_JAX_PLATFORM"
+# Trace context handed to workloads rendezvous-style ("trace_id:span_id"):
+# the workload's root span adopts it so one trace id spans controller,
+# runtime, and train loop (obs/trace.py).  Absent -> workload tracing is a
+# no-op fast path.
+TRACE_CONTEXT_ENV = "TRAININGJOB_TRACE_CONTEXT"
+# Directory the workload writes its finished trace into on shutdown
+# (Chrome trace_event JSON, one file per process); unset -> no export.
+TRACE_DIR_ENV = "TRAININGJOB_TRACE_DIR"
 # "1"/"true" opts back in to the Shardy partitioner (default: classic GSPMD;
 # rationale in workloads/rendezvous.py configure_partitioner).
 SHARDY_ENV = "TRAININGJOB_SHARDY"
@@ -100,6 +108,10 @@ PORT_PREFIX = "aitj-"
 DEFAULT_COORDINATOR_PORT = 8476
 
 # --- event reasons (reference: constants.go:23-39) --------------------------
+# Every reason ever passed to EventRecorder.event() is declared here and
+# listed in EVENT_REASONS below -- the registry tools/analyze TJA007 checks
+# call sites against (an ad-hoc reason string is invisible to dashboards and
+# `kubectl get events --field-selector reason=...` filters).
 POD_TEMPLATE_RESTART_POLICY_REASON = "SettedPodTemplateRestartPolicy"
 EXITED_WITH_CODE_REASON = "ExitedWithCode"
 
@@ -114,6 +126,35 @@ TERMINATING_REASON = "TrainingJobTerminating"
 PREEMPTED_REASON = "TrainingJobPreempted"
 NODE_FAIL_REASON = "TrainingJobNodeFail"
 SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
+
+# Action-trail reasons (previously inline literals at call sites).
+VALIDATION_FAILED_REASON = "ValidationFailed"
+SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
+SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDeletePod"
+SUCCESSFUL_CREATE_SERVICE_REASON = "SuccessfulCreateService"
+SUCCESSFUL_DELETE_SERVICE_REASON = "SuccessfulDeleteService"
+
+#: The registry: the closed set of reasons recorder.event() may emit.
+EVENT_REASONS = frozenset((
+    POD_TEMPLATE_RESTART_POLICY_REASON,
+    EXITED_WITH_CODE_REASON,
+    PENDING_REASON,
+    CREATING_REASON,
+    RUNNING_REASON,
+    SUCCEEDED_REASON,
+    FAILED_REASON,
+    TIMEOUT_REASON,
+    RESTARTING_REASON,
+    TERMINATING_REASON,
+    PREEMPTED_REASON,
+    NODE_FAIL_REASON,
+    SCALING_REASON,
+    VALIDATION_FAILED_REASON,
+    SUCCESSFUL_CREATE_POD_REASON,
+    SUCCESSFUL_DELETE_POD_REASON,
+    SUCCESSFUL_CREATE_SERVICE_REASON,
+    SUCCESSFUL_DELETE_SERVICE_REASON,
+))
 
 # --- fatal container-waiting reasons (reference: constants.go:46-56) --------
 ERROR_CONTAINER_STATUS = (

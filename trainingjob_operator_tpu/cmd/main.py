@@ -65,16 +65,30 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("--nodes", type=int, default=2,
                         help="Virtual node count for sim/localproc backends.")
     parser.add_argument("--metrics-port", type=int, default=0,
-                        help="Serve /metrics, /metrics.json, /healthz and "
-                             "/debug/threads on this port (0 = disabled).")
+                        help="Serve /metrics, /metrics.json, /healthz, "
+                             "/readyz, /debug/threads, /debug/traces and "
+                             "/debug/events on this port (0 = disabled).")
+    parser.add_argument("--log-json", action="store_true",
+                        help="Emit structured JSON log lines (one object per "
+                             "line) instead of text.")
+    parser.add_argument("--trace-out", default="",
+                        help="On shutdown, write the reconcile trace ring as "
+                             "Chrome trace_event JSON to this path "
+                             "(load in Perfetto / chrome://tracing).")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     opt = OperatorOptions.from_args(args)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose >= 2 else
-        logging.INFO if args.verbose == 1 else logging.WARNING,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    level = (logging.DEBUG if args.verbose >= 2 else
+             logging.INFO if args.verbose == 1 else logging.WARNING)
+    if args.log_json:
+        from trainingjob_operator_tpu.obs.logs import configure_logging
+
+        configure_logging(json_output=True, level=level)
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     stop = setup_signal_handler()
     clientset, runtime = build_backend(opt, args)
@@ -82,9 +96,13 @@ def main(argv: Optional[list] = None) -> int:
 
     metrics_server = None
     if args.metrics_port:
+        from trainingjob_operator_tpu.obs.trace import TRACER
         from trainingjob_operator_tpu.utils.metrics import serve_metrics
 
-        metrics_server = serve_metrics(args.metrics_port)
+        metrics_server = serve_metrics(
+            args.metrics_port, tracer=TRACER,
+            events_fn=lambda: clientset.events.list(None),
+            ready_fn=controller.ready)
         print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():
@@ -107,6 +125,12 @@ def main(argv: Optional[list] = None) -> int:
             runtime.stop()
             if metrics_server is not None:
                 metrics_server.shutdown()
+            if args.trace_out:
+                from trainingjob_operator_tpu.obs.trace import TRACER
+
+                with open(args.trace_out, "w") as f:
+                    f.write(TRACER.export_chrome())
+                print(f"reconcile trace written to {args.trace_out}")
 
     if opt.leader_election.leader_elect:
         if opt.backend == "kube":

@@ -12,6 +12,7 @@ from typing import Any, Optional
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.core.objects import OwnerReference, Pod, Service
+from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.utils.events import EventRecorder
 from trainingjob_operator_tpu.utils.metrics import METRICS
 
@@ -41,22 +42,24 @@ class PodControl:
         self._recorder = recorder
 
     def create_pod(self, namespace: str, pod: Pod, job: Any) -> Pod:
-        pod.metadata.namespace = namespace
-        pod.metadata.owner_references = [gen_owner_reference(job)]
-        created = self._cs.pods.create(pod)
+        with TRACER.span("create_pod", pod=pod.metadata.name):
+            pod.metadata.namespace = namespace
+            pod.metadata.owner_references = [gen_owner_reference(job)]
+            created = self._cs.pods.create(pod)
         METRICS.inc("trainingjob_pods_created_total")
-        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulCreatePod",
+        self._recorder.event(job, EventRecorder.NORMAL, constants.SUCCESSFUL_CREATE_POD_REASON,
                              f"Created pod: {created.name}")
         return created
 
     def delete_pod(self, namespace: str, name: str, job: Any,
                    grace_period: Optional[int] = None) -> None:
         try:
-            self._cs.pods.delete(namespace, name, grace_period=grace_period)
+            with TRACER.span("delete_pod", pod=name):
+                self._cs.pods.delete(namespace, name, grace_period=grace_period)
         except KeyError:
             return
         METRICS.inc("trainingjob_pods_deleted_total")
-        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulDeletePod",
+        self._recorder.event(job, EventRecorder.NORMAL, constants.SUCCESSFUL_DELETE_POD_REASON,
                              f"Deleted pod: {name}")
 
 
@@ -66,17 +69,19 @@ class ServiceControl:
         self._recorder = recorder
 
     def create_service(self, namespace: str, service: Service, job: Any) -> Service:
-        service.metadata.namespace = namespace
-        service.metadata.owner_references = [gen_owner_reference(job)]
-        created = self._cs.services.create(service)
-        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulCreateService",
+        with TRACER.span("create_service", service=service.metadata.name):
+            service.metadata.namespace = namespace
+            service.metadata.owner_references = [gen_owner_reference(job)]
+            created = self._cs.services.create(service)
+        self._recorder.event(job, EventRecorder.NORMAL, constants.SUCCESSFUL_CREATE_SERVICE_REASON,
                              f"Created service: {created.name}")
         return created
 
     def delete_service(self, namespace: str, name: str, job: Any) -> None:
         try:
-            self._cs.services.delete(namespace, name)
+            with TRACER.span("delete_service", service=name):
+                self._cs.services.delete(namespace, name)
         except KeyError:
             return
-        self._recorder.event(job, EventRecorder.NORMAL, "SuccessfulDeleteService",
+        self._recorder.event(job, EventRecorder.NORMAL, constants.SUCCESSFUL_DELETE_SERVICE_REASON,
                              f"Deleted service: {name}")

@@ -42,6 +42,8 @@ from trainingjob_operator_tpu.controller.pod import PodReconciler
 from trainingjob_operator_tpu.controller.service import ServiceReconciler
 from trainingjob_operator_tpu.controller.status import StatusManager, update_job_conditions
 from trainingjob_operator_tpu.core.objects import Node, OwnerReference, Pod, Service
+from trainingjob_operator_tpu.obs.goodput import GOODPUT
+from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.controller")
@@ -90,6 +92,11 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self._resync_thread: Optional[threading.Thread] = None
         self._gc: Optional[GarbageCollector] = None
         self._stop = threading.Event()
+        # Readiness gate for /readyz: set once run() has handlers registered
+        # and workers started (in-process informers deliver synchronously, so
+        # "started" is "synced"; a kube-backed informer factory would gate on
+        # its own has_synced here).
+        self._ready = threading.Event()
         # Observability: per-sync latency (SURVEY.md §5.1 asks for better than
         # the reference's V(4) log line).
         self.sync_count = 0
@@ -165,14 +172,20 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             target=self._gc.run, args=(self.options.gc_interval,), daemon=True,
             name="trainingjob-gc")
         gc_thread.start()
+        self._ready.set()
         if wait:
             # analyzer: allow[reconcile-purity]: parks the *caller's* thread
             # until stop(); reconcile runs on the workqueue workers above.
             self._stop.wait()
 
+    def ready(self) -> bool:
+        """Informer-synced gate backing the /readyz endpoint."""
+        return self._ready.is_set() and not self._stop.is_set()
+
     def stop(self) -> None:
         self.metrics.remove_gauge("trainingjob_workqueue_depth")
         self.metrics.remove_gauge("trainingjob_jobs")
+        self._ready.clear()
         self._stop.set()
         if self._gc is not None:
             self._gc.stop()
@@ -215,33 +228,44 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     def sync_handler(self, key: str) -> bool:
         start = time.time()
         try:
-            namespace, name = split_meta_namespace_key(key)
-            job = self.trainingjob_lister.try_get(namespace, name)
-            if job is None:
-                self.expectations.delete_expectations(key)
-                return True
+            # Root span of the reconcile trace; every child below (expectation
+            # check, pod diff, control calls, status write) auto-parents.
+            with TRACER.span("sync_job", job=key) as root:
+                namespace, name = split_meta_namespace_key(key)
+                job = self.trainingjob_lister.try_get(namespace, name)
+                if job is None:
+                    self.expectations.delete_expectations(key)
+                    GOODPUT.forget(key)
+                    root.set_attribute("outcome", "gone")
+                    return True
 
-            if not self.satisfied_expectations(job):
-                return True
+                with TRACER.span("check_expectations"):
+                    satisfied = self.satisfied_expectations(job)
+                if not satisfied:
+                    root.set_attribute("outcome", "expectations_pending")
+                    return True
 
-            set_defaults(job)
-            violations = validate_job(job)
-            if violations:
-                # Real validation (reference FIXME, trainingjob.go:21).
-                msg = "; ".join(violations)
-                self.recorder.event(job, EventRecorder.WARNING,
-                                    "ValidationFailed", msg)
-                if job.status.phase != TrainingJobPhase.FAILED:
-                    update_job_conditions(job, TrainingJobPhase.FAILED,
-                                          constants.FAILED_REASON,
-                                          f"invalid spec: {msg}")
-                    self.update_trainingjob_phase(job)
-                return True
+                with TRACER.span("validate"):
+                    set_defaults(job)
+                    violations = validate_job(job)
+                if violations:
+                    # Real validation (reference FIXME, trainingjob.go:21).
+                    msg = "; ".join(violations)
+                    self.recorder.event(job, EventRecorder.WARNING,
+                                        constants.VALIDATION_FAILED_REASON, msg)
+                    root.set_attribute("outcome", "invalid")
+                    if job.status.phase != TrainingJobPhase.FAILED:
+                        update_job_conditions(job, TrainingJobPhase.FAILED,
+                                              constants.FAILED_REASON,
+                                              f"invalid spec: {msg}")
+                        self.update_trainingjob_phase(job)
+                    return True
 
-            if (job.metadata.deletion_timestamp is None
-                    and job.status.phase in RECONCILABLE_PHASES):
-                self.reconcile_trainingjobs(job)
-            return True
+                if (job.metadata.deletion_timestamp is None
+                        and job.status.phase in RECONCILABLE_PHASES):
+                    self.reconcile_trainingjobs(job)
+                root.set_attribute("phase", job.status.phase)
+                return True
         finally:
             self.sync_count += 1
             dt = time.time() - start
@@ -272,12 +296,16 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         pods = self.get_pods_by_job(job, selector)
         services = self.get_services_by_job(job, selector)
 
+        job_key = meta_namespace_key(job)
         ending_phases: Dict[str, str] = {}
         aggregation_msg: List[str] = []
         if (not job.status.restart_replica_name
                 and not job.status.scaling_replica_name):
             for rtype in sorted(job.spec.replica_specs):
-                ending_phase, msg = self.reconcile_pods(job, pods, rtype)
+                with TRACER.span("reconcile_pods", rtype=rtype) as sp:
+                    ending_phase, msg = self.reconcile_pods(job, pods, rtype)
+                    if ending_phase:
+                        sp.set_attribute("ending_phase", ending_phase)
                 if msg and msg not in aggregation_msg:
                     aggregation_msg.append(msg)
                 if ending_phase == TrainingJobPhase.RESTARTING:
@@ -288,6 +316,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         job, TrainingJobPhase.TERMINATING,
                         constants.TERMINATING_REASON, msg)
                     job.status.restart_replica_name = rtype
+                    GOODPUT.on_interruption(
+                        job_key, job.spec.replica_specs[rtype].restart_scope)
                     break
                 if ending_phase == TrainingJobPhase.SCALING:
                     # Elastic resize: same two-phase drain, scaling marker.
@@ -295,15 +325,19 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         job, TrainingJobPhase.SCALING,
                         constants.SCALING_REASON, msg)
                     job.status.scaling_replica_name = rtype
+                    GOODPUT.on_interruption(job_key, "scale")
                     break
                 if ending_phase:
                     ending_phases[rtype] = ending_phase
                     continue
-                self.reconcile_services(job, services, rtype)
+                with TRACER.span("reconcile_services", rtype=rtype):
+                    self.reconcile_services(job, services, rtype)
 
         message = "; ".join(aggregation_msg)
-        self.update_status(job, pods, services, ending_phases, message)
+        with TRACER.span("update_status"):
+            self.update_status(job, pods, services, ending_phases, message)
         if (job.status.to_dict() != old_status.to_dict()
                 or job.metadata.annotations != old_annotations):
             job.status.last_reconcile_time = time.time()
-            self.update_trainingjob_phase(job)
+            with TRACER.span("write_status", phase=job.status.phase):
+                self.update_trainingjob_phase(job)

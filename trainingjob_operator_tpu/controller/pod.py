@@ -48,6 +48,7 @@ from trainingjob_operator_tpu.core.objects import (
     PodConditionType,
     PodPhase,
 )
+from trainingjob_operator_tpu.obs.trace import current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.pod")
@@ -830,6 +831,12 @@ class PodReconciler:
             EnvVar(constants.JOB_NAME_ENV, job.name),
             EnvVar(constants.JOB_NAMESPACE_ENV, job.namespace),
         ]
+        # Trace context, rendezvous-style: baked into the pod spec at create
+        # time (we are inside the reconcile's sync_job span here), so the
+        # workload's spans join the reconcile trace that created its pod.
+        trace_ctx = current_context()
+        if trace_ctx:
+            hosts_env.append(EnvVar(constants.TRACE_CONTEXT_ENV, trace_ctx))
         hosts_env += self._jax_bootstrap_env(job, rtype, index)
 
         # Template env wins: the operator injects only names the user did not

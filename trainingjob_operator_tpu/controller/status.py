@@ -26,7 +26,7 @@ from trainingjob_operator_tpu.api.types import (
     TPUTrainingJob,
     is_failed_phase,
 )
-from trainingjob_operator_tpu.client.tracker import ConflictError
+from trainingjob_operator_tpu.client.tracker import ConflictError, meta_namespace_key
 from trainingjob_operator_tpu.controller.naming import (
     effective_replicas,
     filter_for_replica_type,
@@ -40,6 +40,7 @@ from trainingjob_operator_tpu.core.objects import (
     PodPhase,
     Service,
 )
+from trainingjob_operator_tpu.obs.goodput import GOODPUT
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.status")
@@ -263,6 +264,7 @@ class StatusManager:
                     job.status.end_time = now
                     update_job_conditions(job, phase, PHASE_REASON[phase],
                                           f"{msg}; deleted pods")
+                    GOODPUT.on_complete(meta_namespace_key(job), now)
                 else:
                     self.enqueue_job(job, rate_limited=True)
                 return
@@ -296,6 +298,8 @@ class StatusManager:
                 job.status.start_running_time = now
             update_job_conditions(job, TrainingJobPhase.RUNNING,
                                   constants.RUNNING_REASON, "all pods are running")
+            GOODPUT.on_running(meta_namespace_key(job), now,
+                               start_time=job.status.start_time)
         if is_running and job.status.scale_up_attempts:
             # A group back at FULL width (maxReplicas when set) resets its own
             # re-expand backoff; groups still below it keep backing off.
@@ -341,6 +345,7 @@ class StatusManager:
                                   f"{message}; kept pods")
             if job.status.end_time is None:
                 job.status.end_time = time.time()
+            GOODPUT.on_complete(meta_namespace_key(job), job.status.end_time)
             return
         job.metadata.annotations[ending_phase] = message
         # The stash is METADATA: on a real apiserver the status-subresource

@@ -1,0 +1,50 @@
+"""Observability: span tracing, structured logging, goodput accounting.
+
+Dependency-free (stdlib only, like ``tools/analyze``).  Three process-global
+singletons mirror ``utils.metrics.METRICS``:
+
+- ``TRACER``  -- span tracer with a bounded ring of finished traces;
+- ``GOODPUT`` -- goodput ledger fed by the status machine;
+- structured logging is stateless (``get_logger`` binds context per call).
+
+See docs/OBSERVABILITY.md for the span/metric/event catalogs.
+"""
+
+from trainingjob_operator_tpu.obs.goodput import GOODPUT, GoodputTracker
+from trainingjob_operator_tpu.obs.logs import (
+    ContextTextFormatter,
+    JsonFormatter,
+    StructuredLogger,
+    configure_logging,
+    get_logger,
+)
+from trainingjob_operator_tpu.obs.trace import (
+    NOOP_SPAN,
+    Span,
+    TRACER,
+    Tracer,
+    current_context,
+    current_span,
+    group_traces,
+    spans_from_jsonl,
+    tracer_from_env,
+)
+
+__all__ = [
+    "GOODPUT",
+    "GoodputTracker",
+    "ContextTextFormatter",
+    "JsonFormatter",
+    "StructuredLogger",
+    "configure_logging",
+    "get_logger",
+    "NOOP_SPAN",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "current_context",
+    "current_span",
+    "group_traces",
+    "spans_from_jsonl",
+    "tracer_from_env",
+]

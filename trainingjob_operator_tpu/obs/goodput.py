@@ -1,0 +1,164 @@
+"""Goodput accounting: productive step time vs. restart/rendezvous overhead.
+
+The TPU-training literature (PAPERS.md: Goodput-style accounting) measures a
+job not by "did it finish" but by what fraction of its wall clock went into
+productive training versus scheduling, restarts, and re-rendezvous.  The
+controller is the one component that sees every transition, so goodput is
+derived here from the condition trail the status machine already maintains:
+
+- time in phase Running counts as productive;
+- an interruption (restart drain, elastic resize) opens a downtime window
+  attributed to its restart scope; the next transition back to Running
+  closes it into ``trainingjob_restart_downtime_seconds{scope=...}``;
+- the first Running transition observes
+  ``trainingjob_time_to_first_step_seconds`` (a controller-side proxy: pods
+  running, not the literal first optimizer step -- the workload-side step
+  spans refine it when tracing is enabled);
+- completion registers ``trainingjob_goodput_ratio{job=...}`` = productive
+  seconds / wall seconds, clamped to [0, 1].
+
+All methods are idempotent per state transition: the status machine may
+re-enter the same branch on consecutive syncs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+#: Downtime-histogram buckets: restarts span ~100 ms (sim) to minutes
+#: (full-slice reschedule + compile).
+DOWNTIME_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
+
+
+class _JobState:
+    __slots__ = ("first_seen", "running_since", "productive",
+                 "downtime_since", "downtime_scope", "first_running",
+                 "completed")
+
+    def __init__(self) -> None:
+        self.first_seen: Optional[float] = None
+        self.running_since: Optional[float] = None
+        self.productive = 0.0
+        self.downtime_since: Optional[float] = None
+        self.downtime_scope = ""
+        self.first_running = False
+        self.completed = False
+
+
+class GoodputTracker:
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        self._metrics = metrics or METRICS
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, _JobState] = {}
+
+    def _state_locked(self, key: str) -> _JobState:
+        st = self._jobs.get(key)
+        if st is None:
+            st = self._jobs[key] = _JobState()
+        return st
+
+    # -- transition hooks (called by the status machine / controller) --------
+
+    def on_running(self, key: str, now: Optional[float] = None,
+                   start_time: Optional[float] = None) -> None:
+        """The job transitioned (back) to Running: close any open downtime
+        window, observe time-to-first-step once, start accruing productive
+        time."""
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._state_locked(key)
+            if st.completed:
+                return
+            if st.first_seen is None:
+                st.first_seen = start_time if start_time is not None else now
+            if st.downtime_since is not None:
+                self._metrics.observe(
+                    "trainingjob_restart_downtime_seconds",
+                    max(now - st.downtime_since, 0.0),
+                    buckets=DOWNTIME_BUCKETS,
+                    scope=st.downtime_scope or "unknown")
+                st.downtime_since = None
+                st.downtime_scope = ""
+            if not st.first_running:
+                st.first_running = True
+                self._metrics.observe(
+                    "trainingjob_time_to_first_step_seconds",
+                    max(now - st.first_seen, 0.0),
+                    buckets=DOWNTIME_BUCKETS)
+            if st.running_since is None:
+                st.running_since = now
+
+    def on_interruption(self, key: str, scope: str,
+                        now: Optional[float] = None) -> None:
+        """A restart/resize drain started: stop accruing productive time and
+        open a downtime window attributed to ``scope`` (a RestartScope value
+        or ``"scale"``)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._state_locked(key)
+            if st.completed:
+                return
+            if st.first_seen is None:
+                st.first_seen = now
+            if st.running_since is not None:
+                st.productive += max(now - st.running_since, 0.0)
+                st.running_since = None
+            if st.downtime_since is None:
+                st.downtime_since = now
+                st.downtime_scope = scope
+
+    def on_complete(self, key: str, now: Optional[float] = None) -> None:
+        """The job reached a terminal phase: freeze the ledger and publish
+        ``trainingjob_goodput_ratio{job=...}``.  Idempotent -- the status
+        machine revisits terminal branches on later syncs."""
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._jobs.get(key)
+            if st is None or st.completed:
+                return
+            st.completed = True
+            if st.running_since is not None:
+                st.productive += max(now - st.running_since, 0.0)
+                st.running_since = None
+            if st.first_seen is None:
+                return  # never observed a lifecycle; nothing to report
+            wall = now - st.first_seen
+            if wall <= 0.0:
+                ratio = 1.0 if st.productive > 0.0 else 0.0
+            else:
+                ratio = min(max(st.productive / wall, 0.0), 1.0)
+            # A pull-gauge closed over the final value: survives until the
+            # job is forgotten, so a completed job's ratio stays scrapeable.
+            self._metrics.gauge("trainingjob_goodput_ratio",
+                                lambda r=ratio: r, job=key)
+
+    def forget(self, key: str) -> None:
+        """The job object is gone (deleted/GC'd): drop state and the gauge."""
+        with self._lock:
+            self._jobs.pop(key, None)
+            self._metrics.remove_gauge("trainingjob_goodput_ratio", job=key)
+
+    def ratio(self, key: str) -> Optional[float]:
+        """Live or final goodput ratio for tests/debugging."""
+        snap = self._metrics.snapshot()
+        val = snap.get(f'trainingjob_goodput_ratio{{job="{key}"}}')
+        if val is not None:
+            return val
+        now = time.time()
+        with self._lock:
+            st = self._jobs.get(key)
+            if st is None or st.first_seen is None:
+                return None
+            productive = st.productive
+            if st.running_since is not None:
+                productive += max(now - st.running_since, 0.0)
+            wall = now - st.first_seen
+            return min(max(productive / wall, 0.0), 1.0) if wall > 0 else None
+
+
+#: Process-global tracker, mirroring METRICS/TRACER.
+GOODPUT = GoodputTracker()

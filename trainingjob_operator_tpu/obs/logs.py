@@ -1,0 +1,106 @@
+"""Structured logging: stdlib records enriched with trace + job identity.
+
+The reference logs free text through klog; correlating "which reconcile
+produced this line" means grepping timestamps.  Production operators
+(controller-runtime's zap integration) bind a per-reconcile context to every
+record instead.  ``StructuredLogger`` is that adapter for stdlib logging:
+each record carries ``trace_id`` (read live from the current span at emit
+time), plus any statically-bound fields (``job="ns/name"``, ``rtype``).
+
+Formatting is opt-in: the default keeps the existing human text format with
+a ``[trace=... job=...]`` suffix; ``JsonFormatter`` renders one JSON object
+per line for log pipelines.  Neither changes what callers write.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict
+
+from trainingjob_operator_tpu.obs.trace import current_span
+
+#: Record attributes the formatters surface (beyond the stdlib ones).
+CONTEXT_FIELDS = ("trace_id", "span_id", "job", "rtype")
+
+
+class StructuredLogger(logging.LoggerAdapter):
+    """Adapter binding static context fields and injecting the live trace id.
+
+    ``get_logger("trainingjob.pod", job="default/j1", rtype="trainer")``
+    returns an adapter whose every record carries those fields plus the
+    ``trace_id``/``span_id`` of whatever span encloses the emit call --
+    nesting order, not binding order, decides the trace.
+    """
+
+    def __init__(self, logger: logging.Logger, **fields: Any):
+        super().__init__(logger, fields)
+
+    def bind(self, **fields: Any) -> "StructuredLogger":
+        merged = dict(self.extra or {})
+        merged.update(fields)
+        return StructuredLogger(self.logger, **merged)
+
+    def process(self, msg, kwargs):
+        extra = dict(self.extra or {})
+        extra.update(kwargs.get("extra") or {})
+        span = current_span()
+        if span is not None:
+            extra.setdefault("trace_id", span.trace_id)
+            extra.setdefault("span_id", span.span_id)
+        kwargs["extra"] = extra
+        return msg, kwargs
+
+
+def get_logger(name: str, **fields: Any) -> StructuredLogger:
+    return StructuredLogger(logging.getLogger(name), **fields)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record: ts/level/logger/message + context fields
+    + formatted exception.  Keys are sorted so lines diff cleanly."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for field in CONTEXT_FIELDS:
+            value = getattr(record, field, None)
+            if value is not None:
+                out[field] = value
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, sort_keys=True, default=str)
+
+
+class ContextTextFormatter(logging.Formatter):
+    """Human text with a bracketed context suffix when any field is bound."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        parts = [f"{field}={getattr(record, field)}"
+                 for field in CONTEXT_FIELDS
+                 if getattr(record, field, None) is not None]
+        return f"{base} [{' '.join(parts)}]" if parts else base
+
+
+def configure_logging(json_output: bool = False,
+                      level: int = logging.INFO,
+                      stream=None) -> logging.Handler:
+    """Install one handler on the root logger (cmd/main.py entry point).
+
+    Returns the handler so callers (tests) can remove it again.
+    """
+    handler = logging.StreamHandler(stream)
+    if json_output:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(ContextTextFormatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

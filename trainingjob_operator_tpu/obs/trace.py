@@ -1,0 +1,335 @@
+"""Span tracer: the causal trail the reference operator never had.
+
+The reference's observability is leveled klog text plus Status.Conditions
+(SURVEY.md §5.5) -- when a job flaps through restart scopes you cannot
+reconstruct *why* without replaying logs by hand.  This module is a
+dependency-free tracer in the OpenTelemetry shape (trace_id/span_id/parent,
+attributes, status) without the SDK: spans are context managers,
+``contextvars`` makes nested calls auto-parent, finished traces land in a
+bounded ring buffer, and two exporters serialize them -- JSON-lines (one span
+per line, machine-diffable) and Chrome ``trace_event`` format (drop the file
+on https://ui.perfetto.dev and read the reconcile timeline visually).
+
+Cross-process propagation is rendezvous-style, like the rest of the
+operator's workload contract: the controller serializes the current span as
+``"trace_id:span_id"`` into ``constants.TRACE_CONTEXT_ENV`` and the workload
+adopts it as the parent of its local root span, so one trace id spans
+controller, runtime, and train loop.
+
+A disabled tracer is a guarded fast path: ``span()`` returns a shared no-op
+singleton without touching the lock, the ring, or the contextvar.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Union
+
+#: Span status values (OpenTelemetry's OK/ERROR, lowercased).
+OK = "ok"
+ERROR = "error"
+
+#: The active span of the calling context; nested ``tracer.span()`` calls
+#: read it to auto-parent.  Thread-local by construction (each thread starts
+#: from the default), crosses threads only via ``contextvars.copy_context()``
+#: or an explicit ``parent=`` argument.
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "trainingjob_current_span", default=None)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current_span() -> Optional["Span"]:
+    """The span enclosing the caller, or None outside any span."""
+    return _current_span.get()
+
+
+def current_context() -> str:
+    """Serialized ``"trace_id:span_id"`` of the enclosing span (``""`` when
+    there is none) -- the value handed to workloads via TRACE_CONTEXT_ENV."""
+    span = _current_span.get()
+    return f"{span.trace_id}:{span.span_id}" if span is not None else ""
+
+
+class Span:
+    """One timed operation.  Use as a context manager::
+
+        with tracer.span("reconcile", job="default/j1") as sp:
+            sp.set_attribute("pods", 4)
+
+    Entering sets the span as the context's current span (children
+    auto-parent); exiting restores the previous one, records an exception as
+    status=error, and hands the finished span to the tracer.
+    """
+
+    __slots__ = ("_tracer", "name", "trace_id", "span_id", "parent_id",
+                 "attributes", "status", "start_time", "end_time",
+                 "pid", "tid", "thread_name", "_token", "_local_root")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], attributes: Dict[str, Any],
+                 local_root: bool):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attributes = attributes
+        self.status = OK
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.pid = os.getpid()
+        self.tid = threading.get_ident()
+        self.thread_name = threading.current_thread().name
+        self._token: Optional[contextvars.Token] = None
+        self._local_root = local_root
+
+    # -- recording -----------------------------------------------------------
+
+    def set_attribute(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def set_status(self, status: str) -> "Span":
+        self.status = status
+        return self
+
+    # -- context manager -----------------------------------------------------
+
+    def __enter__(self) -> "Span":
+        self.start_time = time.time()
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end_time = time.time()
+        if exc_type is not None:
+            self.status = ERROR
+            self.attributes.setdefault(
+                "exception", f"{exc_type.__name__}: {exc}")
+        if self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
+        self._tracer._finish(self)
+        return False  # never swallow
+
+    # -- serialization -------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "pid": self.pid,
+            "tid": self.tid,
+            "thread": self.thread_name,
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned by a disabled tracer.  Touches no
+    lock, no ring, no contextvar -- the guarded fast path."""
+
+    __slots__ = ()
+
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    status = OK
+    attributes: Dict[str, Any] = {}
+
+    def set_attribute(self, key: str, value: Any) -> "_NoopSpan":
+        return self
+
+    def set_status(self, status: str) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+#: Module-level singleton: every disabled ``span()`` call returns this.
+NOOP_SPAN = _NoopSpan()
+
+SpanParent = Union[None, Span, str]
+
+
+class Tracer:
+    """Collects finished spans into traces.
+
+    A *trace* is the span tree under one local root -- a span created with no
+    enclosing span (a fresh reconcile) or with an env-carried string context
+    (a workload adopting the controller's trace id).  While the root is open,
+    its finished descendants accumulate in ``_active``; when the root
+    finishes, the whole list moves into the bounded ``_finished`` ring
+    (oldest trace evicted first).
+    """
+
+    #: Hard cap on spans recorded per trace: a runaway span producer (a train
+    #: loop emitting one span per step for a week) must not grow one trace
+    #: without bound.  Overflow is counted, not silent.
+    MAX_SPANS_PER_TRACE = 4096
+
+    #: Cap on concurrently-open traces: spans finishing after their local
+    #: root (cross-thread stragglers) reopen an _active entry that no root
+    #: will ever flush; evict oldest past this.
+    MAX_ACTIVE_TRACES = 256
+
+    def __init__(self, enabled: bool = True, max_traces: int = 256,
+                 service: str = "trainingjob-operator"):
+        self.enabled = enabled
+        self.service = service
+        self._lock = threading.Lock()
+        self._active: "Dict[str, List[Dict[str, Any]]]" = {}
+        self._dropped: Dict[str, int] = {}
+        self._finished: "deque[Dict[str, Any]]" = deque(maxlen=max_traces)
+
+    # -- span creation -------------------------------------------------------
+
+    def span(self, name: str, parent: SpanParent = None,
+             **attributes: Any) -> Union[Span, _NoopSpan]:
+        """Open a span.  ``parent`` may be a Span, a ``"trace_id:span_id"``
+        string (env-carried context), or None to adopt the context's current
+        span (a fresh trace when there is none)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        local_root = False
+        if parent is None:
+            parent = _current_span.get()
+        if isinstance(parent, Span):
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif isinstance(parent, str) and ":" in parent:
+            trace_id, _, parent_id = parent.partition(":")
+            local_root = True  # the real root lives in another process
+        else:
+            trace_id, parent_id = _new_id(), None
+            local_root = True
+        return Span(self, name, trace_id, parent_id, dict(attributes),
+                    local_root)
+
+    def _finish(self, span: Span) -> None:
+        record = span.to_dict()
+        with self._lock:
+            spans = self._active.setdefault(span.trace_id, [])
+            if (len(spans) >= self.MAX_SPANS_PER_TRACE
+                    and not span._local_root):
+                # Drop descendants past the cap; the root always lands so the
+                # trace still flushes with its drop count attached.
+                self._dropped[span.trace_id] = (
+                    self._dropped.get(span.trace_id, 0) + 1)
+                return
+            spans.append(record)
+            if span._local_root:
+                self._active.pop(span.trace_id, None)
+                dropped = self._dropped.pop(span.trace_id, 0)
+                trace = {"trace_id": span.trace_id, "root": span.name,
+                         "service": self.service, "spans": spans}
+                if dropped:
+                    trace["dropped_spans"] = dropped
+                self._finished.append(trace)
+            elif len(self._active) > self.MAX_ACTIVE_TRACES:
+                oldest = next(iter(self._active))
+                self._active.pop(oldest, None)
+                self._dropped.pop(oldest, None)
+
+    # -- retrieval -----------------------------------------------------------
+
+    def traces(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Finished traces, newest first."""
+        with self._lock:
+            out = list(self._finished)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+            self._active.clear()
+            self._dropped.clear()
+
+    # -- exporters -----------------------------------------------------------
+
+    def export_jsonl(self, traces: Optional[List[Dict[str, Any]]] = None) -> str:
+        """One JSON object per line, one line per span (trace_id on every
+        line, so ``spans_from_jsonl`` reassembles traces losslessly)."""
+        if traces is None:
+            traces = self.traces()
+        lines = [json.dumps(span, sort_keys=True)
+                 for trace in traces for span in trace["spans"]]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def export_chrome(self, traces: Optional[List[Dict[str, Any]]] = None) -> str:
+        """Chrome ``trace_event`` JSON (the Perfetto/about:tracing format):
+        one complete event (``ph:"X"``) per span, timestamps and durations in
+        microseconds."""
+        if traces is None:
+            traces = self.traces()
+        events: List[Dict[str, Any]] = []
+        for trace in traces:
+            for span in trace["spans"]:
+                args = dict(span["attributes"])
+                args.update(trace_id=span["trace_id"],
+                            span_id=span["span_id"],
+                            parent_id=span["parent_id"],
+                            status=span["status"])
+                events.append({
+                    "ph": "X",
+                    "name": span["name"],
+                    "cat": trace.get("service", self.service),
+                    "ts": span["start_time"] * 1e6,
+                    "dur": max(span["end_time"] - span["start_time"], 0.0) * 1e6,
+                    "pid": span["pid"],
+                    "tid": span["tid"],
+                    "args": args,
+                })
+        return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"},
+                          indent=2)
+
+
+def spans_from_jsonl(text: str) -> List[Dict[str, Any]]:
+    """Inverse of ``export_jsonl``: parse back to a list of span dicts."""
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def group_traces(spans: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    """Group span dicts by trace_id, preserving order (round-trip helper)."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for span in spans:
+        out.setdefault(span["trace_id"], []).append(span)
+    return out
+
+
+#: Process-global tracer, mirroring utils.metrics.METRICS: controller,
+#: pod/service control, and runtimes all record into the same ring.
+TRACER = Tracer()
+
+
+def tracer_from_env(environ: Optional[Dict[str, str]] = None) -> "tuple[Tracer, str]":
+    """Workload-side tracer + the parent context handed down by the runtime.
+
+    Enabled only when the launcher injected TRACE_CONTEXT_ENV -- an untraced
+    run pays the no-op fast path and nothing else.  Returns ``(tracer,
+    parent_context)``; pass ``parent=parent_context`` to the workload's root
+    span so it joins the controller's trace.
+    """
+    from trainingjob_operator_tpu.api import constants
+
+    env = os.environ if environ is None else environ
+    parent = env.get(constants.TRACE_CONTEXT_ENV, "")
+    return Tracer(enabled=bool(parent), service="trainingjob-workload"), parent

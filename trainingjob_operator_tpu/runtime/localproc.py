@@ -43,6 +43,7 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
 log = logging.getLogger("trainingjob.localproc")
@@ -144,16 +145,19 @@ class LocalProcRuntime(PodStateRuntime):
         with self._lock:
             proc = self._state.get(f"{namespace}/{name}")
         if proc is not None and proc.popen is not None and proc.popen.poll() is None:
-            proc.popen.kill()
+            with TRACER.span("localproc.preempt", pod=f"{namespace}/{name}"):
+                proc.popen.kill()
 
     def fail_node(self, node: str) -> None:
         """Kill every pod process on the node and mark it NotReady."""
         with self._lock:
             victims = [p for p in self._state.values() if p.node == node]
-        for proc in victims:
-            if proc.popen is not None and proc.popen.poll() is None:
-                proc.popen.kill()
-        set_node_readiness(self._cs, node, False)
+        with TRACER.span("localproc.fail_node", node=node,
+                         pods=len(victims)):
+            for proc in victims:
+                if proc.popen is not None and proc.popen.poll() is None:
+                    proc.popen.kill()
+            set_node_readiness(self._cs, node, False)
 
     def recover_node(self, node: str) -> None:
         set_node_readiness(self._cs, node, True)
@@ -263,30 +267,39 @@ class LocalProcRuntime(PodStateRuntime):
             self._report_exit(pod, 2, node=node, reason="NoCommand")
             return
 
-        env = dict(os.environ)
-        env["PYTHONPATH"] = (str(Path(__file__).resolve().parents[2])
-                             + os.pathsep + env.get("PYTHONPATH", ""))
-        env[constants.RUNTIME_ENV] = "localproc"
-        for e in container.env:
-            env[e.name] = self._rewrite_value(e.value, pod.namespace)
+        # Adopt the reconcile trace that created this pod (stamped into the
+        # container env by pod.set_env); the launch span and the workload's
+        # own spans then share its trace id.
+        parent = next((e.value for e in container.env
+                       if e.name == constants.TRACE_CONTEXT_ENV), None)
+        with TRACER.span("localproc.launch", parent=parent,
+                         pod=f"{pod.namespace}/{pod.name}", node=node) as sp:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (str(Path(__file__).resolve().parents[2])
+                                 + os.pathsep + env.get("PYTHONPATH", ""))
+            env[constants.RUNTIME_ENV] = "localproc"
+            for e in container.env:
+                env[e.name] = self._rewrite_value(e.value, pod.namespace)
 
-        log_path = self._log_dir / f"{pod.namespace}_{pod.name}_{int(time.time()*1000)}.log"
-        try:
-            log_file = open(log_path, "wb")
-            popen = subprocess.Popen(
-                argv, env=env, stdout=log_file, stderr=subprocess.STDOUT,
-                cwd=container.working_dir or None,
-                start_new_session=True)
-            log_file.close()
-        except OSError as e:
-            log.error("launch %s failed: %s", pod.name, e)
-            self._report_exit(pod, 127, node=node, reason="LaunchError")
-            return
+            log_path = self._log_dir / f"{pod.namespace}_{pod.name}_{int(time.time()*1000)}.log"
+            try:
+                log_file = open(log_path, "wb")
+                popen = subprocess.Popen(
+                    argv, env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                    cwd=container.working_dir or None,
+                    start_new_session=True)
+                log_file.close()
+            except OSError as e:
+                log.error("launch %s failed: %s", pod.name, e)
+                sp.set_status("error")
+                self._report_exit(pod, 127, node=node, reason="LaunchError")
+                return
 
-        proc.popen = popen
-        proc.node = node
-        proc.log_path = str(log_path)
-        self._mark_running(pod, proc)
+            proc.popen = popen
+            proc.node = node
+            proc.log_path = str(log_path)
+            self._mark_running(pod, proc)
+            sp.set_attribute("pid", popen.pid)
         log.info("launched %s on %s (pid %d, log %s)",
                  pod.name, node, popen.pid, log_path)
 
@@ -308,6 +321,10 @@ class LocalProcRuntime(PodStateRuntime):
                      reason: str = "") -> None:
         if code < 0:  # killed by signal N -> exit code 128+N (shell convention)
             code = 128 - code
+        with TRACER.span("localproc.exit", pod=f"{pod.namespace}/{pod.name}",
+                         exit_code=code) as sp:
+            if code != 0:
+                sp.set_status("error")
         pod.status.phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
         if node:
             pod.spec.node_name = node

@@ -36,6 +36,7 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
 log = logging.getLogger("trainingjob.sim")
@@ -188,35 +189,43 @@ class SimRuntime(PodStateRuntime):
                 delay = float(pod.metadata.annotations.get(
                     START_DELAY_ANNOTATION, self._start_delay))
                 if now - rt.scheduled_at >= delay:
-                    pod.status.phase = PodPhase.RUNNING
-                    pod.status.start_time = now
-                    pod.status.container_statuses = [
-                        ContainerStatus(name=c.name,
-                                        state=ContainerState(running_started_at=now))
-                        for c in pod.spec.containers]
-                    run_s = pod.metadata.annotations.get(RUN_SECONDS_ANNOTATION)
-                    if self._try_update_pod(pod):
-                        rt.started_at = now
-                        if run_s is not None and rt.will_exit_at is None:
-                            rt.will_exit_at = now + float(run_s)
-                            rt.exit_code = int(pod.metadata.annotations.get(
-                                EXIT_CODE_ANNOTATION, "0"))
+                    with TRACER.span("sim.start",
+                                     pod=f"{pod.namespace}/{pod.name}",
+                                     node=pod.spec.node_name):
+                        pod.status.phase = PodPhase.RUNNING
+                        pod.status.start_time = now
+                        pod.status.container_statuses = [
+                            ContainerStatus(name=c.name,
+                                            state=ContainerState(running_started_at=now))
+                            for c in pod.spec.containers]
+                        run_s = pod.metadata.annotations.get(RUN_SECONDS_ANNOTATION)
+                        if self._try_update_pod(pod):
+                            rt.started_at = now
+                            if run_s is not None and rt.will_exit_at is None:
+                                rt.will_exit_at = now + float(run_s)
+                                rt.exit_code = int(pod.metadata.annotations.get(
+                                    EXIT_CODE_ANNOTATION, "0"))
 
             elif (pod.status.phase == PodPhase.RUNNING
                   and rt.will_exit_at is not None and now >= rt.will_exit_at):
                 code = rt.exit_code
-                pod.status.phase = (PodPhase.SUCCEEDED if code == 0
-                                    else PodPhase.FAILED)
-                pod.status.container_statuses = [
-                    ContainerStatus(name=c.name,
-                                    state=ContainerState(
-                                        terminated_exit_code=code,
-                                        terminated_reason="Completed" if code == 0 else "Error"))
-                    for c in pod.spec.containers]
-                if self._try_update_pod(pod):
-                    # Only clear after a successful write -- a conflict retries
-                    # against a fresh snapshot next tick.
-                    rt.will_exit_at = None
+                with TRACER.span("sim.exit",
+                                 pod=f"{pod.namespace}/{pod.name}",
+                                 exit_code=code) as sp:
+                    if code != 0:
+                        sp.set_status("error")
+                    pod.status.phase = (PodPhase.SUCCEEDED if code == 0
+                                        else PodPhase.FAILED)
+                    pod.status.container_statuses = [
+                        ContainerStatus(name=c.name,
+                                        state=ContainerState(
+                                            terminated_exit_code=code,
+                                            terminated_reason="Completed" if code == 0 else "Error"))
+                        for c in pod.spec.containers]
+                    if self._try_update_pod(pod):
+                        # Only clear after a successful write -- a conflict
+                        # retries against a fresh snapshot next tick.
+                        rt.will_exit_at = None
 
     def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
         placements = []
@@ -246,12 +255,15 @@ class SimRuntime(PodStateRuntime):
                 for p in gang_pods:
                     self._mark_unschedulable(p)
                 return
-        for pod, node_name, _ in placements:
-            pod.spec.node_name = node_name
-            pod.status.conditions = [Condition(
-                type=PodConditionType.SCHEDULED, status=ConditionStatus.TRUE,
-                last_transition_time=time.time())]
-            self._try_update_pod(pod)
+        # One span per committed gang placement (transitions only -- a gang
+        # that stays pending retries every tick and must not flood the ring).
+        with TRACER.span("sim.schedule", pods=len(placements)):
+            for pod, node_name, _ in placements:
+                pod.spec.node_name = node_name
+                pod.status.conditions = [Condition(
+                    type=PodConditionType.SCHEDULED, status=ConditionStatus.TRUE,
+                    last_transition_time=time.time())]
+                self._try_update_pod(pod)
 
     def _mark_unschedulable(self, pod: Pod) -> None:
         msg = "0/? nodes available: insufficient capacity"

@@ -58,9 +58,16 @@ class _Histogram:
         self.counts[-1] += 1
 
     def quantile(self, q: float) -> float:
-        """Bucket-resolution quantile estimate (upper bound of the bucket)."""
-        if self.count == 0:
+        """Bucket-resolution quantile estimate (upper bound of the bucket).
+
+        q is clamped to (0, 1]: at q<=0 the old code computed target=0 and
+        the first ``seen >= target`` test passed before any mass was seen,
+        biasing the answer to the first bucket's upper bound even when the
+        histogram held nothing there.
+        """
+        if self.count == 0 or q <= 0.0:
             return 0.0
+        q = min(q, 1.0)
         target = q * self.count
         seen = 0
         for i, c in enumerate(self.counts[:-1]):
@@ -184,11 +191,45 @@ def thread_dump() -> str:
     return "\n".join(parts)
 
 
+def _render_traces(tracer, params: Dict[str, List[str]]) -> Tuple[str, str]:
+    """(content-type, body) for /debug/traces: JSON trace list by default,
+    Chrome trace_event JSON with ?format=chrome (Perfetto-loadable)."""
+    limit_raw = params.get("limit", [""])[0]
+    limit = int(limit_raw) if limit_raw.isdigit() else None
+    traces = tracer.traces(limit)
+    if params.get("format", [""])[0] == "chrome":
+        return "application/json", tracer.export_chrome(traces)
+    return "application/json", json.dumps(
+        {"count": len(traces), "traces": traces}, indent=2)
+
+
+def _render_events(events_fn, params: Dict[str, List[str]]) -> str:
+    """/debug/events: the durable event store, newest last, filterable with
+    ?job=<namespace/name> (or bare name) on the involved object."""
+    events = list(events_fn())
+    job = params.get("job", [""])[0]
+    if job:
+        def matches(ev) -> bool:
+            return (f"{ev.involved_namespace}/{ev.involved_name}" == job
+                    or ev.involved_name == job)
+        events = [ev for ev in events if matches(ev)]
+    events.sort(key=lambda ev: ev.timestamp or 0.0)
+    return json.dumps({"count": len(events),
+                       "events": [ev.to_dict() for ev in events]}, indent=2)
+
+
 def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
-                  host: str = "127.0.0.1"):
-    """Serve /metrics (Prometheus text), /metrics.json, /healthz and
-    /debug/threads on a daemon thread; ``.shutdown()`` stops it and closes
-    the socket.
+                  host: str = "127.0.0.1", tracer=None, events_fn=None,
+                  ready_fn: Optional[Callable[[], bool]] = None):
+    """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
+    /debug/threads, /debug/traces and /debug/events on a daemon thread;
+    ``.shutdown()`` stops it and closes the socket.
+
+    - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
+    - ``events_fn``: zero-arg callable returning Event objects (e.g.
+      ``lambda: clientset.events.list(None)``); enables /debug/events.
+    - ``ready_fn``: informer-synced gate for /readyz -- 503 until it returns
+      truthy.  Omitted -> always ready (no controller to wait for).
 
     Binds loopback by default -- /debug/threads exposes live stacks, the
     pprof convention (expose beyond localhost only deliberately via
@@ -196,6 +237,7 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
     client can neither block other scrapes nor hang operator shutdown.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs
 
     reg = registry or METRICS
 
@@ -203,27 +245,38 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
         timeout = 5  # settimeout on the connection: drop stuck clients
 
         def do_GET(self):  # noqa: N802 (stdlib API)
-            routes = {
-                "/metrics": ("text/plain; version=0.0.4",
-                             lambda: reg.render_prometheus()),
-                "/metrics.json": ("application/json",
-                                  lambda: json.dumps(reg.snapshot(),
-                                                     indent=2)),
-                "/healthz": ("text/plain", lambda: "ok\n"),
-                "/debug/threads": ("text/plain", thread_dump),
-            }
-            route = routes.get(self.path.split("?")[0])
-            if route is None:
+            path, _, query = self.path.partition("?")
+            params = parse_qs(query)
+            status, ctype, body = 200, "text/plain", None
+            if path == "/metrics":
+                ctype, body = "text/plain; version=0.0.4", reg.render_prometheus()
+            elif path == "/metrics.json":
+                ctype, body = "application/json", json.dumps(reg.snapshot(),
+                                                            indent=2)
+            elif path == "/healthz":
+                body = "ok\n"
+            elif path == "/readyz":
+                if ready_fn is None or ready_fn():
+                    body = "ok\n"
+                else:
+                    status, body = 503, "not ready\n"
+            elif path == "/debug/threads":
+                body = thread_dump()
+            elif path == "/debug/traces" and tracer is not None:
+                ctype, body = _render_traces(tracer, params)
+            elif path == "/debug/events" and events_fn is not None:
+                ctype, body = "application/json", _render_events(events_fn,
+                                                                params)
+            if body is None:
                 self.send_response(404)
                 self.end_headers()
                 return
-            ctype, render = route
-            body = render().encode()
-            self.send_response(200)
+            raw = body.encode()
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Length", str(len(raw)))
             self.end_headers()
-            self.wfile.write(body)
+            self.wfile.write(raw)
 
         def log_message(self, *args):  # quiet
             pass

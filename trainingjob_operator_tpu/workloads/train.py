@@ -23,6 +23,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.trace import tracer_from_env
 from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
 
 
@@ -376,19 +377,32 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
 
     shutdown = GracefulShutdown().install()
     profiler = StepProfiler()
+    # Workload half of the trace contract: enabled only when the operator
+    # injected TRAININGJOB_TRACE_CONTEXT into the pod env (pod.set_env), so
+    # the run span joins the trace of the reconcile that created this pod.
+    tracer, trace_parent = tracer_from_env()
     loss = None
     t_start = None
     t_loop = time.time()
     # One-step-ahead prefetch: batch_at(i) runs on a background thread while
     # step i-1 executes on the chip (batch_at ends in an async device_put,
     # so the host->HBM DMA overlaps compute too).
-    with peer_loss_guard(shutdown=shutdown), \
+    with tracer.span("train.run", parent=trace_parent,
+                     start_step=start_step, steps=steps), \
+            peer_loss_guard(shutdown=shutdown), \
             Prefetcher(batch_at, start_step, steps) as batches:
         for i, batch in batches:
             profiler.step_start(i)
-            params, opt_state, loss = step_fn(params, opt_state, batch)
+            # The first step after a (re)start is trace+compile+step -- the
+            # elastic-recovery component -- so it gets its own span name and
+            # a real device fence; later steps dispatch async and the span
+            # measures host-side dispatch only.
+            with tracer.span("train.compile" if i == start_step
+                             else "train.step", step=i):
+                params, opt_state, loss = step_fn(params, opt_state, batch)
+                if i == start_step:
+                    jax.block_until_ready(loss)
             if i == start_step:
-                jax.block_until_ready(loss)
                 t_start = time.time()
                 # Trace + compile (compile-cache-sensitive) + one step:
                 # the last recovery component after llama_elastic's
@@ -401,8 +415,9 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
             profiler.step_end(i, sync=loss)
 
             def save(step, wait=False):
-                state.save({"params": params, "opt_state": opt_state,
-                            "step": step}, wait=wait)
+                with tracer.span("train.checkpoint", step=step, wait=wait):
+                    state.save({"params": params, "opt_state": opt_state,
+                                "step": step}, wait=wait)
 
             if shutdown.requested:
                 shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
@@ -421,7 +436,25 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
         profiler.close()
         jax.block_until_ready(loss)
         state.finalize()  # commit any in-flight background save before exit
+    _maybe_export_trace(tracer)
     return params, opt_state, loss, t_start
+
+
+def _maybe_export_trace(tracer) -> None:
+    """Dump the workload trace (Chrome trace_event JSON, Perfetto-loadable)
+    to ``$TRAININGJOB_TRACE_DIR/trace-<pid>.json`` when the dir is set.
+    Best-effort: an unwritable dir must never fail a finished run."""
+    trace_dir = os.environ.get(constants.TRACE_DIR_ENV, "")
+    if not trace_dir or not tracer.enabled:
+        return
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"trace-{os.getpid()}.json")
+        with open(path, "w") as f:
+            f.write(tracer.export_chrome())
+        print(f"workload trace written to {path}", flush=True)
+    except OSError as exc:
+        print(f"trace export failed: {exc}", flush=True)
 
 
 def accumulated_value_and_grad(loss_fn: Callable, params: Any, tokens,
