@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test dryrun bench install ci trace-demo
+.PHONY: lint test dryrun bench install ci trace-demo telemetry-demo
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): milliseconds, runs
 # before the tests so a grammar/race/contract bug fails fast with a
@@ -27,6 +27,12 @@ bench:
 # trace_event JSON (docs/OBSERVABILITY.md) -- load it in Perfetto.
 trace-demo:
 	$(PY) -m tools.trace_demo --out /tmp/trace.json
+
+# One simulated job with a deliberate straggler + stalled replica; prints the
+# live per-replica step table, straggler skew, and the StepStalled event
+# (docs/OBSERVABILITY.md telemetry section).
+telemetry-demo:
+	$(PY) -m tools.telemetry_demo
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation

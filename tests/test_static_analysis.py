@@ -576,6 +576,70 @@ class TestOrphanedThread:
         assert analyze(tmp_path, "m.py", src, only=["orphaned-thread"]) == []
 
 
+# -- TJA009 status-write-discipline ------------------------------------------
+
+class TestStatusWriteDiscipline:
+    def test_fires_on_direct_phase_and_condition_mutation(self, tmp_path):
+        src = """
+        def rogue(job, cond):
+            job.status.phase = "Failed"
+            job.status.conditions = []
+            job.status.conditions.append(cond)
+            fresh_job.status.phase = "Running"
+        """
+        findings = analyze(tmp_path, "trainingjob_operator_tpu/controller/m.py",
+                           src, only=["status-write-discipline"])
+        assert ids(findings) == ["TJA009"]
+        assert len(findings) == 4
+        assert all("update_job_conditions" in f.message for f in findings)
+
+    def test_quiet_on_pod_status_and_reads(self, tmp_path):
+        src = """
+        def fine(job, pod, node):
+            pod.status.phase = "Running"       # pod status: unguarded API
+            node.status.conditions = []
+            if job.status.phase == "Running":  # read, not write
+                return job.status.conditions[-1]
+            job.status.restart_replica_name = ""  # not a guarded field
+        """
+        assert analyze(tmp_path, "trainingjob_operator_tpu/controller/m.py",
+                       src, only=["status-write-discipline"]) == []
+
+    def test_status_machine_helpers_are_exempt(self, tmp_path):
+        src = """
+        def set_condition(status, new_cond):
+            status.conditions.append(new_cond)
+
+        def update_job_conditions(job, ctype):
+            job.status.phase = ctype
+
+        def rogue(job):
+            job.status.phase = "X"
+        """
+        findings = analyze(
+            tmp_path, "trainingjob_operator_tpu/controller/status.py", src,
+            only=["status-write-discipline"])
+        assert len(findings) == 1
+        assert findings[0].line == 9
+
+    def test_out_of_package_code_is_not_scoped(self, tmp_path):
+        src = """
+        def fixture(job):
+            job.status.phase = "Succeeded"
+        """
+        assert analyze(tmp_path, "tests/m.py", src,
+                       only=["status-write-discipline"]) == []
+
+    def test_waiver_suppresses(self, tmp_path):
+        src = """
+        def migrate(job):
+            # analyzer: allow[status-write-discipline]: one-shot migration
+            job.status.phase = "Failed"
+        """
+        assert analyze(tmp_path, "trainingjob_operator_tpu/controller/m.py",
+                       src, only=["status-write-discipline"]) == []
+
+
 # -- runner: baseline, waivers, formats, CLI ---------------------------------
 
 class TestRunnerMachinery:
@@ -630,11 +694,11 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_eight_checks_registered(self):
+    def test_all_nine_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
-            "TJA007", "TJA008"}
+            "TJA007", "TJA008", "TJA009"}
 
 
 # -- the tier-1 gate ---------------------------------------------------------

@@ -1,0 +1,538 @@
+"""Replica telemetry plane: aggregator semantics, the emitter->sink wire,
+the /debug/steps endpoint, and the sim e2e stall acceptance.
+
+Unit layer first (ingest/percentiles/skew/MFU/stall/resume against a private
+aggregator + registry, no globals), then the TCP line-protocol wire, then
+HTTP (the scrape pattern of test_obs.py), then e2e: a sim job with one
+annotation-stalled replica must produce a StepStalled event, a nonzero
+straggler-skew sample on /metrics, and a /debug/steps table showing the
+lagging rank.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+from trainingjob_operator_tpu.obs.telemetry import (
+    TELEMETRY,
+    TelemetryAggregator,
+    TelemetryEmitter,
+    TelemetrySink,
+    clear_sink_address,
+    peak_flops_for_accelerator,
+    publish_sink_address,
+    sink_address,
+)
+from trainingjob_operator_tpu.utils.metrics import (
+    METRICS,
+    MetricsRegistry,
+    serve_metrics,
+)
+
+from conftest import wait_for  # noqa: E402
+
+JOB = "default/tjob"
+
+
+def _agg(**kw):
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("goodput", GoodputTracker(metrics=kw["metrics"]))
+    return TelemetryAggregator(**kw)
+
+
+def _rec(rank=0, step=0, ms=100.0, job=JOB, rtype="worker", **extra):
+    rec = {"v": 1, "job": job, "rtype": rtype, "rank": rank, "step": step,
+           "ms": ms}
+    rec.update(extra)
+    return rec
+
+
+def _feed(agg, ranks=1, steps=10, ms=100.0, t0=1000.0, slow=None,
+          slow_factor=3.0, **extra):
+    """steps records per rank, 0.1 s apart; ``slow`` rank gets slower steps."""
+    now = t0
+    for step in range(steps):
+        now = t0 + step * 0.1
+        for rank in range(ranks):
+            step_ms = ms * (slow_factor if rank == slow else 1.0)
+            assert agg.ingest(_rec(rank=rank, step=step, ms=step_ms, **extra),
+                              now=now)
+    return now
+
+
+# -- aggregator unit layer ----------------------------------------------------
+
+class TestAggregatorIngest:
+    def test_percentiles_and_table(self):
+        agg = _agg()
+        for i, ms in enumerate([10.0] * 9 + [100.0]):
+            agg.ingest(_rec(step=i, ms=ms), now=1000.0 + i)
+        table = agg.job_table(JOB, now=1010.0)
+        row = table["replicas"][0]
+        assert row["replica"] == "worker-0"
+        assert row["step"] == 9
+        assert row["median_ms"] == 10.0
+        assert row["p90_ms"] == 100.0
+
+    def test_malformed_records_counted_not_raised(self):
+        reg = MetricsRegistry()
+        agg = _agg(metrics=reg)
+        bad = [
+            {},                                   # no fields at all
+            {"job": "nojslash", "step": 1, "ms": 5},  # job not ns/name
+            _rec(step=-1),                        # negative step
+            _rec(ms=0.0),                         # non-positive duration
+            _rec(rank=-2),                        # negative rank
+            {"job": JOB, "step": "x", "ms": 5},   # non-numeric step
+        ]
+        for rec in bad:
+            assert agg.ingest(rec, now=1.0) is False
+        assert agg.job_table(JOB) is None
+        snap = reg.snapshot()
+        assert snap["trainingjob_telemetry_malformed_total"] == len(bad)
+
+    def test_pacer_dedup_tokens_per_sec_not_summed(self):
+        # 4 SPMD ranks each report 1000 tokens per 100 ms step: the job rate
+        # is one rank's rate (10k tokens/s), not 4x.
+        agg = _agg()
+        _feed(agg, ranks=4, steps=10, ms=100.0, tokens=1000)
+        assert agg.tokens_per_sec(JOB) == pytest.approx(10000.0)
+
+    def test_pacer_feeds_goodput_productive_steps(self):
+        gp = GoodputTracker(metrics=MetricsRegistry())
+        agg = _agg(goodput=gp)
+        gp.on_running(JOB, 1000.0)
+        _feed(agg, ranks=2, steps=10, ms=100.0)
+        gp.on_complete(JOB, 1002.0)
+        # 10 pacer steps x 0.1 s = 1 s productive over 2 s running.
+        assert gp.ratio(JOB) == pytest.approx(0.5, abs=0.01)
+
+    def test_straggler_skew_slowest_over_median(self):
+        agg = _agg()
+        _feed(agg, ranks=4, steps=10, slow=3, slow_factor=3.0)
+        assert agg.straggler_skew(JOB, "worker") == pytest.approx(3.0)
+        assert agg.straggler_skew(JOB, "nope") == 0.0
+
+    def test_mfu_from_spec_peak(self):
+        agg = _agg()
+        # 100 ms/step at 2e12 FLOPs/step = 2e13 FLOP/s achieved.
+        _feed(agg, steps=10, ms=100.0, flops=2e12)
+        agg.set_peak_flops(JOB, 8e13)
+        assert agg.mfu(JOB) == pytest.approx(0.25)
+
+    def test_mfu_from_record_peak_and_unknown_is_none(self):
+        agg = _agg()
+        _feed(agg, steps=10, ms=100.0, flops=1e12, peak_flops=4e13)
+        assert agg.mfu(JOB) == pytest.approx(0.25)
+        agg2 = _agg()
+        _feed(agg2, steps=10, ms=100.0)  # no flops, no peak
+        assert agg2.mfu(JOB) is None
+
+    def test_accelerator_peak_table(self):
+        assert peak_flops_for_accelerator("tpu-v5-lite-podslice") > 0
+        assert peak_flops_for_accelerator("tpu-v4-podslice") > 0
+        assert peak_flops_for_accelerator("warehouse-gpu") == 0.0
+
+
+class TestStallWatchdog:
+    def test_stall_fires_event_and_counter_then_resume(self):
+        reg = MetricsRegistry()
+        agg = _agg(metrics=reg)
+        events = []
+        agg.set_event_sink(lambda k, r, m: events.append((k, r, m)))
+        now = _feed(agg, ranks=2, steps=10, ms=100.0)
+
+        # Not yet: below threshold (max(8 x 0.1 s, 2 s floor) = 2 s).
+        agg.check_stalls(now=now + 1.0)
+        assert not events
+
+        agg.check_stalls(now=now + 3.0)
+        reasons = [r for _, r, _ in events]
+        assert reasons.count(constants.STEP_STALLED_REASON) == 2
+        assert agg.stalled_count(JOB) == 2
+        assert "worker-0" in events[0][2] and "stuck at step 9" in events[0][2]
+        snap = reg.snapshot()
+        key = ('trainingjob_steps_stalled_total'
+               '{job="default/tjob",rtype="worker"}')
+        assert snap[key] == 2.0
+        # No re-fire while still stalled.
+        agg.check_stalls(now=now + 10.0)
+        assert len(events) == 2
+
+        # Progress: StepResumed, stalled gauge falls back to 0.
+        agg.ingest(_rec(rank=0, step=10), now=now + 11.0)
+        agg.ingest(_rec(rank=1, step=10), now=now + 11.0)
+        resumed = [r for _, r, _ in events
+                   if r == constants.STEP_RESUMED_REASON]
+        assert len(resumed) == 2
+        assert agg.stalled_count(JOB) == 0
+
+    def test_needs_three_steps_before_arming(self):
+        agg = _agg()
+        events = []
+        agg.set_event_sink(lambda k, r, m: events.append(r))
+        agg.ingest(_rec(step=0), now=1000.0)
+        agg.ingest(_rec(step=1), now=1000.1)
+        agg.check_stalls(now=9999.0)
+        assert not events
+
+    def test_interruption_suspends_and_clears_replicas(self):
+        agg = _agg()
+        events = []
+        agg.set_event_sink(lambda k, r, m: events.append(r))
+        now = _feed(agg, ranks=2, steps=10)
+        agg.on_interruption(JOB)
+        # Replicas renumber across a restart/resize: stale per-rank state
+        # must not page while the drain kills pods on purpose.
+        agg.check_stalls(now=now + 100.0)
+        assert not events
+        assert agg.job_table(JOB)["replicas"] == []
+
+    def test_completed_job_drops_late_records(self):
+        agg = _agg()
+        _feed(agg, steps=5)
+        agg.on_complete(JOB)
+        assert agg.ingest(_rec(step=99), now=2000.0)  # accepted, dropped
+        assert agg.job_table(JOB)["replicas"][0]["step"] == 4
+
+    def test_forget_removes_gauges(self):
+        reg = MetricsRegistry()
+        agg = _agg(metrics=reg)
+        _feed(agg, steps=5, tokens=100)
+        assert any("trainingjob_tokens_per_sec" in k
+                   for k in reg.snapshot())
+        agg.forget(JOB)
+        assert not any("trainingjob_tokens_per_sec" in k
+                       for k in reg.snapshot())
+        assert agg.job_table(JOB) is None
+
+
+class TestStatusLine:
+    def test_snapshot_and_cache(self):
+        agg = _agg()
+        _feed(agg, steps=10, ms=100.0, tokens=1000, t0=1000.0)
+        line = agg.status_line(JOB, now=1001.0)
+        assert "step 9" in line and "tokens/s" in line
+        # Cached: new steps don't show until the refresh window passes.
+        agg.ingest(_rec(step=50), now=1002.0)
+        assert agg.status_line(JOB, now=1002.0) == line
+        fresh = agg.status_line(JOB, now=1001.0 + agg.status_refresh_seconds)
+        assert "step 50" in fresh
+
+    def test_empty_for_unknown_job(self):
+        assert _agg().status_line("ns/none") == ""
+
+
+# -- sink address publication (rendezvous env injection) ----------------------
+
+class TestSinkAddressPublication:
+    def test_publish_clear_owner_scoped(self):
+        try:
+            publish_sink_address("127.0.0.1:1111", owner="a")
+            assert sink_address() == "127.0.0.1:1111"
+            clear_sink_address(owner="b")  # not the publisher: no-op
+            assert sink_address() == "127.0.0.1:1111"
+            clear_sink_address(owner="a")
+            assert sink_address() == ""
+        finally:
+            clear_sink_address()
+
+    def test_pod_env_gets_telemetry_addr(self):
+        from trainingjob_operator_tpu.api.types import (
+            ReplicaSpec,
+            TPUTrainingJob,
+        )
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.controller.controller import (
+            TrainingJobController,
+        )
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+            PodTemplateSpec,
+        )
+
+        tc = TrainingJobController(Clientset())
+        job = TPUTrainingJob(metadata=ObjectMeta(name="envj",
+                                                 namespace="default"))
+        spec = ReplicaSpec(replicas=1, template=PodTemplateSpec(
+            spec=PodSpec(containers=[Container(name="aitj-main")])))
+        job.spec.replica_specs["worker"] = spec
+
+        def build_env():
+            pod = Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                      spec=PodSpec(containers=[Container(name="aitj-main")]))
+            tc.set_env(pod, job, spec, "worker", "0", "0")
+            return {e.name: e.value for e in pod.spec.containers[0].env}
+
+        try:
+            clear_sink_address()
+            assert constants.TELEMETRY_ADDR_ENV not in build_env()
+            publish_sink_address("127.0.0.1:2222", owner="t")
+            assert build_env()[constants.TELEMETRY_ADDR_ENV] == "127.0.0.1:2222"
+        finally:
+            clear_sink_address()
+
+
+# -- the TCP wire -------------------------------------------------------------
+
+class TestEmitterSinkWire:
+    def test_records_flow_and_garbage_is_counted(self, monkeypatch):
+        import socket
+
+        reg = MetricsRegistry()
+        agg = _agg(metrics=reg)
+        sink = TelemetrySink(aggregator=agg, publish=False).start()
+        try:
+            monkeypatch.setenv(constants.TELEMETRY_ADDR_ENV, sink.address)
+            monkeypatch.setenv(constants.JOB_NAMESPACE_ENV, "default")
+            monkeypatch.setenv(constants.JOB_NAME_ENV, "wirejob")
+            monkeypatch.setenv(constants.REPLICA_NAME_ENV, "Worker")
+            monkeypatch.setenv(constants.REPLICA_INDEX_ENV, "1")
+            em = TelemetryEmitter(units_per_step=64.0)
+            assert em.enabled
+            for i in range(5):
+                em.emit(i, 12.5, loss=3.0 - i * 0.1)
+            em.close()
+            def last_step():
+                rows = (agg.job_table("default/wirejob")
+                        or {"replicas": []})["replicas"]
+                return rows[0]["step"] if rows else -1
+
+            # Wait for the *last* record: the sink drains the stream
+            # record by record after the emitter has already closed.
+            assert wait_for(lambda: last_step() == 4, 5)
+            row = agg.job_table("default/wirejob")["replicas"][0]
+            assert row["rtype"] == "worker" and row["rank"] == 1
+            assert row["loss"] == pytest.approx(2.6)
+
+            # Garbage on the wire: counted, never raises, sink stays up.
+            host, _, port = sink.address.rpartition(":")
+            with socket.create_connection((host, int(port)), timeout=2) as s:
+                s.sendall(b"not json at all\n{}\n")
+            assert wait_for(
+                lambda: reg.snapshot().get(
+                    "trainingjob_telemetry_malformed_total", 0) >= 2, 5)
+        finally:
+            sink.stop()
+
+    def test_emitter_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv(constants.TELEMETRY_ADDR_ENV, raising=False)
+        em = TelemetryEmitter()
+        assert not em.enabled
+        em.emit(0, 1.0)  # no-op, no error
+        em.close()
+
+    def test_emitter_survives_dead_sink(self, monkeypatch):
+        sink = TelemetrySink(aggregator=_agg(), publish=False).start()
+        addr = sink.address
+        sink.stop()
+        monkeypatch.setenv(constants.TELEMETRY_ADDR_ENV, addr)
+        monkeypatch.setenv(constants.JOB_NAMESPACE_ENV, "default")
+        monkeypatch.setenv(constants.JOB_NAME_ENV, "deadjob")
+        em = TelemetryEmitter(retry_seconds=0.0)
+        for i in range(3):
+            em.emit(i, 1.0)  # connection refused: swallowed
+        em.close()
+
+    def test_sink_publishes_and_unpublishes_address(self):
+        try:
+            sink = TelemetrySink(aggregator=_agg()).start()
+            assert sink_address() == sink.address
+            sink.stop()
+            assert sink_address() == ""
+        finally:
+            clear_sink_address()
+
+
+# -- /debug/steps + query-param edge cases ------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestDebugStepsEndpoint:
+    @pytest.fixture
+    def server(self):
+        from trainingjob_operator_tpu.obs.trace import Tracer
+
+        agg = _agg()
+        _feed(agg, ranks=3, steps=10, ms=50.0, tokens=256, slow=2)
+        tracer = Tracer()
+        with tracer.span("sync_job", job=JOB):
+            pass
+        srv = serve_metrics(0, MetricsRegistry(), tracer=tracer,
+                            events_fn=lambda: [], telemetry=agg)
+        yield srv.server_address[1]
+        srv.shutdown()
+
+    def test_job_table_json(self, server):
+        status, body = _get(server, f"/debug/steps?job={JOB}")
+        doc = json.loads(body)
+        assert status == 200 and doc["job"] == JOB
+        assert [r["replica"] for r in doc["replicas"]] == ["worker-0",
+                                                           "worker-1",
+                                                           "worker-2"]
+        assert doc["straggler_skew"]["worker"] == pytest.approx(3.0)
+
+    def test_job_list_without_param(self, server):
+        status, body = _get(server, "/debug/steps")
+        doc = json.loads(body)
+        assert status == 200 and doc == {"count": 1, "jobs": [JOB]}
+
+    def test_text_format(self, server):
+        status, body = _get(server, f"/debug/steps?job={JOB}&format=text")
+        assert status == 200
+        assert body.splitlines()[0].startswith("replica")
+        assert "worker-2" in body
+
+    def test_unknown_job_404_not_500(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/debug/steps?job=no/such")
+        assert exc.value.code == 404
+
+    def test_404_without_telemetry_provider(self):
+        srv = serve_metrics(0, MetricsRegistry())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(srv.server_address[1], "/debug/steps")
+            assert exc.value.code == 404
+        finally:
+            srv.shutdown()
+
+    def test_traces_junk_limit_and_unknown_format(self, server):
+        # ?limit=junk falls back to "no limit" instead of 500ing.
+        status, body = _get(server, "/debug/traces?limit=junk")
+        assert status == 200 and json.loads(body)["count"] == 1
+        # Unknown ?format= serves the default JSON form.
+        status, body = _get(server, "/debug/traces?format=starlight")
+        assert status == 200 and "traces" in json.loads(body)
+
+    def test_events_with_no_matches_is_empty_not_error(self, server):
+        status, body = _get(server, "/debug/events?job=absent/job")
+        assert status == 200
+        assert json.loads(body) == {"count": 0, "events": []}
+
+
+# -- e2e: sim job with an injected stalled replica ----------------------------
+
+class TestStallE2E:
+    @pytest.fixture
+    def cluster(self):
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.cmd.options import OperatorOptions
+        from trainingjob_operator_tpu.controller.controller import (
+            TrainingJobController,
+        )
+        from trainingjob_operator_tpu.runtime.sim import SimRuntime
+
+        cs = Clientset()
+        tc = TrainingJobController(
+            cs, options=OperatorOptions(resync_period=0.05))
+        sim = SimRuntime(cs)
+        sim.add_node("n0")
+        sim.start()
+        tc.run(workers=2)
+        yield cs, tc, sim
+        tc.stop()
+        sim.stop()
+
+    def test_stalled_replica_event_skew_and_step_table(self, cluster):
+        from trainingjob_operator_tpu.api.types import (
+            ReplicaSpec,
+            TPUTrainingJob,
+            TrainingJobPhase,
+        )
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ContainerPort,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from trainingjob_operator_tpu.runtime.sim import (
+            RUN_SECONDS_ANNOTATION,
+            STALL_AT_STEP_ANNOTATION,
+            STALL_RANK_ANNOTATION,
+            STEP_MS_ANNOTATION,
+            STRAGGLER_FACTOR_ANNOTATION,
+            STRAGGLER_RANK_ANNOTATION,
+            TOKENS_PER_STEP_ANNOTATION,
+        )
+
+        cs, tc, sim = cluster
+        key = "default/stalljob"
+        TELEMETRY.forget(key)
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name="stalljob", namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=3,
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(annotations={
+                    RUN_SECONDS_ANNOTATION: "30",
+                    STEP_MS_ANNOTATION: "20",
+                    TOKENS_PER_STEP_ANNOTATION: "512",
+                    STRAGGLER_RANK_ANNOTATION: "1",
+                    STRAGGLER_FACTOR_ANNOTATION: "2.0",
+                    STALL_RANK_ANNOTATION: "2",
+                    STALL_AT_STEP_ANNOTATION: "10",
+                }),
+                spec=PodSpec(containers=[
+                    Container(name="aitj-main",
+                              ports=[ContainerPort(name="aitj-7745",
+                                                   container_port=7745)])])))
+        cs.trainingjobs.create(job)
+        try:
+            assert wait_for(
+                lambda: cs.trainingjobs.get("default", "stalljob")
+                .status.phase == TrainingJobPhase.RUNNING, 10)
+
+            # Acceptance 1: the watchdog raises StepStalled for the frozen
+            # rank (stall floor 2 s: rank 2 stops advancing at step 10).
+            assert wait_for(
+                lambda: any(
+                    ev.reason == constants.STEP_STALLED_REASON
+                    for ev in cs.events.list("default")), 15)
+            ev = next(ev for ev in cs.events.list("default")
+                      if ev.reason == constants.STEP_STALLED_REASON)
+            assert "trainer-2" in ev.message
+
+            # Acceptance 2: nonzero straggler-skew sample on /metrics.
+            line = next(
+                (ln for ln in METRICS.render_prometheus().splitlines()
+                 if ln.startswith('trainingjob_straggler_skew{'
+                                  'job="default/stalljob"')), None)
+            assert line is not None
+            assert float(line.split()[-1]) >= 2.0
+
+            # Acceptance 3: the live step table shows the lagging rank.
+            table = TELEMETRY.job_table(key)
+            rows = {r["replica"]: r for r in table["replicas"]}
+            assert rows["trainer-2"]["stalled"] is True
+            # stall-at-step 10 = ten records reported, last step index 9.
+            assert rows["trainer-2"]["step"] == 9
+            assert rows["trainer-0"]["step"] > rows["trainer-2"]["step"]
+            assert rows["trainer-1"]["step"] < rows["trainer-0"]["step"]
+            assert table["tokens_per_sec"] > 0
+
+            # The Running condition message carries the snapshot.
+            fresh = cs.trainingjobs.get("default", "stalljob")
+            running = next(c for c in fresh.status.conditions
+                           if c.type == TrainingJobPhase.RUNNING)
+            assert wait_for(
+                lambda: "tokens/s" in next(
+                    c for c in cs.trainingjobs.get("default", "stalljob")
+                    .status.conditions
+                    if c.type == TrainingJobPhase.RUNNING).message, 10), \
+                running.message
+        finally:
+            cs.trainingjobs.delete("default", "stalljob")
+            TELEMETRY.forget(key)

@@ -1,0 +1,118 @@
+"""TJA009 status-write-discipline: every job phase/condition mutation goes
+through the status machine in ``controller/status.py``.
+
+The condition list is an append-or-refresh state machine with invariants
+(latest condition authoritative, older ones flipped to False, completed jobs
+frozen) that only ``set_condition``/``update_job_conditions`` maintain.  A
+raw ``job.status.phase = ...`` or ``job.status.conditions.append(...)`` at a
+call site bypasses the completed-job guard and the condition flip, producing
+status histories no consumer can interpret.  Flagged shapes:
+
+1. assignment to ``<job>.status.phase`` or ``<job>.status.conditions``; and
+2. ``<job>.status.conditions.append(...)`` / ``.extend`` / ``.insert``.
+
+A receiver participates when the root of the attribute chain is a name
+containing ``job`` (``job``, ``fresh_job``, ``trainingjob``...) or is the
+bare ``status`` object itself (the pass-the-status-subobject idiom used by
+the status helpers).  Pod/node status writes (``pod.status.phase = ...`` in
+the runtimes) are a different, unguarded API and are not flagged.
+
+The implementing helpers themselves -- ``set_condition``,
+``update_job_conditions`` and ``new_condition`` in ``controller/status.py``
+-- are exempt: they ARE the discipline.  Scope is operator code only
+(``trainingjob_operator_tpu/``); tests construct status fixtures directly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+
+#: Attribute names on ``.status`` whose mutation is the state machine's job.
+_GUARDED_FIELDS = ("phase", "conditions")
+
+#: List-mutating methods on ``.status.conditions``.
+_MUTATORS = ("append", "extend", "insert")
+
+#: (path suffix, function names) exempt because they implement the machine.
+_EXEMPT = ("trainingjob_operator_tpu/controller/status.py",
+           ("set_condition", "update_job_conditions", "new_condition"))
+
+
+def _chain_root(node: ast.expr) -> Optional[ast.Name]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def _is_job_status(node: ast.expr) -> bool:
+    """True for ``<job-ish>.status`` or the bare ``status`` name."""
+    if isinstance(node, ast.Name):
+        return node.id == "status"
+    if isinstance(node, ast.Attribute) and node.attr == "status":
+        root = _chain_root(node)
+        return root is not None and "job" in root.id.lower()
+    return False
+
+
+def _guarded_target(node: ast.expr) -> Optional[str]:
+    """'phase' / 'conditions' when ``node`` is a guarded status attribute."""
+    if (isinstance(node, ast.Attribute) and node.attr in _GUARDED_FIELDS
+            and _is_job_status(node.value)):
+        return node.attr
+    return None
+
+
+def _exempt_lines(ctx: FileContext) -> Set[Tuple[int, int]]:
+    suffix, names = _EXEMPT
+    if not ctx.path.endswith(suffix):
+        return set()
+    spans: Set[Tuple[int, int]] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            spans.add((node.lineno, max(getattr(node, "end_lineno", node.lineno),
+                                        node.lineno)))
+    return spans
+
+
+@register("TJA009", "status-write-discipline")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None or not ctx.path.startswith("trainingjob_operator_tpu/"):
+        return []
+    if ".status." not in ctx.source and "status.phase" not in ctx.source:
+        return []
+    exempt = _exempt_lines(ctx)
+
+    def exempted(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in exempt)
+
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        if exempted(node.lineno):
+            return
+        findings.append(Finding(
+            "TJA009", "status-write-discipline", ctx.path, node.lineno,
+            node.col_offset, ERROR,
+            f"direct {what} bypasses the status machine; route the change "
+            "through update_job_conditions/set_condition "
+            "(controller/status.py) so the completed-job guard and "
+            "condition-flip invariants hold"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                field = _guarded_target(target)
+                if field:
+                    flag(target, f"write to job .status.{field}")
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _MUTATORS
+              and _guarded_target(node.func.value) == "conditions"):
+            flag(node, f".status.conditions.{node.func.attr}() call")
+    return findings

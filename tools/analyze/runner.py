@@ -44,7 +44,8 @@ def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
         broad_except, constant_drift, event_reasons, lock_discipline,
-        orphaned_thread, py_compat, reconcile_purity, tracer_safety,
+        orphaned_thread, py_compat, reconcile_purity, status_discipline,
+        tracer_safety,
     )
 
 

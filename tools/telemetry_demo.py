@@ -1,0 +1,119 @@
+"""Run one simulated TrainingJob with a deliberate straggler + stall and
+print the live telemetry.
+
+The ``make telemetry-demo`` driver: in-process sim cluster (no subprocesses,
+no JAX), one 4-replica job whose pods synthesize per-step telemetry records
+(sim.py step annotations).  Rank 2 runs 4x slower than its peers (straggler)
+and rank 3 freezes at step 25 (stall).  The demo waits for the watchdog to
+fire ``StepStalled``, then prints the per-replica step table -- the same
+rendering ``/debug/steps?job=...&format=text`` serves -- plus the straggler
+skew and the recorded events.
+
+Usage::
+
+    python -m tools.telemetry_demo [--seconds 3.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("telemetry-demo")
+    parser.add_argument("--seconds", type=float, default=3.0,
+                        help="How long to let the simulated job train.")
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="Give up if StepStalled has not fired by then.")
+    args = parser.parse_args(argv)
+
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.api.types import ReplicaSpec, TPUTrainingJob
+    from trainingjob_operator_tpu.client.clientset import Clientset
+    from trainingjob_operator_tpu.cmd.options import OperatorOptions
+    from trainingjob_operator_tpu.controller.controller import (
+        TrainingJobController,
+    )
+    from trainingjob_operator_tpu.core.objects import (
+        Container,
+        ContainerPort,
+        ObjectMeta,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
+    from trainingjob_operator_tpu.runtime.sim import (
+        PEAK_FLOPS_ANNOTATION,
+        FLOPS_PER_STEP_ANNOTATION,
+        RUN_SECONDS_ANNOTATION,
+        STALL_AT_STEP_ANNOTATION,
+        STALL_RANK_ANNOTATION,
+        STEP_MS_ANNOTATION,
+        STRAGGLER_FACTOR_ANNOTATION,
+        STRAGGLER_RANK_ANNOTATION,
+        TOKENS_PER_STEP_ANNOTATION,
+        SimRuntime,
+    )
+
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.add_node("sim-0")
+    sim.add_node("sim-1")
+    sim.start()
+    tc.run(workers=2)
+    job_key = "default/telemetry-demo"
+    try:
+        job = TPUTrainingJob(metadata=ObjectMeta(name="telemetry-demo",
+                                                 namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=4,
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(annotations={
+                    RUN_SECONDS_ANNOTATION: str(args.seconds + args.timeout),
+                    STEP_MS_ANNOTATION: "20",
+                    TOKENS_PER_STEP_ANNOTATION: "8192",
+                    FLOPS_PER_STEP_ANNOTATION: "4e12",
+                    PEAK_FLOPS_ANNOTATION: "4e14",
+                    STRAGGLER_RANK_ANNOTATION: "2",
+                    STRAGGLER_FACTOR_ANNOTATION: "4.0",
+                    STALL_RANK_ANNOTATION: "3",
+                    STALL_AT_STEP_ANNOTATION: "25",
+                }),
+                spec=PodSpec(containers=[
+                    Container(name="aitj-main",
+                              ports=[ContainerPort(name="aitj-7777",
+                                                   container_port=7777)])])))
+        cs.trainingjobs.create(job)
+
+        def stalled_event():
+            return [ev for ev in cs.events.list(None)
+                    if ev.reason == constants.STEP_STALLED_REASON]
+
+        deadline = time.time() + args.timeout
+        time.sleep(args.seconds)
+        while time.time() < deadline and not stalled_event():
+            time.sleep(0.1)
+        events = stalled_event()
+        if not events:
+            print(f"StepStalled did not fire within {args.timeout}s",
+                  file=sys.stderr)
+            return 1
+
+        print(TELEMETRY.render_table(job_key))
+        for ev in events:
+            print(f"event {ev.reason}: {ev.message}")
+        skew = TELEMETRY.straggler_skew(job_key, "trainer")
+        line = TELEMETRY.status_line(job_key)
+        print(f"status: {line}")
+        print(f"straggler skew (slowest/median): {skew:.2f}x")
+        return 0
+    finally:
+        tc.stop()
+        sim.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

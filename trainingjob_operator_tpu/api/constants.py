@@ -85,6 +85,20 @@ JAX_PLATFORM_ENV = "TRAININGJOB_JAX_PLATFORM"
 # runtime, and train loop (obs/trace.py).  Absent -> workload tracing is a
 # no-op fast path.
 TRACE_CONTEXT_ENV = "TRAININGJOB_TRACE_CONTEXT"
+# Telemetry sink address ("host:port"), injected rendezvous-style like the
+# trace context: when set, the workload's StepProfiler pushes one JSON line
+# per completed step (obs/telemetry.py wire protocol) back to the runtime's
+# controller-side aggregator.  Absent -> per-step telemetry is a no-op.
+TELEMETRY_ADDR_ENV = "TRAININGJOB_TELEMETRY_ADDR"
+# MFU accounting overrides (obs/telemetry.py): model FLOPs per optimizer
+# step, and the aggregate peak FLOP/s of the chips the replica drives.  Both
+# are normally computed (workload config / spec.tpu topology) -- the env
+# vars exist so a template can pin the numbers for odd models.
+MODEL_FLOPS_ENV = "TRAININGJOB_MODEL_FLOPS_PER_STEP"
+PEAK_FLOPS_ENV = "TRAININGJOB_PEAK_FLOPS"
+# "1" -> workload processes emit structured JSON log lines (obs/logs.py),
+# mirroring the operator's --log-json; step records then carry trace ids.
+LOG_JSON_ENV = "TRAININGJOB_LOG_JSON"
 # Directory the workload writes its finished trace into on shutdown
 # (Chrome trace_event JSON, one file per process); unset -> no export.
 TRACE_DIR_ENV = "TRAININGJOB_TRACE_DIR"
@@ -127,6 +141,14 @@ PREEMPTED_REASON = "TrainingJobPreempted"
 NODE_FAIL_REASON = "TrainingJobNodeFail"
 SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
 
+# Telemetry-plane reasons (obs/telemetry.py watchdog): a replica's step
+# counter stopped advancing for N x its median step time / started moving
+# again.  Events, not phase transitions -- a stalled replica is still
+# Running as far as the kubelet knows; that is exactly why pod phase alone
+# cannot see it.
+STEP_STALLED_REASON = "StepStalled"
+STEP_RESUMED_REASON = "StepResumed"
+
 # Action-trail reasons (previously inline literals at call sites).
 VALIDATION_FAILED_REASON = "ValidationFailed"
 SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
@@ -149,6 +171,8 @@ EVENT_REASONS = frozenset((
     PREEMPTED_REASON,
     NODE_FAIL_REASON,
     SCALING_REASON,
+    STEP_STALLED_REASON,
+    STEP_RESUMED_REASON,
     VALIDATION_FAILED_REASON,
     SUCCESSFUL_CREATE_POD_REASON,
     SUCCESSFUL_DELETE_POD_REASON,

@@ -66,8 +66,9 @@ def main(argv: Optional[list] = None) -> int:
                         help="Virtual node count for sim/localproc backends.")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="Serve /metrics, /metrics.json, /healthz, "
-                             "/readyz, /debug/threads, /debug/traces and "
-                             "/debug/events on this port (0 = disabled).")
+                             "/readyz, /debug/threads, /debug/traces, "
+                             "/debug/events and /debug/steps on this port "
+                             "(0 = disabled).")
     parser.add_argument("--log-json", action="store_true",
                         help="Emit structured JSON log lines (one object per "
                              "line) instead of text.")
@@ -82,9 +83,15 @@ def main(argv: Optional[list] = None) -> int:
     level = (logging.DEBUG if args.verbose >= 2 else
              logging.INFO if args.verbose == 1 else logging.WARNING)
     if args.log_json:
+        import os
+
+        from trainingjob_operator_tpu.api import constants
         from trainingjob_operator_tpu.obs.logs import configure_logging
 
         configure_logging(json_output=True, level=level)
+        # Propagate to workload subprocesses (localproc backend) so their
+        # step records come out as structured JSON too.
+        os.environ[constants.LOG_JSON_ENV] = "1"
     else:
         logging.basicConfig(
             level=level,
@@ -96,13 +103,14 @@ def main(argv: Optional[list] = None) -> int:
 
     metrics_server = None
     if args.metrics_port:
+        from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
         from trainingjob_operator_tpu.obs.trace import TRACER
         from trainingjob_operator_tpu.utils.metrics import serve_metrics
 
         metrics_server = serve_metrics(
             args.metrics_port, tracer=TRACER,
             events_fn=lambda: clientset.events.list(None),
-            ready_fn=controller.ready)
+            ready_fn=controller.ready, telemetry=TELEMETRY)
         print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():

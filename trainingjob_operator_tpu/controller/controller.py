@@ -37,12 +37,14 @@ from trainingjob_operator_tpu.client.workqueue import RateLimitingQueue
 from trainingjob_operator_tpu.cmd.options import OperatorOptions
 from trainingjob_operator_tpu.controller.control import PodControl, ServiceControl
 from trainingjob_operator_tpu.controller.garbage_collection import GarbageCollector
-from trainingjob_operator_tpu.controller.naming import job_selector
+from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
+from trainingjob_operator_tpu.controller.naming import effective_replicas, job_selector
 from trainingjob_operator_tpu.controller.pod import PodReconciler
 from trainingjob_operator_tpu.controller.service import ServiceReconciler
 from trainingjob_operator_tpu.controller.status import StatusManager, update_job_conditions
 from trainingjob_operator_tpu.core.objects import Node, OwnerReference, Pod, Service
 from trainingjob_operator_tpu.obs.goodput import GOODPUT
+from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, peak_flops_for_accelerator
 from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
@@ -159,6 +161,9 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                            lambda: float(len(self.work_queue)))
         self.metrics.gauge("trainingjob_jobs",
                            lambda: float(len(self.trainingjob_lister.list(None))))
+        # Telemetry watchdog findings (StepStalled/StepResumed) become job
+        # events and a reconcile kick so the Running message refreshes.
+        TELEMETRY.set_event_sink(self._telemetry_event)
         for i in range(n):
             th = threading.Thread(target=self._worker, daemon=True,
                                   name=f"trainingjob-worker-{i}")
@@ -185,6 +190,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     def stop(self) -> None:
         self.metrics.remove_gauge("trainingjob_workqueue_depth")
         self.metrics.remove_gauge("trainingjob_jobs")
+        TELEMETRY.set_event_sink(None)
         self._ready.clear()
         self._stop.set()
         if self._gc is not None:
@@ -192,6 +198,20 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.work_queue.shut_down()
         for th in self._workers:
             th.join(timeout=2)
+
+    def _telemetry_event(self, key: str, reason: str, message: str) -> None:
+        """Telemetry watchdog callback (runs on sink/runtime threads): record
+        the finding as a job event and wake the reconciler so the Running
+        condition message picks up the new snapshot."""
+        namespace, name = split_meta_namespace_key(key)
+        job = self.trainingjob_lister.try_get(namespace, name)
+        if job is None:
+            return
+        etype = (EventRecorder.WARNING
+                 if reason == constants.STEP_STALLED_REASON
+                 else EventRecorder.NORMAL)
+        self.recorder.event(job, etype, reason, message)
+        self.enqueue_job(job, rate_limited=True)
 
     def _resync_loop(self) -> None:
         """Periodic full re-enqueue (reference: informer resync, 10 s)."""
@@ -236,6 +256,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                 if job is None:
                     self.expectations.delete_expectations(key)
                     GOODPUT.forget(key)
+                    TELEMETRY.forget(key)
                     root.set_attribute("outcome", "gone")
                     return True
 
@@ -289,6 +310,25 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     # -- reconcile driver (reference: reconcileTrainingJobs,
     #    controller.go:314-388) ----------------------------------------------
 
+    def _register_peak_flops(self, job: TPUTrainingJob, job_key: str) -> None:
+        """Derive the job's aggregate peak FLOP/s from its TPU specs so the
+        aggregator can turn achieved FLOPs into an MFU ratio.  Replica specs
+        without a TPU (or with an unknown accelerator) contribute nothing;
+        workloads may still self-report a peak via TRAININGJOB_PEAK_FLOPS."""
+        peak = 0.0
+        for rtype, spec in job.spec.replica_specs.items():
+            if spec.tpu is None:
+                continue
+            try:
+                shape = resolve_slice_shape(spec.tpu)
+            except ValueError:
+                continue
+            per_chip = peak_flops_for_accelerator(shape.accelerator)
+            peak += (effective_replicas(job, rtype)
+                     * shape.chips_per_host * per_chip)
+        if peak > 0.0:
+            TELEMETRY.set_peak_flops(job_key, peak)
+
     def reconcile_trainingjobs(self, job: TPUTrainingJob) -> None:
         old_status = job.deepcopy().status
         old_annotations = dict(job.metadata.annotations)
@@ -297,6 +337,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         services = self.get_services_by_job(job, selector)
 
         job_key = meta_namespace_key(job)
+        self._register_peak_flops(job, job_key)
         ending_phases: Dict[str, str] = {}
         aggregation_msg: List[str] = []
         if (not job.status.restart_replica_name
@@ -318,6 +359,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                     job.status.restart_replica_name = rtype
                     GOODPUT.on_interruption(
                         job_key, job.spec.replica_specs[rtype].restart_scope)
+                    TELEMETRY.on_interruption(job_key)
                     break
                 if ending_phase == TrainingJobPhase.SCALING:
                     # Elastic resize: same two-phase drain, scaling marker.
@@ -326,6 +368,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         constants.SCALING_REASON, msg)
                     job.status.scaling_replica_name = rtype
                     GOODPUT.on_interruption(job_key, "scale")
+                    TELEMETRY.on_interruption(job_key)
                     break
                 if ending_phase:
                     ending_phases[rtype] = ending_phase

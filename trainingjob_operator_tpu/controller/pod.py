@@ -48,6 +48,7 @@ from trainingjob_operator_tpu.core.objects import (
     PodConditionType,
     PodPhase,
 )
+from trainingjob_operator_tpu.obs.telemetry import sink_address
 from trainingjob_operator_tpu.obs.trace import current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
@@ -837,6 +838,13 @@ class PodReconciler:
         trace_ctx = current_context()
         if trace_ctx:
             hosts_env.append(EnvVar(constants.TRACE_CONTEXT_ENV, trace_ctx))
+        # Telemetry sink address, same rendezvous pattern: the runtime that
+        # will launch this pod published where step records should go
+        # (obs/telemetry.py); absent -> the workload emitter is a no-op.
+        telemetry_addr = sink_address()
+        if telemetry_addr:
+            hosts_env.append(EnvVar(constants.TELEMETRY_ADDR_ENV,
+                                    telemetry_addr))
         hosts_env += self._jax_bootstrap_env(job, rtype, index)
 
         # Template env wins: the operator injects only names the user did not

@@ -41,6 +41,7 @@ from trainingjob_operator_tpu.core.objects import (
     Service,
 )
 from trainingjob_operator_tpu.obs.goodput import GOODPUT
+from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.status")
@@ -265,6 +266,7 @@ class StatusManager:
                     update_job_conditions(job, phase, PHASE_REASON[phase],
                                           f"{msg}; deleted pods")
                     GOODPUT.on_complete(meta_namespace_key(job), now)
+                    TELEMETRY.on_complete(meta_namespace_key(job))
                 else:
                     self.enqueue_job(job, rate_limited=True)
                 return
@@ -297,9 +299,18 @@ class StatusManager:
             if job.status.start_running_time is None:
                 job.status.start_running_time = now
             update_job_conditions(job, TrainingJobPhase.RUNNING,
-                                  constants.RUNNING_REASON, "all pods are running")
+                                  constants.RUNNING_REASON,
+                                  self._running_message(job, now))
             GOODPUT.on_running(meta_namespace_key(job), now,
                                start_time=job.status.start_time)
+        elif is_running and job.status.phase == TrainingJobPhase.RUNNING:
+            # Live throughput snapshot in the Running condition: same
+            # type/status/reason means set_condition refreshes the message in
+            # place (no new condition, no phase churn); the snapshot itself is
+            # cached by the aggregator so write-back churn stays bounded.
+            update_job_conditions(job, TrainingJobPhase.RUNNING,
+                                  constants.RUNNING_REASON,
+                                  self._running_message(job, now))
         if is_running and job.status.scale_up_attempts:
             # A group back at FULL width (maxReplicas when set) resets its own
             # re-expand backoff; groups still below it keep backing off.
@@ -332,6 +343,16 @@ class StatusManager:
             remaining = spec.time_limit - (now - job.status.start_running_time)
             self.enqueue_job(job, delay=max(remaining, 0.0))
 
+    @staticmethod
+    def _running_message(job: TPUTrainingJob, now: float) -> str:
+        """Base Running message plus the latest telemetry snapshot, when the
+        job's replicas have reported any steps."""
+        msg = "all pods are running"
+        snapshot = TELEMETRY.status_line(meta_namespace_key(job), now=now)
+        if snapshot:
+            msg = f"{msg}; {snapshot}"
+        return msg
+
     # -- termination (reference: terminateTrainingJob, status.go:256-283) ----
 
     def terminate_trainingjob(self, job: TPUTrainingJob, pods: List[Pod],
@@ -346,6 +367,7 @@ class StatusManager:
             if job.status.end_time is None:
                 job.status.end_time = time.time()
             GOODPUT.on_complete(meta_namespace_key(job), job.status.end_time)
+            TELEMETRY.on_complete(meta_namespace_key(job))
             return
         job.metadata.annotations[ending_phase] = message
         # The stash is METADATA: on a real apiserver the status-subresource

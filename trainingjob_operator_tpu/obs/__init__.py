@@ -1,16 +1,27 @@
-"""Observability: span tracing, structured logging, goodput accounting.
+"""Observability: span tracing, structured logging, goodput, telemetry.
 
-Dependency-free (stdlib only, like ``tools/analyze``).  Three process-global
+Dependency-free (stdlib only, like ``tools/analyze``).  Process-global
 singletons mirror ``utils.metrics.METRICS``:
 
-- ``TRACER``  -- span tracer with a bounded ring of finished traces;
-- ``GOODPUT`` -- goodput ledger fed by the status machine;
+- ``TRACER``    -- span tracer with a bounded ring of finished traces;
+- ``GOODPUT``   -- goodput ledger fed by the status machine;
+- ``TELEMETRY`` -- per-step replica telemetry aggregator (throughput, MFU,
+  straggler skew, stall watchdog), fed by the runtimes' sinks;
 - structured logging is stateless (``get_logger`` binds context per call).
 
 See docs/OBSERVABILITY.md for the span/metric/event catalogs.
 """
 
 from trainingjob_operator_tpu.obs.goodput import GOODPUT, GoodputTracker
+from trainingjob_operator_tpu.obs.telemetry import (
+    TELEMETRY,
+    TelemetryAggregator,
+    TelemetryEmitter,
+    TelemetrySink,
+    peak_flops_for_accelerator,
+    publish_sink_address,
+    sink_address,
+)
 from trainingjob_operator_tpu.obs.logs import (
     ContextTextFormatter,
     JsonFormatter,
@@ -33,6 +44,13 @@ from trainingjob_operator_tpu.obs.trace import (
 __all__ = [
     "GOODPUT",
     "GoodputTracker",
+    "TELEMETRY",
+    "TelemetryAggregator",
+    "TelemetryEmitter",
+    "TelemetrySink",
+    "peak_flops_for_accelerator",
+    "publish_sink_address",
+    "sink_address",
     "ContextTextFormatter",
     "JsonFormatter",
     "StructuredLogger",

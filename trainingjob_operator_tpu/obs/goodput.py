@@ -37,7 +37,7 @@ DOWNTIME_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
 class _JobState:
     __slots__ = ("first_seen", "running_since", "productive",
                  "downtime_since", "downtime_scope", "first_running",
-                 "completed")
+                 "completed", "step_productive", "steps_seen")
 
     def __init__(self) -> None:
         self.first_seen: Optional[float] = None
@@ -47,6 +47,12 @@ class _JobState:
         self.downtime_scope = ""
         self.first_running = False
         self.completed = False
+        # Step-fed ledger (obs/telemetry.py): per-step wall time pushed by
+        # the workload's pacer replica.  When any step was seen, it replaces
+        # the Running-window approximation -- "productive" then means steps
+        # actually completed, not time spent in phase Running.
+        self.step_productive = 0.0
+        self.steps_seen = 0
 
 
 class GoodputTracker:
@@ -111,6 +117,31 @@ class GoodputTracker:
                 st.downtime_since = now
                 st.downtime_scope = scope
 
+    def record_step(self, key: str, seconds: float,
+                    now: Optional[float] = None) -> None:
+        """One completed optimizer step took ``seconds`` of wall time
+        (pushed from replica telemetry, pacer rank only).  Refines the
+        ledger from condition-transition granularity to per-step goodput:
+        a job whose pods sit Running but stuck contributes nothing."""
+        if seconds <= 0.0:
+            return
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._state_locked(key)
+            if st.completed:
+                return
+            if st.first_seen is None:
+                st.first_seen = now
+            st.step_productive += seconds
+            st.steps_seen += 1
+
+    @staticmethod
+    def _productive_locked(st: _JobState) -> float:
+        """Step-fed ledger when populated, Running-window sum otherwise
+        (callers fold any open running window into ``st.productive``
+        first)."""
+        return st.step_productive if st.steps_seen else st.productive
+
     def on_complete(self, key: str, now: Optional[float] = None) -> None:
         """The job reached a terminal phase: freeze the ledger and publish
         ``trainingjob_goodput_ratio{job=...}``.  Idempotent -- the status
@@ -126,11 +157,12 @@ class GoodputTracker:
                 st.running_since = None
             if st.first_seen is None:
                 return  # never observed a lifecycle; nothing to report
+            productive = self._productive_locked(st)
             wall = now - st.first_seen
             if wall <= 0.0:
-                ratio = 1.0 if st.productive > 0.0 else 0.0
+                ratio = 1.0 if productive > 0.0 else 0.0
             else:
-                ratio = min(max(st.productive / wall, 0.0), 1.0)
+                ratio = min(max(productive / wall, 0.0), 1.0)
             # A pull-gauge closed over the final value: survives until the
             # job is forgotten, so a completed job's ratio stays scrapeable.
             self._metrics.gauge("trainingjob_goodput_ratio",
@@ -153,9 +185,12 @@ class GoodputTracker:
             st = self._jobs.get(key)
             if st is None or st.first_seen is None:
                 return None
-            productive = st.productive
-            if st.running_since is not None:
-                productive += max(now - st.running_since, 0.0)
+            if st.steps_seen:
+                productive = st.step_productive
+            else:
+                productive = st.productive
+                if st.running_since is not None:
+                    productive += max(now - st.running_since, 0.0)
             wall = now - st.first_seen
             return min(max(productive / wall, 0.0), 1.0) if wall > 0 else None
 

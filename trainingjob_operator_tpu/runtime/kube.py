@@ -66,11 +66,23 @@ class KubeRuntime:
     (controller.go:210-234) and (b) start the reflectors that feed the
     informer cache, blocking until the initial LISTs land
     (WaitForCacheSync, controller.go:195).
+
+    Telemetry (stub wiring): pass ``telemetry_port`` to also run the
+    per-step telemetry sink (obs/telemetry.py) bound to 0.0.0.0, and
+    ``telemetry_advertise`` with an address pods can reach (the operator
+    pod's service/DNS name -- in-cluster pods cannot reach the operator's
+    loopback).  The advertised address is what pod.set_env injects as
+    ``TRAININGJOB_TELEMETRY_ADDR``.  Left at 0, no sink runs and workload
+    telemetry stays disabled -- safe default for the stub backend.
     """
 
-    def __init__(self, clientset: Any, apply_crd: bool = True):
+    def __init__(self, clientset: Any, apply_crd: bool = True,
+                 telemetry_port: int = 0, telemetry_advertise: str = ""):
         self._cs = clientset
         self._apply_crd = apply_crd
+        self._telemetry_port = telemetry_port
+        self._telemetry_advertise = telemetry_advertise
+        self._telemetry_sink = None
 
     def start(self) -> None:
         if self._apply_crd:
@@ -80,7 +92,19 @@ class KubeRuntime:
                 logging.getLogger("trainingjob.kube").info(
                     "created CRD %s.%s", constants.KIND_PLURAL,
                     constants.GROUP_NAME)
+        if self._telemetry_port:
+            from trainingjob_operator_tpu.obs.telemetry import TelemetrySink
+
+            # check_interval: no kubelet tick exists on this backend, so the
+            # sink runs the stall watchdog on its own timer.
+            self._telemetry_sink = TelemetrySink(
+                host="0.0.0.0", port=self._telemetry_port,
+                advertise=self._telemetry_advertise,
+                check_interval=1.0).start()
         self._cs.start(wait_synced=True)
 
     def stop(self) -> None:
+        if self._telemetry_sink is not None:
+            self._telemetry_sink.stop()
+            self._telemetry_sink = None
         self._cs.stop()

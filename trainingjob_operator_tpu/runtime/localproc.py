@@ -43,6 +43,7 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, TelemetrySink
 from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
@@ -105,6 +106,7 @@ class LocalProcRuntime(PodStateRuntime):
         #: what the controller's elastic starvation shrink keys on, letting
         #: node loss exercise the true resize path with real processes.
         self._pods_per_node = pods_per_node
+        self._telemetry_sink: Optional[TelemetrySink] = None
 
     def _new_state(self, uid: str) -> _Proc:
         return _Proc(uid=uid)
@@ -128,10 +130,18 @@ class LocalProcRuntime(PodStateRuntime):
                 self._cs.nodes.create(make_ready_node(name))
             except AlreadyExistsError:
                 pass  # node survives from a previous runtime on this tracker
+        # Per-step telemetry sink: loopback, ephemeral port.  Starting it
+        # here (before the controller creates any pod) publishes the address
+        # pod.set_env injects, so worker subprocesses push step records
+        # straight back into the in-process aggregator.
+        self._telemetry_sink = TelemetrySink().start()
         super().start()
 
     def stop(self) -> None:
         super().stop()
+        if self._telemetry_sink is not None:
+            self._telemetry_sink.stop()
+            self._telemetry_sink = None
         with self._lock:
             procs = list(self._state.values())
         for proc in procs:
@@ -218,6 +228,10 @@ class LocalProcRuntime(PodStateRuntime):
 
     def _reconcile_once(self) -> None:
         now = time.time()
+        # The kubelet tick doubles as the step-progress watchdog tick: a
+        # worker process that is alive but no longer stepping is invisible
+        # to poll()-based liveness below.
+        TELEMETRY.check_stalls(now)
         ready_nodes = [n.name for n in self._cs.nodes.list() if n.is_ready()]
         pods = self._cs.pods.list()
 

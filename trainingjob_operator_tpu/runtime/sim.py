@@ -36,6 +36,7 @@ from trainingjob_operator_tpu.core.objects import (
     make_ready_node,
     set_node_readiness,
 )
+from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
 from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.runtime.base import PodStateRuntime
 
@@ -45,6 +46,26 @@ log = logging.getLogger("trainingjob.sim")
 RUN_SECONDS_ANNOTATION = "sim.tpu.trainingjob.dev/run-seconds"
 EXIT_CODE_ANNOTATION = "sim.tpu.trainingjob.dev/exit-code"
 START_DELAY_ANNOTATION = "sim.tpu.trainingjob.dev/start-delay"
+#: Telemetry synthesis: a Running pod with step-ms set "trains", reporting
+#: one step record per step-ms of wall time into the TELEMETRY aggregator
+#: (the sim's substitute for the workload-side TelemetryEmitter; same
+#: records, no socket).  The rank-targeted knobs live on the shared pod
+#: template and select on the pod's TrainingJobReplicaIndex label:
+#: straggler-rank runs straggler-factor x slower; stall-rank stops
+#: advancing at stall-at-step (and its pod stays Running -- exactly the
+#: "up but stuck" state the stall watchdog exists to catch).
+STEP_MS_ANNOTATION = "sim.tpu.trainingjob.dev/step-ms"
+TOKENS_PER_STEP_ANNOTATION = "sim.tpu.trainingjob.dev/tokens-per-step"
+FLOPS_PER_STEP_ANNOTATION = "sim.tpu.trainingjob.dev/flops-per-step"
+PEAK_FLOPS_ANNOTATION = "sim.tpu.trainingjob.dev/peak-flops"
+STRAGGLER_RANK_ANNOTATION = "sim.tpu.trainingjob.dev/straggler-rank"
+STRAGGLER_FACTOR_ANNOTATION = "sim.tpu.trainingjob.dev/straggler-factor"
+STALL_RANK_ANNOTATION = "sim.tpu.trainingjob.dev/stall-rank"
+STALL_AT_STEP_ANNOTATION = "sim.tpu.trainingjob.dev/stall-at-step"
+
+#: Step records synthesized per pod per tick, at most (a pod "catching up"
+#: after a long scheduler pause must not flood the aggregator's window).
+_MAX_STEPS_PER_TICK = 200
 
 
 @dataclass
@@ -56,6 +77,7 @@ class _PodRuntime:
     exit_code: int = 0
     terminating_since: Optional[float] = None
     frozen_on: str = ""  # node whose failure froze this pod's reports
+    steps_reported: int = 0
 
 
 class SimRuntime(PodStateRuntime):
@@ -206,8 +228,11 @@ class SimRuntime(PodStateRuntime):
                                 rt.exit_code = int(pod.metadata.annotations.get(
                                     EXIT_CODE_ANNOTATION, "0"))
 
-            elif (pod.status.phase == PodPhase.RUNNING
-                  and rt.will_exit_at is not None and now >= rt.will_exit_at):
+            elif pod.status.phase == PodPhase.RUNNING and rt.frozen_on == "":
+                self._synthesize_steps(pod, rt, now)
+
+            if (pod.status.phase == PodPhase.RUNNING
+                    and rt.will_exit_at is not None and now >= rt.will_exit_at):
                 code = rt.exit_code
                 with TRACER.span("sim.exit",
                                  pod=f"{pod.namespace}/{pod.name}",
@@ -226,6 +251,57 @@ class SimRuntime(PodStateRuntime):
                         # Only clear after a successful write -- a conflict
                         # retries against a fresh snapshot next tick.
                         rt.will_exit_at = None
+
+        # The kubelet tick doubles as the step-progress watchdog tick, same
+        # as the localproc runtime: a stalled pod above is still Running.
+        TELEMETRY.check_stalls(now)
+
+    def _synthesize_steps(self, pod: Pod, rt: _PodRuntime, now: float) -> None:
+        """Advance the pod's simulated step counter and push the records a
+        real workload's TelemetryEmitter would have pushed."""
+        ann = pod.metadata.annotations
+        step_ms_raw = ann.get(STEP_MS_ANNOTATION)
+        if not step_ms_raw or rt.started_at == 0.0:
+            return
+        try:
+            step_ms = float(step_ms_raw)
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+            straggler_rank = int(ann.get(STRAGGLER_RANK_ANNOTATION, "-1"))
+            if rank == straggler_rank:
+                step_ms *= float(ann.get(STRAGGLER_FACTOR_ANNOTATION, "3.0"))
+            target = int((now - rt.started_at) * 1000.0 / step_ms)
+            stall_rank = int(ann.get(STALL_RANK_ANNOTATION, "-1"))
+            if rank == stall_rank:
+                target = min(target, int(ann.get(STALL_AT_STEP_ANNOTATION,
+                                                 "0")))
+            tokens = float(ann.get(TOKENS_PER_STEP_ANNOTATION, "0"))
+            flops = float(ann.get(FLOPS_PER_STEP_ANNOTATION, "0"))
+            peak = float(ann.get(PEAK_FLOPS_ANNOTATION, "0"))
+        except ValueError:
+            return  # malformed script annotations: no telemetry
+        if step_ms <= 0.0:
+            return
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL, "")
+        if not job_name:
+            return
+        job_key = f"{pod.namespace}/{job_name}"
+        rtype = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL, "worker")
+        budget = _MAX_STEPS_PER_TICK
+        while rt.steps_reported < target and budget > 0:
+            record = {
+                "v": 1, "job": job_key, "rtype": rtype, "rank": rank,
+                "step": rt.steps_reported, "ms": step_ms, "ts": now,
+            }
+            if tokens:
+                record["tokens"] = tokens
+            if flops:
+                record["flops"] = flops
+            if peak:
+                record["peak_flops"] = peak
+            TELEMETRY.ingest(record, now=now)
+            rt.steps_reported += 1
+            budget -= 1
 
     def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
         placements = []

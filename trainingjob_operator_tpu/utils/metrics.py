@@ -218,18 +218,38 @@ def _render_events(events_fn, params: Dict[str, List[str]]) -> str:
                        "events": [ev.to_dict() for ev in events]}, indent=2)
 
 
+def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/steps: per-replica live step
+    table for ?job=<namespace/name> (text with ?format=text), or the list of
+    jobs with telemetry when no job is given.  Unknown job -> 404."""
+    job = params.get("job", [""])[0]
+    if not job:
+        jobs = telemetry.jobs()
+        return 200, "application/json", json.dumps(
+            {"count": len(jobs), "jobs": jobs}, indent=2)
+    table = telemetry.job_table(job)
+    if table is None:
+        return 404, "text/plain", ""
+    if params.get("format", [""])[0] == "text":
+        return 200, "text/plain", telemetry.render_table(job)
+    return 200, "application/json", json.dumps(table, indent=2)
+
+
 def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                   host: str = "127.0.0.1", tracer=None, events_fn=None,
-                  ready_fn: Optional[Callable[[], bool]] = None):
+                  ready_fn: Optional[Callable[[], bool]] = None,
+                  telemetry=None):
     """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
-    /debug/threads, /debug/traces and /debug/events on a daemon thread;
-    ``.shutdown()`` stops it and closes the socket.
+    /debug/threads, /debug/traces, /debug/events and /debug/steps on a
+    daemon thread; ``.shutdown()`` stops it and closes the socket.
 
     - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
     - ``events_fn``: zero-arg callable returning Event objects (e.g.
       ``lambda: clientset.events.list(None)``); enables /debug/events.
     - ``ready_fn``: informer-synced gate for /readyz -- 503 until it returns
       truthy.  Omitted -> always ready (no controller to wait for).
+    - ``telemetry``: an obs.telemetry.TelemetryAggregator; enables
+      /debug/steps (404 without).
 
     Binds loopback by default -- /debug/threads exposes live stacks, the
     pprof convention (expose beyond localhost only deliberately via
@@ -267,6 +287,10 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
             elif path == "/debug/events" and events_fn is not None:
                 ctype, body = "application/json", _render_events(events_fn,
                                                                 params)
+            elif path == "/debug/steps" and telemetry is not None:
+                status, ctype, body = _render_steps(telemetry, params)
+                if status == 404:
+                    body = None
             if body is None:
                 self.send_response(404)
                 self.end_headers()

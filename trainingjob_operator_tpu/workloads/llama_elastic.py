@@ -169,10 +169,17 @@ def main() -> int:
           f"setup_s={t_setup - t_init:.2f} "
           f"restore_s={t_restore - t_setup:.2f}", flush=True)
 
+    # Telemetry accounting: tokens per optimizer step, and the standard
+    # dense-transformer estimate of 6 * params * tokens FLOPs per step
+    # (fwd 2x + bwd 4x) -- feeds the controller-side MFU gauge.
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    tokens_per_step = global_batch * seq
     params, opt_state, loss, t_start = train.run_elastic_loop(
         step_fn=step_fn, batch_at=batch_at, state=state, params=params,
         opt_state=opt_state, steps=steps, start_step=start_step,
-        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every)
+        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every,
+        units_per_step=tokens_per_step,
+        flops_per_step=6.0 * n_params * tokens_per_step)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

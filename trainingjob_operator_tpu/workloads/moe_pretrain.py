@@ -115,10 +115,18 @@ def main() -> int:
         print(f"resumed at step {start_step} (width "
               f"{rdv.elastic_replicas})", flush=True)
 
+    # Telemetry accounting.  The 6 * params * tokens FLOPs estimate is an
+    # upper bound for an MoE (only top-k experts are active per token);
+    # templates wanting an exact figure override via
+    # TRAININGJOB_MODEL_FLOPS_PER_STEP.
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    tokens_per_step = global_batch * seq
     params, opt_state, loss, t_start = train.run_elastic_loop(
         step_fn=step_fn, batch_at=batch_at, state=state, params=params,
         opt_state=opt_state, steps=steps, start_step=start_step,
-        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every)
+        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every,
+        units_per_step=tokens_per_step,
+        flops_per_step=6.0 * n_params * tokens_per_step)
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "

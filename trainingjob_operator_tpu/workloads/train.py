@@ -17,14 +17,30 @@ the global array, so elastic resume needs no gather/re-shard choreography.
 
 from __future__ import annotations
 
+import logging
 import os
 import signal
 import time
 from typing import Any, Callable, Dict, Optional
 
 from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.logs import configure_logging, get_logger
+from trainingjob_operator_tpu.obs.telemetry import TelemetryEmitter
 from trainingjob_operator_tpu.obs.trace import tracer_from_env
 from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
+
+
+def _ensure_workload_logging() -> None:
+    """Workloads run as bare subprocesses: without a handler, stdlib logging
+    drops INFO records on the floor.  Install the structured handler once
+    (JSON when the operator propagated --log-json via TRAININGJOB_LOG_JSON),
+    so step records reach the pod log -- with trace/span ids attached."""
+    root = logging.getLogger()
+    if root.handlers:
+        return
+    configure_logging(
+        json_output=os.environ.get(constants.LOG_JSON_ENV) == "1",
+        level=logging.INFO)
 
 
 class CheckpointState:
@@ -219,15 +235,20 @@ class GracefulShutdown:
 
 
 class StepProfiler:
-    """Env-gated workload-side profiling (SURVEY.md §5.1).
+    """Env-gated workload-side profiling + per-step telemetry (SURVEY.md §5.1).
 
     ``TRAININGJOB_PROFILE_DIR=/path`` + ``TRAININGJOB_PROFILE_STEPS=a:b``
     wraps steps [a, b) in ``jax.profiler.start_trace/stop_trace`` (view with
     tensorboard/xprof); ``TRAININGJOB_STEP_TIMES=1`` logs per-step wall time
     so a throughput regression is diagnosable from the log, not one scalar.
+    When the operator injected ``TRAININGJOB_TELEMETRY_ADDR`` (pod.set_env),
+    every completed step is additionally pushed to the controller-side
+    aggregator (obs/telemetry.py) -- step index, wall ms, tokens, loss --
+    feeding throughput/MFU/straggler/stall accounting.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, units_per_step: float = 0.0,
+                 flops_per_step: float = 0.0, unit: str = "tokens") -> None:
         self.trace_dir = os.environ.get(constants.PROFILE_DIR_ENV, "")
         rng = os.environ.get(constants.PROFILE_STEPS_ENV, "2:5")
         try:
@@ -236,6 +257,12 @@ class StepProfiler:
         except ValueError:
             self.start_step, self.stop_step = 2, 5
         self.step_times = os.environ.get(constants.STEP_TIMES_ENV) == "1"
+        self.emitter = TelemetryEmitter(units_per_step=units_per_step,
+                                        flops_per_step=flops_per_step,
+                                        unit=unit)
+        if self.step_times or self.emitter.enabled:
+            _ensure_workload_logging()
+        self._log = get_logger("trainingjob.workload.steps")
         self._tracing = False
         self._t0 = 0.0
 
@@ -247,13 +274,15 @@ class StepProfiler:
             self._tracing = True
         self._t0 = time.perf_counter()
 
-    def step_end(self, i: int, sync: Any = None) -> None:
+    def step_end(self, i: int, sync: Any = None,
+                 loss: Optional[float] = None) -> None:
         """``sync``: a device value to fence on (its device-to-host read is
         the only reliable completion barrier -- ``block_until_ready`` can
         return early on the axon runtime; see
         tools/repro_block_until_ready.py)."""
         stopping = self._tracing and i + 1 >= self.stop_step
-        if sync is not None and (self.step_times or stopping):
+        if sync is not None and (self.step_times or stopping
+                                 or self.emitter.enabled):
             import jax
 
             jax.device_get(sync)  # device-to-host: real fence
@@ -264,9 +293,21 @@ class StepProfiler:
             self._tracing = False
             print(f"profiler trace written to {self.trace_dir} "
                   f"(steps {self.start_step}:{self.stop_step})", flush=True)
+        ms = (time.perf_counter() - self._t0) * 1e3
         if self.step_times:
-            print(f"step_time step={i} ms="
-                  f"{(time.perf_counter() - self._t0) * 1e3:.2f}", flush=True)
+            self._log.info("step_time step=%d ms=%.2f", i, ms)
+        if self.emitter.enabled:
+            self.emitter.emit(i, ms, loss=_scalar(loss))
+
+    def log_throughput(self, prefix: str, steps_done: int,
+                       units_per_step: float, seconds: float,
+                       unit: str = "tokens") -> None:
+        """Structured throughput summary (carries trace/span ids under
+        --log-json), replacing the old bare ``print(throughput_line(...))``
+        idiom."""
+        _ensure_workload_logging()
+        self._log.info("%s", throughput_line(prefix, steps_done,
+                                             units_per_step, seconds, unit))
 
     def close(self) -> None:
         if self._tracing:
@@ -274,6 +315,20 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
+        self.emitter.close()
+
+
+def _scalar(value: Any) -> Optional[float]:
+    """Device scalar -> float, best-effort (telemetry must never crash a
+    step on a weird dtype or an aborted transfer)."""
+    if value is None:
+        return None
+    try:
+        return float(value)
+    # analyzer: allow[broad-except]: jax raises backend-specific errors on
+    # device-to-host transfer; a loss we cannot read is just omitted.
+    except Exception:
+        return None
 
 
 #: Substrings identifying transport/collective failures caused by a LOST
@@ -359,7 +414,9 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                      state: "CheckpointState", params: Any, opt_state: Any,
                      steps: int, start_step: int, ckpt_every: int,
                      eval_fn: Optional[Callable] = None,
-                     eval_every: int = 0):
+                     eval_every: int = 0,
+                     units_per_step: float = 0.0,
+                     flops_per_step: float = 0.0):
     """The shared elastic train loop (llama_elastic / moe_pretrain):
     checkpoint every ``ckpt_every`` steps, print the first post-resume step
     (the elastic-recovery endpoint the bench keys on), honor the SIGTERM
@@ -376,7 +433,8 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
     from trainingjob_operator_tpu.data.loader import Prefetcher
 
     shutdown = GracefulShutdown().install()
-    profiler = StepProfiler()
+    profiler = StepProfiler(units_per_step=units_per_step,
+                            flops_per_step=flops_per_step)
     # Workload half of the trace contract: enabled only when the operator
     # injected TRAININGJOB_TRACE_CONTEXT into the pod env (pod.set_env), so
     # the run span joins the trace of the reconcile that created this pod.
@@ -412,7 +470,7 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                 if start_step > 0:
                     print(f"step {i+1}/{steps} loss {float(loss):.4f} "
                           f"(first after resume)", flush=True)
-            profiler.step_end(i, sync=loss)
+            profiler.step_end(i, sync=loss, loss=loss)
 
             def save(step, wait=False):
                 with tracer.span("train.checkpoint", step=step, wait=wait):
@@ -436,6 +494,10 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
         profiler.close()
         jax.block_until_ready(loss)
         state.finalize()  # commit any in-flight background save before exit
+    if units_per_step and t_start is not None:
+        profiler.log_throughput(
+            "train_done", max(steps - start_step - 1, 1), units_per_step,
+            max(time.time() - t_start, 1e-9))
     _maybe_export_trace(tracer)
     return params, opt_state, loss, t_start
 
