@@ -7,11 +7,13 @@ VDEV ?= 8
 
 .PHONY: lint test dryrun bench install ci trace-demo telemetry-demo
 
-# AST-based operator lint (docs/STATIC_ANALYSIS.md): milliseconds, runs
-# before the tests so a grammar/race/contract bug fails fast with a
-# file:line annotation instead of 5 modules of collection errors.
+# AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
+# so a grammar/race/contract bug fails fast with a file:line annotation
+# instead of 5 modules of collection errors. --max-seconds 2 is a wall-clock
+# budget: the whole-program graph must stay cheap, and a perf regression in
+# it should fail CI, not silently slow every push.
 lint:
-	$(PY) -m tools.analyze trainingjob_operator_tpu/ tools/ tests/ --format=github
+	$(PY) -m tools.analyze trainingjob_operator_tpu/ tools/ tests/ --format=github --max-seconds 2
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -33,6 +33,20 @@ def analyze(tmp_path, rel, source, only=None):
     return run_checks([str(path)], root=str(tmp_path), only=only)
 
 
+def analyze_tree(tmp_path, files, only=None):
+    """Write a {rel: source} tree under tmp_path and analyze the whole dir.
+
+    Whole-program fixtures go through here: running over the directory (not
+    one file) makes ``ProjectContext.covers_package`` hold for the fixture's
+    miniature ``trainingjob_operator_tpu/`` package, so the absence-based
+    passes (TJA011/TJA012/TJA014) actually assert."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return run_checks([str(tmp_path)], root=str(tmp_path), only=only)
+
+
 def ids(findings):
     return sorted({f.check_id for f in findings})
 
@@ -640,6 +654,400 @@ class TestStatusWriteDiscipline:
                        src, only=["status-write-discipline"]) == []
 
 
+# -- TJA010 lock-order-cycle -------------------------------------------------
+
+LOCK_CYCLE_SRC = """\
+import threading
+
+
+class Alpha:
+    def __init__(self):
+        self._la = threading.Lock()
+        self.beta = Beta()
+
+    def forward(self):
+        with self._la:
+            self.beta.poke()
+
+    def grab(self):
+        with self._la:
+            pass
+
+
+class Beta:
+    def __init__(self):
+        self._lb = threading.Lock()
+        self.alpha = Alpha()
+
+    def poke(self):
+        with self._lb:
+            pass
+
+    def back(self):
+        with self._lb:
+            self.alpha.grab()
+"""
+
+
+class TestLockOrderCycle:
+    def test_fires_on_two_lock_inversion(self, tmp_path):
+        """Alpha holds la and calls into a lb-acquirer; Beta holds lb and
+        calls (transitively) an la-acquirer: la -> lb -> la."""
+        findings = analyze_tree(
+            tmp_path, {"trainingjob_operator_tpu/plane.py": LOCK_CYCLE_SRC},
+            only=["lock-order-cycle"])
+        assert ids(findings) == ["TJA010"]
+        assert any("cycle" in f.message or "deadlock" in f.message
+                   for f in findings)
+
+    def test_fires_on_self_deadlock_of_plain_lock(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/selfy.py": """\
+                import threading
+
+
+                class Selfy:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                """}, only=["TJA010"])
+        assert ids(findings) == ["TJA010"]
+
+    def test_quiet_on_rlock_reentry(self, tmp_path):
+        """The same shape with an RLock is legal re-entry, not a deadlock."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/selfy.py": """\
+                import threading
+
+
+                class Selfy:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                """}, only=["TJA010"])
+        assert findings == []
+
+    def test_quiet_on_deferred_callback_under_lock(self, tmp_path):
+        """A lambda *registered* under the lock runs later, at call time --
+        its acquisitions must not count as nested-while-held (the telemetry
+        gauge-callback pattern)."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/gauges.py": """\
+                import threading
+
+
+                class Gauges:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cb = None
+
+                    def register(self):
+                        with self._lock:
+                            self._cb = lambda: self.read()
+
+                    def read(self):
+                        with self._lock:
+                            return 1
+                """}, only=["TJA010"])
+        assert findings == []
+
+    def test_quiet_on_consistent_order(self, tmp_path):
+        """la -> lb in every path: an ordering, not a cycle."""
+        src = LOCK_CYCLE_SRC.replace(
+            "    def back(self):\n"
+            "        with self._lb:\n"
+            "            self.alpha.grab()\n",
+            "    def back(self):\n"
+            "        self.alpha.grab()\n")
+        findings = analyze_tree(
+            tmp_path, {"trainingjob_operator_tpu/plane.py": src},
+            only=["TJA010"])
+        assert findings == []
+
+
+# -- TJA011 env-contract -----------------------------------------------------
+
+ENV_CONSTANTS = """\
+FOO_ENV = "TRAININGJOB_FOO"
+BAR_ENV = "TRAININGJOB_BAR"
+"""
+
+
+class TestEnvContract:
+    def test_fires_on_read_never_injected(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": ENV_CONSTANTS,
+            "trainingjob_operator_tpu/worker.py": """\
+                import os
+
+                from trainingjob_operator_tpu.api import constants
+
+
+                def addr():
+                    return os.environ.get(constants.FOO_ENV, "")
+                """}, only=["env-contract"])
+        assert ids(findings) == ["TJA011"]
+        (f,) = findings
+        assert f.severity == "error" and "never injected" in f.message
+        assert f.path == "trainingjob_operator_tpu/worker.py"
+
+    def test_fires_on_injected_never_read(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": ENV_CONSTANTS,
+            "trainingjob_operator_tpu/pod.py": """\
+                from trainingjob_operator_tpu.api import constants
+
+
+                def build_env(env):
+                    env[constants.BAR_ENV] = "1"
+                """}, only=["TJA011"])
+        assert ids(findings) == ["TJA011"]
+        (f,) = findings
+        assert f.severity == "warning" and "nothing" in f.message
+
+    def test_fires_on_undeclared_contract_var(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": ENV_CONSTANTS,
+            "trainingjob_operator_tpu/worker.py": """\
+                import os
+
+
+                def mystery():
+                    return os.environ.get("TRAININGJOB_MYSTERY", "")
+                """}, only=["TJA011"])
+        assert any(f.severity == "error" and "not declared" in f.message
+                   for f in findings)
+
+    def test_quiet_when_declared_user_knob(self, tmp_path):
+        """A knob the *user* sets (never the controller) is exempt from the
+        read-never-injected direction via USER_ENV_KNOBS."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py":
+                ENV_CONSTANTS + "USER_ENV_KNOBS = frozenset((FOO_ENV, BAR_ENV))\n",
+            "trainingjob_operator_tpu/worker.py": """\
+                import os
+
+                from trainingjob_operator_tpu.api import constants
+
+
+                def addr():
+                    return os.environ.get(constants.FOO_ENV, "")
+                """}, only=["TJA011"])
+        assert findings == []
+
+    def test_quiet_on_closed_triangle(self, tmp_path):
+        """Declared, injected, and read: nothing to report."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": ENV_CONSTANTS,
+            "trainingjob_operator_tpu/pod.py": """\
+                from trainingjob_operator_tpu.api import constants
+
+
+                def build_env(env):
+                    env[constants.FOO_ENV] = "addr:1234"
+                """,
+            "trainingjob_operator_tpu/worker.py": """\
+                import os
+
+                from trainingjob_operator_tpu.api import constants
+
+
+                def addr():
+                    return os.environ.get(constants.FOO_ENV, "")
+                """}, only=["TJA011"])
+        assert [f for f in findings if "TRAININGJOB_FOO" in f.message] == []
+
+
+# -- TJA012 metric-name-drift ------------------------------------------------
+
+METRIC_DOC = """\
+# Observability
+
+| name | type | meaning |
+|------|------|---------|
+| `trainingjob_good_total` | counter | documented and emitted |
+| `trainingjob_ghost_total` | counter | documented, never emitted |
+"""
+
+
+class TestMetricNameDrift:
+    def test_fires_both_directions(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "docs/OBSERVABILITY.md": METRIC_DOC,
+            "trainingjob_operator_tpu/metrics_user.py": """\
+                def emit(registry):
+                    registry.inc("trainingjob_good_total")
+                    registry.inc("trainingjob_rogue_total")
+                """}, only=["metric-name-drift"])
+        assert ids(findings) == ["TJA012"]
+        rogue = [f for f in findings if "rogue" in f.message]
+        ghost = [f for f in findings if "ghost" in f.message]
+        assert len(rogue) == 1 and rogue[0].severity == "error"
+        assert rogue[0].path == "trainingjob_operator_tpu/metrics_user.py"
+        assert len(ghost) == 1 and ghost[0].severity == "warning"
+        assert ghost[0].path == "docs/OBSERVABILITY.md"
+
+    def test_quiet_on_non_metric_callee(self, tmp_path):
+        """A metric-patterned literal passed to a non-metric callee (the
+        ContextVar-name pattern in obs/trace.py) is not an emission."""
+        findings = analyze_tree(tmp_path, {
+            "docs/OBSERVABILITY.md": METRIC_DOC,
+            "trainingjob_operator_tpu/trace_like.py": """\
+                import contextvars
+
+                _span = contextvars.ContextVar(
+                    "trainingjob_undocumented_span", default=None)
+
+
+                def emit(registry):
+                    registry.inc("trainingjob_good_total")
+                    registry.observe("trainingjob_ghost_total", 1.0)
+                """}, only=["TJA012"])
+        assert findings == []
+
+
+# -- TJA013 phase-transition-exhaustiveness ----------------------------------
+
+PHASE_CONSTANTS = """\
+PHASE_TRANSITIONS = {
+    "": ("Pending",),
+    "Pending": ("Running",),
+    "Running": ("Succeed", "Failed"),
+    "Succeed": (),
+}
+"""
+
+PHASE_TYPES = """\
+class TrainingJobPhase:
+    NONE = ""
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeed"
+    FAILED = "Failed"
+    TIMEOUT = "Timeout"
+"""
+
+
+class TestPhaseTransitionExhaustiveness:
+    def test_fires_on_witnessed_illegal_transition(self, tmp_path):
+        """Succeed -> Running resurrects a completed job; the table forbids
+        it and the dominating phase test witnesses the source."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": PHASE_CONSTANTS,
+            "trainingjob_operator_tpu/api/types.py": PHASE_TYPES,
+            "trainingjob_operator_tpu/sync.py": """\
+                from trainingjob_operator_tpu.api.types import TrainingJobPhase
+                from trainingjob_operator_tpu.status import update_job_conditions
+
+
+                def resurrect(job):
+                    if job.status.phase == TrainingJobPhase.SUCCEEDED:
+                        update_job_conditions(job, TrainingJobPhase.RUNNING,
+                                              "Restarted", "never do this")
+                """}, only=["phase-transition-exhaustiveness"])
+        assert ids(findings) == ["TJA013"]
+        (f,) = findings
+        assert "'Succeed' -> 'Running'" in f.message
+
+    def test_fires_on_unreachable_target(self, tmp_path):
+        """A target no table entry allows any source to reach."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": PHASE_CONSTANTS,
+            "trainingjob_operator_tpu/api/types.py": PHASE_TYPES,
+            "trainingjob_operator_tpu/sync.py": """\
+                from trainingjob_operator_tpu.api.types import TrainingJobPhase
+                from trainingjob_operator_tpu.status import update_job_conditions
+
+
+                def expire(job):
+                    update_job_conditions(job, TrainingJobPhase.TIMEOUT,
+                                          "Expired", "nothing declares this")
+                """}, only=["TJA013"])
+        assert ids(findings) == ["TJA013"]
+        assert "no PHASE_TRANSITIONS entry" in findings[0].message
+
+    def test_quiet_on_legal_and_dynamic_transitions(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": PHASE_CONSTANTS,
+            "trainingjob_operator_tpu/api/types.py": PHASE_TYPES,
+            "trainingjob_operator_tpu/sync.py": """\
+                from trainingjob_operator_tpu.api.types import TrainingJobPhase
+                from trainingjob_operator_tpu.status import update_job_conditions
+
+
+                def advance(job, ending_phase):
+                    if job.status.phase == TrainingJobPhase.PENDING:
+                        update_job_conditions(job, TrainingJobPhase.RUNNING,
+                                              "Started", "legal")
+                    if job.status.phase == TrainingJobPhase.RUNNING:
+                        # Same-phase refresh: always legal.
+                        update_job_conditions(job, TrainingJobPhase.RUNNING,
+                                              "Heartbeat", "refresh")
+                    # Dynamic target: skipped, the runtime guard owns it.
+                    update_job_conditions(job, ending_phase, "End", "dynamic")
+                """}, only=["TJA013"])
+        assert findings == []
+
+
+# -- TJA014 dead-event-reason ------------------------------------------------
+
+REASON_CONSTANTS = """\
+ALIVE_REASON = "AliveReason"
+DEAD_REASON = "DeadReason"
+
+EVENT_REASONS = frozenset((
+    ALIVE_REASON,
+    DEAD_REASON,
+))
+"""
+
+
+class TestDeadEventReason:
+    def test_fires_on_registry_entry_nothing_emits(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": REASON_CONSTANTS,
+            "trainingjob_operator_tpu/emitter.py": """\
+                from trainingjob_operator_tpu.api import constants
+
+
+                def emit(recorder, job):
+                    recorder.event(job, "Normal", constants.ALIVE_REASON, "m")
+                """}, only=["dead-event-reason"])
+        assert ids(findings) == ["TJA014"]
+        (f,) = findings
+        assert "'DeadReason'" in f.message
+        assert f.path == "trainingjob_operator_tpu/api/constants.py"
+        # Reported at the member's line inside the frozenset literal.
+        assert f.line == 6
+
+    def test_quiet_when_every_reason_is_used(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/api/constants.py": REASON_CONSTANTS,
+            "trainingjob_operator_tpu/emitter.py": """\
+                from trainingjob_operator_tpu.api import constants
+
+
+                def emit(recorder, job):
+                    recorder.event(job, "Normal", constants.ALIVE_REASON, "m")
+                    recorder.event(job, "Warning", "DeadReason", "literal use")
+                """}, only=["TJA014"])
+        assert findings == []
+
+
 # -- runner: baseline, waivers, formats, CLI ---------------------------------
 
 class TestRunnerMachinery:
@@ -694,11 +1102,25 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_nine_checks_registered(self):
+    def test_all_fourteen_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
             "TJA007", "TJA008", "TJA009"}
+        assert {cid for cid, _fn in runner.PROJECT_REGISTRY.values()} == {
+            "TJA010", "TJA011", "TJA012", "TJA013", "TJA014"}
+        assert len(runner.all_checks()) == 14
+
+    def test_every_check_has_a_docs_row(self):
+        """Self-check: each registered ID must have a catalog row in
+        docs/STATIC_ANALYSIS.md -- a check nobody can look up is a check
+        nobody waives correctly."""
+        runner._load_checks()
+        doc = open(os.path.join(REPO_ROOT, "docs",
+                                "STATIC_ANALYSIS.md")).read()
+        for cid, name in sorted(runner.all_checks().items()):
+            assert f"| {cid} |" in doc, f"{cid} has no catalog row"
+            assert f"`{name}`" in doc, f"{name} not named in the catalog"
 
 
 # -- the tier-1 gate ---------------------------------------------------------

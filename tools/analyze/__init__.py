@@ -1,23 +1,39 @@
 """AST-based operator-lint suite (docs/STATIC_ANALYSIS.md).
 
-Six repo-specific passes over stdlib ``ast``:
+Fourteen repo-specific passes over stdlib ``ast`` — nine per-file, five
+whole-program (a ``ProjectContext`` built once per run over the shared
+per-file trees):
 
-=======  =================  =====================================================
-ID       name               what it catches
-=======  =================  =====================================================
-TJA001   py-compat          files that don't parse under the oldest supported
-                            grammar (Python 3.10), e.g. f-string backslashes
-TJA002   lock-discipline    attribute mutations outside ``with self._lock:`` in
-                            classes that create a Lock/RLock/Condition
-TJA003   reconcile-purity   time.sleep / blocking HTTP-socket calls / unbounded
-                            waits inside controller reconcile paths
-TJA004   broad-except       ``except Exception:`` / bare ``except:`` that neither
-                            logs, re-raises, nor carries a waiver comment
-TJA005   constant-drift     label/annotation/env-var contract strings used inline
-                            instead of via api/constants.py
-TJA006   tracer-safety      Python control flow on traced values, float()/.item()
-                            host syncs, and print() inside jit/pmap/shard_map
-=======  =================  =====================================================
+=======  ==============================  =======================================
+ID       name                            what it catches
+=======  ==============================  =======================================
+TJA001   py-compat                       files that don't parse under the oldest
+                                         supported grammar (Python 3.10)
+TJA002   lock-discipline                 attribute mutations outside ``with
+                                         self._lock:`` in lock-owning classes
+TJA003   reconcile-purity                sleeps / blocking IO / unbounded waits
+                                         inside controller reconcile paths
+TJA004   broad-except                    swallowed ``except Exception:`` without
+                                         log, re-raise, forward, or waiver
+TJA005   constant-drift                  contract strings inlined instead of
+                                         taken from api/constants.py
+TJA006   tracer-safety                   host syncs / Python control flow on
+                                         traced values inside jit/pmap/shard_map
+TJA007   event-reason-drift              recorder.event reasons outside the
+                                         EVENT_REASONS registry
+TJA008   orphaned-thread                 non-daemon threads with no join
+TJA009   status-write-discipline         raw job.status writes outside the
+                                         status machine's helpers
+TJA010   lock-order-cycle                cycles in the global lock-acquisition-
+                                         order graph (potential deadlocks)
+TJA011   env-contract                    TRAININGJOB_* vars read-never-injected
+                                         / injected-never-read / undeclared
+TJA012   metric-name-drift               emitted Prometheus names vs the
+                                         docs/OBSERVABILITY.md registry
+TJA013   phase-transition-exhaustiveness update_job_conditions call sites vs
+                                         the PHASE_TRANSITIONS legal table
+TJA014   dead-event-reason               EVENT_REASONS members nothing uses
+=======  ==============================  =======================================
 
 Run: ``python -m tools.analyze trainingjob_operator_tpu/`` (see __main__.py).
 """

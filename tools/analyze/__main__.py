@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 from tools.analyze import runner
 
@@ -32,18 +33,23 @@ def main(argv=None) -> int:
     ap.add_argument("--checks", default=None,
                     help="comma-separated subset of check names or IDs")
     ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--max-seconds", type=float, default=None, metavar="S",
+                    help="fail (exit 1) when the analysis itself takes longer "
+                         "than S wall-clock seconds -- a CI budget proving "
+                         "the whole-program layer stays cheap")
     args = ap.parse_args(argv)
 
     if args.list_checks:
-        runner._load_checks()
-        for name, (cid, _fn) in sorted(runner.REGISTRY.items(),
-                                       key=lambda kv: kv[1][0]):
-            print(f"{cid}  {name}")
+        for cid, name in sorted(runner.all_checks().items()):
+            kind = "project" if name in runner.PROJECT_REGISTRY else "file"
+            print(f"{cid}  {name}  [{kind}]")
         return 0
 
     only = args.checks.split(",") if args.checks else None
     paths = args.paths or ["trainingjob_operator_tpu"]
+    started = time.monotonic()
     findings = runner.run_checks(paths, root=os.getcwd(), only=only)
+    elapsed = time.monotonic() - started
 
     if args.write_baseline:
         n = runner.write_baseline(args.write_baseline, findings)
@@ -66,7 +72,12 @@ def main(argv=None) -> int:
     summary = f"{len(findings)} finding(s)"
     if suppressed:
         summary += f", {suppressed} baselined"
+    summary += f" in {elapsed:.2f}s"
     print(summary, file=sys.stderr)
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"analysis took {elapsed:.2f}s, over the --max-seconds "
+              f"{args.max_seconds:g} budget", file=sys.stderr)
+        return 1
     return 1 if findings else 0
 
 

@@ -73,8 +73,8 @@ def check(ctx: FileContext) -> List[Finding]:
     if ctx.tree is None:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+    for node in ctx.by_type(ast.ExceptHandler):
+        if not _is_broad(node):
             continue
         if _handler_is_accountable(node):
             continue

@@ -82,9 +82,9 @@ def _load_constants(repo_root: str) -> Tuple[Dict[str, str], Set[str]]:
     return by_value, all_values
 
 
-def _docstring_and_fstring_nodes(tree: ast.Module) -> Set[int]:
+def _docstring_and_fstring_nodes(nodes: list) -> Set[int]:
     skip: Set[int] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
                              ast.AsyncFunctionDef)):
             body = getattr(node, "body", [])
@@ -120,10 +120,12 @@ def check(ctx: FileContext) -> List[Finding]:
     by_value, all_values = _load_constants(root)
     if not by_value and not all_values:
         return []
-    skip = _docstring_and_fstring_nodes(ctx.tree)
+    skip = _docstring_and_fstring_nodes(ctx.by_type(
+        ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef,
+        ast.JoinedStr))
     findings: List[Finding] = []
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+    for node in ctx.by_type(ast.Constant):
+        if not isinstance(node.value, str):
             continue
         if id(node) in skip:
             continue

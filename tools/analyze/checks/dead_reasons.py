@@ -1,0 +1,111 @@
+"""TJA014 dead-event-reason: registry entries no emission site uses.
+
+``api/constants.py`` declares every Kubernetes event reason in
+``EVENT_REASONS`` and TJA007 proves each ``recorder.event(...)`` call uses
+a registered reason -- but nothing proved the converse.  A registry entry
+with no emission site is worse than dead code: operators write alert rules
+and ``kubectl get events --field-selector reason=...`` filters against the
+registry, and a dead entry means the alert can never fire.  The usual
+cause is a feature whose emission site was refactored away (or never
+landed) while the constant survived.
+
+A reason counts as *used* when either:
+
+- its literal value is passed to a recorder ``.event(...)`` call (same
+  receiver heuristic as TJA007), directly or via the ``*_REASON`` constant
+  naming it; or
+- the ``*_REASON`` constant naming it is referenced as an attribute
+  anywhere outside ``api/constants.py`` -- that covers dynamic flows like
+  the ``PHASE_REASON`` phase->reason table in ``api/types.py`` and
+  telemetry paths that pick reasons at runtime.
+
+Unused members are reported at their line inside the ``EVENT_REASONS``
+declaration.  "Nothing uses it" is a whole-package claim, so the pass is
+inert unless the analyzed set covers the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ModuleInfo, ProjectContext
+from tools.analyze.runner import register_project
+
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+REGISTRY_NAME = "EVENT_REASONS"
+
+
+def _registry_members(const_mod: ModuleInfo) -> Dict[str, int]:
+    """reason value -> line of its member inside the frozenset literal."""
+    if const_mod.ctx is None or const_mod.ctx.tree is None:
+        return {}
+    for node in const_mod.ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == REGISTRY_NAME
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "frozenset" and node.value.args):
+            continue
+        seq = node.value.args[0]
+        out: Dict[str, int] = {}
+        if isinstance(seq, (ast.Tuple, ast.List, ast.Set)):
+            for el in seq.elts:
+                if isinstance(el, ast.Name) and el.id in const_mod.constants:
+                    out[const_mod.constants[el.id]] = el.lineno
+                elif isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out[el.value] = el.lineno
+        return out
+    return {}
+
+
+def _used_reasons(pc: ProjectContext, const_mod: ModuleInfo) -> Set[str]:
+    #: constant name -> reason value, for every ``*_REASON`` declaration.
+    by_name = {n: v for n, v in const_mod.constants.items()
+               if n.endswith("_REASON")}
+    used: Set[str] = set()
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or rel == CONSTANTS_REL \
+                or not rel.startswith("trainingjob_operator_tpu/"):
+            continue
+        for node in ctx.by_type(ast.Attribute, ast.Name, ast.Call):
+            if isinstance(node, ast.Attribute) and node.attr in by_name:
+                used.add(by_name[node.attr])
+            elif isinstance(node, ast.Name) and node.id in by_name:
+                # ``from ..api.constants import X_REASON`` then bare use.
+                used.add(by_name[node.id])
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute) and fn.attr == "event"):
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        used.add(arg.value)
+    return used
+
+
+@register_project("TJA014", "dead-event-reason")
+def check(pc: ProjectContext) -> List[Finding]:
+    const_mod = pc.ensure_module(CONSTANTS_REL)
+    if const_mod is None:
+        return []
+    members = _registry_members(const_mod)
+    if not members:
+        return []
+    if not pc.covers_package("trainingjob_operator_tpu"):
+        return []
+    used = _used_reasons(pc, const_mod)
+    findings: List[Finding] = []
+    for value in sorted(set(members) - used):
+        findings.append(Finding(
+            "TJA014", "dead-event-reason", CONSTANTS_REL, members[value], 0,
+            ERROR,
+            f"event reason {value!r} is registered in EVENT_REASONS but no "
+            "emission site ever passes it to a recorder; wire up the "
+            "emission or delete the registry entry (alerts filtering on a "
+            "dead reason can never fire)"))
+    findings.sort(key=Finding.sort_key)
+    return findings

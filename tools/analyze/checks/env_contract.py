@@ -1,0 +1,241 @@
+"""TJA011 env-contract: three-way consistency for the rendezvous env.
+
+The controller's entire interface with workloads is environment variables
+(PAPER.md's env-injection design): ``controller/pod.py`` bakes
+``TRAININGJOB_*`` / ``TPU_WORKER_*`` / ``MEGASCALE_*`` vars into pod specs,
+runtimes forward them into processes, and ``workloads/``/``runtime/`` read
+them back.  Because the two halves never share code -- only strings -- the
+contract can drift silently in three directions, and this pass closes the
+triangle project-wide:
+
+1. **read-but-never-injected** (error): code reads a contract var that no
+   injection site sets and that is not declared a user knob
+   (``USER_ENV_KNOBS`` in api/constants.py) -- the read can only ever see
+   its default, which usually means a rename landed on one side only;
+2. **injected-but-never-read** (warning): the controller injects a declared
+   var that nothing in the project reads and that is not declared
+   externally consumed (``EXTERNAL_CONSUMER_ENV``) -- dead contract
+   surface that every future reader must reverse-engineer;
+3. **undeclared** (error): a contract-shaped var is read or injected via a
+   raw literal that ``api/constants.py`` does not define (TJA005 flags this
+   per-file in controller/runtime/workloads; this pass covers the whole
+   package, including ``ops/`` and ``data/``).
+
+Evidence is syntactic: injection is ``EnvVar(X, ...)``, ``env[X] = ...`` or
+``env.setdefault(X, ...)``; a read is ``X`` appearing as the key argument
+of a ``.get``/``getenv``/``.pop`` call, as a ``Load`` subscript index, as a
+parameter default, or as the first argument to an ``_env``-named helper.
+``X`` may be a ``constants.*`` attribute or a string literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import ModuleInfo, ProjectContext
+from tools.analyze.runner import register_project
+
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+CONTRACT_ENV_RE = re.compile(
+    r"^(TRAININGJOB_[A-Z0-9_]+|TPU_WORKER_[A-Z0-9_]+|MEGASCALE_[A-Z0-9_]+)$")
+
+#: Call-leaf names whose string key argument is a read.
+_READ_CALLS = {"get", "getenv", "pop"}
+#: Receiver/callee substrings marking an env helper (``_env_float(X, d)``).
+_ENV_HELPER_RE = re.compile(r"(^|_)env", re.IGNORECASE)
+
+
+def _frozenset_values(mod: ModuleInfo, name: str) -> Set[str]:
+    """String values of a ``NAME = frozenset((A, B, ...))`` declaration,
+    resolving member names through the module's own string constants."""
+    out: Set[str] = set()
+    if mod.ctx is None or mod.ctx.tree is None:
+        return out
+    for node in mod.ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "frozenset" and node.value.args):
+            continue
+        seq = node.value.args[0]
+        if isinstance(seq, (ast.Tuple, ast.List, ast.Set)):
+            for el in seq.elts:
+                if isinstance(el, ast.Name) and el.id in mod.constants:
+                    out.add(mod.constants[el.id])
+                elif isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+    return out
+
+
+def _env_value(node: ast.expr, constants: Dict[str, str],
+               local_consts: Dict[str, str]) -> Optional[str]:
+    """The env-var name an expression denotes: a string literal, a
+    ``constants.X`` attribute, or a module-local ``X`` constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute) and node.attr in constants:
+        return constants[node.attr]
+    if isinstance(node, ast.Name) and node.id in local_consts:
+        return local_consts[node.id]
+    return None
+
+
+class _Collector:
+    """Evidence collection over one file's typed node buckets.  Every rule
+    here is context-free (a node alone decides), so there is no need for a
+    recursive NodeVisitor walk -- iterating the by_type buckets covers all
+    nested occurrences at a fraction of the traversal cost."""
+
+    def __init__(self, path: str, constants: Dict[str, str],
+                 local_consts: Dict[str, str]):
+        self.path = path
+        self.constants = constants
+        self.local_consts = local_consts
+        #: value -> first (path, line) evidence.
+        self.injected: Dict[str, Tuple[str, int]] = {}
+        self.read: Dict[str, Tuple[str, int]] = {}
+        self.undeclared: List[Tuple[str, int, str]] = []   # (value, line, how)
+
+    def _note(self, store: Dict[str, Tuple[str, int]], value: str,
+              line: int, how: str) -> None:
+        store.setdefault(value, (self.path, line))
+        if (CONTRACT_ENV_RE.match(value)
+                and value not in self.constants.values()):
+            self.undeclared.append((value, line, how))
+
+    def _key(self, node: ast.expr) -> Optional[str]:
+        return _env_value(node, self.constants, self.local_consts)
+
+    def collect(self, ctx) -> None:
+        for node in ctx.by_type(ast.Call):
+            self._call(node)
+        for node in ctx.by_type(ast.Subscript):
+            self._subscript(node)
+        for node in ctx.by_type(ast.arguments):
+            self._defaults(node)
+        for node in ctx.by_type(ast.Compare):
+            self._compare(node)
+
+    def _call(self, node: ast.Call) -> None:
+        fn = node.func
+        leaf = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if leaf == "EnvVar" and node.args:
+            v = self._key(node.args[0])
+            if v is not None:
+                self._note(self.injected, v, node.lineno, "injected")
+        elif leaf == "setdefault" and node.args:
+            v = self._key(node.args[0])
+            if v is not None and CONTRACT_ENV_RE.match(v):
+                self._note(self.injected, v, node.lineno, "injected")
+        elif leaf in _READ_CALLS and node.args:
+            v = self._key(node.args[0])
+            if v is not None:
+                self._note(self.read, v, node.lineno, "read")
+        elif _ENV_HELPER_RE.search(leaf) and node.args:
+            v = self._key(node.args[0])
+            if v is not None and CONTRACT_ENV_RE.match(v):
+                self._note(self.read, v, node.lineno, "read")
+
+    def _subscript(self, node: ast.Subscript) -> None:
+        v = self._key(node.slice)
+        if v is not None and CONTRACT_ENV_RE.match(v):
+            if isinstance(node.ctx, ast.Store):
+                self._note(self.injected, v, node.lineno, "injected")
+            else:
+                self._note(self.read, v, node.lineno, "read")
+
+    def _defaults(self, node: ast.arguments) -> None:
+        for default in list(node.defaults) + [d for d in node.kw_defaults if d]:
+            v = self._key(default)
+            if v is not None and CONTRACT_ENV_RE.match(v):
+                # ``def from_env(var=constants.X_ENV)``: the function reads
+                # os.environ[var] dynamically -- count the default as a read.
+                self._note(self.read, v, default.lineno, "read")
+
+    def _compare(self, node: ast.Compare) -> None:
+        # ``constants.X_ENV in os.environ`` -- membership probe is a read.
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            v = self._key(node.left)
+            if v is not None and CONTRACT_ENV_RE.match(v):
+                self._note(self.read, v, node.lineno, "read")
+
+
+@register_project("TJA011", "env-contract")
+def check(pc: ProjectContext) -> List[Finding]:
+    const_mod = pc.ensure_module(CONSTANTS_REL)
+    if const_mod is None:
+        return []
+    constants = {n: v for n, v in const_mod.constants.items()
+                 if n.endswith("_ENV")}
+    declared = set(constants.values())
+    user_knobs = _frozenset_values(const_mod, "USER_ENV_KNOBS")
+    external = _frozenset_values(const_mod, "EXTERNAL_CONSUMER_ENV")
+    decl_lines = {}
+    if const_mod.ctx is not None and const_mod.ctx.tree is not None:
+        for node in const_mod.ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                decl_lines[node.value.value] = node.lineno
+
+    injected: Dict[str, Tuple[str, int]] = {}
+    read: Dict[str, Tuple[str, int]] = {}
+    findings: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or rel == CONSTANTS_REL \
+                or not rel.startswith("trainingjob_operator_tpu/"):
+            continue
+        mod = pc.module_of_path(rel)
+        local_consts = dict(mod.constants) if mod is not None else {}
+        col = _Collector(rel, constants, local_consts)
+        col.collect(ctx)
+        for v, site in col.injected.items():
+            injected.setdefault(v, site)
+        for v, site in col.read.items():
+            read.setdefault(v, site)
+        for v, line, how in col.undeclared:
+            findings.append(Finding(
+                "TJA011", "env-contract", rel, line, 0, ERROR,
+                f"contract env var {v!r} is {how} here but not declared in "
+                "api/constants.py; declare it (and add it to USER_ENV_KNOBS "
+                "if the controller never injects it)"))
+
+    # The two absence-based directions are whole-package claims: skip them
+    # unless the analyzed set actually covers the package.
+    if not pc.covers_package("trainingjob_operator_tpu"):
+        findings.sort(key=Finding.sort_key)
+        return findings
+
+    for v in sorted(read):
+        if not CONTRACT_ENV_RE.match(v):
+            continue
+        if v in injected or v in user_knobs or v not in declared:
+            continue   # undeclared reads already reported above
+        path, line = read[v]
+        findings.append(Finding(
+            "TJA011", "env-contract", path, line, 0, ERROR,
+            f"env var {v!r} is read here but never injected by the "
+            "controller or a runtime, and is not in USER_ENV_KNOBS "
+            "(api/constants.py): the read can only see its default"))
+
+    for v in sorted(injected):
+        if not CONTRACT_ENV_RE.match(v):
+            continue
+        if v in read or v in external or v not in declared:
+            continue
+        path, line = injected[v]
+        findings.append(Finding(
+            "TJA011", "env-contract", path, line, 0, WARNING,
+            f"env var {v!r} is injected here but nothing in the project "
+            "reads it and it is not in EXTERNAL_CONSUMER_ENV "
+            "(api/constants.py): dead contract surface"))
+
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -120,8 +120,8 @@ def check(ctx: FileContext) -> List[Finding]:
     if not member_values:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and _is_recorder_call(node)):
+    for node in ctx.by_type(ast.Call):
+        if not _is_recorder_call(node):
             continue
         reason = _reason_arg(node)
         if reason is None:

@@ -137,7 +137,7 @@ def check(ctx: FileContext) -> List[Finding]:
     if ctx.tree is None:
         return []
     findings: List[Finding] = []
-    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+    for cls in ctx.by_type(ast.ClassDef):
         methods = [n for n in cls.body
                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         lock_attrs: Set[str] = set()

@@ -1,0 +1,263 @@
+"""TJA010 lock-order-cycle: a whole-program lock-acquisition-order graph.
+
+The reconcile plane holds locks across call boundaries: the telemetry
+aggregator registers gauges in the metrics registry while holding its own
+lock, the workqueue's condition feeds worker threads that re-enter the
+tracker, mixins acquire attributes their siblings created.  Per-file passes
+(TJA002) can prove *discipline* -- mutations happen under the lock -- but
+only a global view can prove *order*: if thread A takes L1 then L2 while
+thread B takes L2 then L1, the process deadlocks the first time the
+schedules interleave, typically weeks into a soak run.
+
+The pass builds a directed graph over every lock in the project (class
+attributes assigned ``threading.Lock()``/``RLock()``/``Condition()`` --
+identified by their *creating* class, so mixin siblings share one node --
+plus module-level locks).  An edge L1 -> L2 is added when some method:
+
+- acquires L2 (``with``/``.acquire()``) lexically inside a ``with L1:``; or
+- calls, while holding L1, a callable that (transitively, through the
+  project call graph: ``self.m()`` across mixin MROs, module functions,
+  ``self._attr.m()`` / ``GLOBAL.m()`` via inferred constructor types) may
+  acquire L2.
+
+Any cycle is a potential deadlock and is reported once, with the witness
+edge sites.  A self-cycle (re-acquiring a lock already held) is reported
+only for non-reentrant ``Lock``s -- ``RLock``/``Condition`` re-entry is
+legal.  Dynamic dispatch and callbacks are invisible; this is a
+conservative witness-based pass, not a proof of absence.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import (
+    ClassInfo, MethodSummary, ModuleInfo, ProjectContext, REENTRANT_FACTORIES,
+)
+from tools.analyze.runner import register_project
+
+
+class _Resolver:
+    """Resolution helpers shared by the graph build, with caches."""
+
+    def __init__(self, pc: ProjectContext):
+        self.pc = pc
+        self._composites: Dict[str, List[ClassInfo]] = {}
+        self._creator: Dict[Tuple[str, str], Optional[Tuple[str, str]]] = {}
+
+    def composites(self, ci: ClassInfo) -> List[ClassInfo]:
+        got = self._composites.get(ci.qual)
+        if got is None:
+            got = self.pc.subclasses_including(ci)
+            self._composites[ci.qual] = got
+        return got
+
+    def lock_id(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                name: str) -> Optional[Tuple[str, str]]:
+        """(lock id, factory kind) for a raw acquisition name recorded in a
+        summary: a module-level lock, or a ``self.X`` attribute whose
+        creating class is found in the MRO of any composite the defining
+        class is mixed into.  None when the name is not provably a lock."""
+        if name in mod.module_locks:
+            return f"{mod.name}.{name}", mod.module_locks[name]
+        if cls is None:
+            return None
+        key = (cls.qual, name)
+        if key in self._creator:
+            return self._creator[key]
+        found: Optional[Tuple[str, str]] = None
+        for k in [cls] + self.composites(cls):
+            for c in self.pc.mro_classes(k):
+                if name in c.lock_attrs:
+                    found = (f"{c.qual}.{name}", c.lock_attrs[name])
+                    break
+            if found:
+                break
+        self._creator[key] = found
+        return found
+
+    def callee_summaries(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                         callee: tuple) -> List[Tuple[ModuleInfo,
+                                                      Optional[ClassInfo],
+                                                      MethodSummary]]:
+        kind = callee[0]
+        out: List[Tuple[ModuleInfo, Optional[ClassInfo], MethodSummary]] = []
+        if kind == "self" and cls is not None:
+            name = callee[1]
+            seen: Set[str] = set()
+            for k in self.composites(cls):
+                table = self.pc.mro_methods(k)
+                hit = table.get(name)
+                if hit is None:
+                    continue
+                ci, _node = hit
+                s = ci.summaries.get(name)
+                if s is not None and s.qual not in seen:
+                    seen.add(s.qual)
+                    out.append((self.pc.modules[ci.module], ci, s))
+            return out
+        if kind == "name":
+            name = callee[1]
+            if name in mod.fn_summaries:
+                return [(mod, None, mod.fn_summaries[name])]
+            target = mod.imports.get(name)
+            if target:
+                tmod, _, leaf = target.rpartition(".")
+                mi = self.pc.modules.get(tmod)
+                if mi is not None and leaf in mi.fn_summaries:
+                    return [(mi, None, mi.fn_summaries[leaf])]
+            return out
+        if kind == "attr":
+            leaf, meth = callee[1], callee[2]
+            ctor: Optional[Tuple[str, str]] = None   # (module, class name)
+            if cls is not None:
+                for k in [cls] + self.composites(cls):
+                    for c in self.pc.mro_classes(k):
+                        if leaf in c.attr_ctors:
+                            ctor = (c.module, c.attr_ctors[leaf])
+                            break
+                    if ctor:
+                        break
+            if ctor is None:
+                tgt, src_mod = mod.global_ctors.get(leaf), mod.name
+                if tgt is None:
+                    imp = mod.imports.get(leaf)
+                    if imp:
+                        m, _, l2 = imp.rpartition(".")
+                        mi = self.pc.modules.get(m)
+                        if mi is not None and l2 in mi.global_ctors:
+                            tgt, src_mod = mi.global_ctors[l2], m
+                if tgt is not None:
+                    ctor = (src_mod, tgt)
+            if ctor is not None:
+                ci = self.pc.resolve_class(ctor[0], ctor[1])
+                if ci is not None:
+                    table = self.pc.mro_methods(ci)
+                    hit = table.get(meth)
+                    if hit is not None:
+                        c2, _node = hit
+                        s = c2.summaries.get(meth)
+                        if s is not None:
+                            out.append((self.pc.modules[c2.module], c2, s))
+            return out
+        return out
+
+
+def _iter_summaries(pc: ProjectContext):
+    for mod in pc.modules.values():
+        for s in mod.fn_summaries.values():
+            yield mod, None, s
+        for ci in mod.classes.values():
+            for s in ci.summaries.values():
+                yield mod, ci, s
+
+
+@register_project("TJA010", "lock-order-cycle")
+def check(pc: ProjectContext) -> List[Finding]:
+    res = _Resolver(pc)
+
+    # Per-summary facts: resolved direct lock ids + resolved callee quals.
+    direct: Dict[str, Set[str]] = {}
+    callees: Dict[str, Set[str]] = {}
+    ctx_of: Dict[str, Tuple[ModuleInfo, Optional[ClassInfo], MethodSummary]] = {}
+    kinds: Dict[str, str] = {}
+    for mod, cls, s in _iter_summaries(pc):
+        ctx_of[s.qual] = (mod, cls, s)
+        locks: Set[str] = set()
+        for name in s.acquires:
+            hit = res.lock_id(mod, cls, name)
+            if hit is not None:
+                locks.add(hit[0])
+                kinds[hit[0]] = hit[1]
+        direct[s.qual] = locks
+        outs: Set[str] = set()
+        for call in {c[:-1] for c in s.calls}:   # drop lineno, dedup
+            for _m, _c, cs in res.callee_summaries(mod, cls, call):
+                outs.add(cs.qual)
+        callees[s.qual] = outs
+
+    # Transitive may-acquire, by fixpoint over the (small) call graph.
+    may: Dict[str, Set[str]] = {q: set(v) for q, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, outs in callees.items():
+            acc = may[q]
+            before = len(acc)
+            for o in outs:
+                acc |= may.get(o, set())
+            if len(acc) != before:
+                changed = True
+
+    # Lock-order edges, with one witness (path, line, holder qual) each.
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(l1: str, l2: str, mod: ModuleInfo, line: int,
+                 qual: str) -> None:
+        edges.setdefault((l1, l2), (mod.ctx.path, line, qual))
+
+    for qual, (mod, cls, s) in ctx_of.items():
+        for outer, inner, line in s.nested_acquires:
+            h1, h2 = res.lock_id(mod, cls, outer), res.lock_id(mod, cls, inner)
+            if h1 and h2:
+                add_edge(h1[0], h2[0], mod, line, qual)
+        for outer, callee, line in s.held_calls:
+            h1 = res.lock_id(mod, cls, outer)
+            if h1 is None:
+                continue
+            for _m, _c, cs in res.callee_summaries(mod, cls, callee):
+                for l2 in may.get(cs.qual, ()):
+                    add_edge(h1[0], l2, mod, line, qual)
+
+    findings: List[Finding] = []
+
+    # Self-cycles: re-acquiring a non-reentrant Lock already held.
+    for (l1, l2), (path, line, qual) in sorted(edges.items()):
+        if l1 == l2 and kinds.get(l1) not in REENTRANT_FACTORIES:
+            findings.append(Finding(
+                "TJA010", "lock-order-cycle", path, line, 0, ERROR,
+                f"{qual} may re-acquire non-reentrant lock {l1} while "
+                f"already holding it (self-deadlock); use an RLock or hoist "
+                f"the inner acquisition out of the locked region"))
+
+    # Multi-lock cycles: DFS over the order graph.
+    graph: Dict[str, List[str]] = {}
+    for (l1, l2) in edges:
+        if l1 != l2:
+            graph.setdefault(l1, []).append(l2)
+    for outs in graph.values():
+        outs.sort()
+
+    reported: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in graph.get(node, ()):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key in reported:
+                    continue
+                reported.add(key)
+                cycle = path + [start]
+                hops = []
+                for a, b in zip(cycle, cycle[1:]):
+                    p, ln, q = edges[(a, b)]
+                    hops.append(f"{a} -> {b} ({q} at {p}:{ln})")
+                p0, ln0, _q0 = edges[(cycle[0], cycle[1])]
+                findings.append(Finding(
+                    "TJA010", "lock-order-cycle", p0, ln0, 0, ERROR,
+                    "lock-order cycle (potential deadlock): "
+                    + "; ".join(hops)
+                    + "; impose one global acquisition order or drop a lock "
+                      "before crossing the boundary"))
+            elif nxt not in path and nxt > start:
+                # Only explore nodes > start so each cycle is found from its
+                # smallest member exactly once.
+                dfs(start, nxt, path + [nxt])
+
+    for node in sorted(graph):
+        dfs(node, node, [node])
+
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -1,0 +1,108 @@
+"""TJA012 metric-name-drift: emitted Prometheus names vs the documented
+registry.
+
+Dashboards, alerts and runbooks are keyed on metric *names*; the code can
+rename ``trainingjob_steps_stalled_total`` without any test noticing, and
+every alert silently goes dark.  The authoritative registry is the metric
+catalog table in ``docs/OBSERVABILITY.md`` (one backticked
+``trainingjob_*`` name per row); this pass diffs it against every name the
+package actually emits:
+
+- **emitted-but-undocumented** (error, at the emission site): a literal
+  ``trainingjob_*`` name is passed to a metric-shaped callee (``.inc`` /
+  ``.observe`` / ``.gauge`` / ``.remove_gauge`` or a registration helper
+  named like one) but has no catalog row;
+- **documented-but-never-emitted** (warning, at the catalog row): a row
+  names a metric nothing emits -- a stale doc or a rename that only
+  landed in the code.
+
+Dynamic names (f-strings, variables) are invisible and skipped; the
+emitting modules keep names literal precisely so this pass can see them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+DOC_REL = "docs/OBSERVABILITY.md"
+METRIC_RE = re.compile(r"^trainingjob_[a-z0-9_]+$")
+#: A catalog row: ``| `trainingjob_foo` | type | ...``.
+ROW_RE = re.compile(r"^\|\s*`(trainingjob_[a-z0-9_]+)`\s*\|")
+#: Callee leaf names that carry a metric name: the registry API itself
+#: (``inc``/``observe``/``gauge``/``remove_gauge``) and the registration
+#: helpers built on it (``_register_gauge_locked``, ``_has_gauge``).  A
+#: metric-patterned literal passed anywhere *else* is not an emission --
+#: e.g. the ``trainingjob_current_span`` ContextVar name in obs/trace.py.
+EMIT_CALLEE_RE = re.compile(
+    r"(inc|observe|gauge|counter|histogram|summary|metric)", re.IGNORECASE)
+
+
+def _doc_registry(pc: ProjectContext) -> Dict[str, int]:
+    """metric name -> line number of its catalog row."""
+    path = os.path.join(pc.root, DOC_REL.replace("/", os.sep))
+    if not os.path.exists(path):
+        return {}
+    out: Dict[str, int] = {}
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = ROW_RE.match(line.strip())
+            if m:
+                out.setdefault(m.group(1), i)
+    return out
+
+
+def _emitted(pc: ProjectContext) -> Dict[str, Tuple[str, int]]:
+    """metric name -> first (path, line) where a literal name is passed to
+    any call in the package (emission or registration)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or not rel.startswith("trainingjob_operator_tpu/"):
+            continue
+        for node in ctx.by_type(ast.Call):
+            fn = node.func
+            leaf = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if not EMIT_CALLEE_RE.search(leaf):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and METRIC_RE.match(arg.value)):
+                    out.setdefault(arg.value, (rel, arg.lineno))
+    return out
+
+
+@register_project("TJA012", "metric-name-drift")
+def check(pc: ProjectContext) -> List[Finding]:
+    documented = _doc_registry(pc)
+    if not documented:
+        return []   # no registry to diff against (fixture trees)
+    emitted = _emitted(pc)
+    findings: List[Finding] = []
+    for name in sorted(set(emitted) - set(documented)):
+        path, line = emitted[name]
+        findings.append(Finding(
+            "TJA012", "metric-name-drift", path, line, 0, ERROR,
+            f"metric {name!r} is emitted here but has no row in the "
+            f"{DOC_REL} metric catalog; document it (dashboards and alerts "
+            "are keyed on the registry)"))
+    if not pc.covers_package("trainingjob_operator_tpu"):
+        # "nothing emits it" is a whole-package claim; don't make it when
+        # only a subset of the package was analyzed.
+        findings.sort(key=Finding.sort_key)
+        return findings
+    for name in sorted(set(documented) - set(emitted)):
+        findings.append(Finding(
+            "TJA012", "metric-name-drift", DOC_REL, documented[name], 0,
+            WARNING,
+            f"metric {name!r} is documented in the catalog but nothing in "
+            "the package emits it; delete the row or restore the emission"))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -53,12 +53,12 @@ def _daemon_kwarg_ok(call: ast.Call) -> bool:
     return False
 
 
-def _collect_evidence(tree: ast.Module) -> Set[str]:
+def _collect_evidence(nodes: list) -> Set[str]:
     """Names credited with a join (directly, via a join-sweep over them, or
     via an explicit ``<name>.daemon = True`` after construction)."""
     # comprehension/for variable -> iterated container name
     var_to_iter: Dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.For):
             tgt, it = node.target, node.iter
             if isinstance(tgt, ast.Name) and isinstance(it, ast.Name):
@@ -68,7 +68,7 @@ def _collect_evidence(tree: ast.Module) -> Set[str]:
             if isinstance(tgt, ast.Name) and isinstance(it, ast.Name):
                 var_to_iter[tgt.id] = it.id
     credited: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr == "join"):
@@ -88,12 +88,12 @@ def _collect_evidence(tree: ast.Module) -> Set[str]:
     return credited
 
 
-def _bindings(tree: ast.Module) -> Dict[int, str]:
+def _bindings(nodes: list) -> Dict[int, str]:
     """id(Thread Call) -> leaf name it is bound to, covering direct
     assignment, assignment of a comprehension building threads, and
     ``container.append(Thread(...))``."""
     bound: Dict[int, str] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             leaf = _leaf_name(node.targets[0])
             if not leaf:
@@ -120,10 +120,10 @@ def _bindings(tree: ast.Module) -> Dict[int, str]:
 def check(ctx: FileContext) -> List[Finding]:
     if ctx.tree is None or "Thread(" not in ctx.source:
         return []
-    credited = _collect_evidence(ctx.tree)
-    bound = _bindings(ctx.tree)
+    credited = _collect_evidence(ctx.nodes)
+    bound = _bindings(ctx.nodes)
     findings: List[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
             continue
         if _daemon_kwarg_ok(node):

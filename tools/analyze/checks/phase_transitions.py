@@ -1,0 +1,227 @@
+"""TJA013 phase-transition-exhaustiveness: the phase machine vs its
+declared legal-transition table.
+
+The job phase state machine is spread across ``controller/status.py`` (the
+``update_job_conditions`` helper and the update_status flow) and the
+reconcile loop -- nothing ever said which transitions are *legal*, so a new
+code path can quietly wire e.g. ``Succeed -> Running`` and resurrect a
+completed job.  ``api/constants.py`` now declares the table
+(``PHASE_TRANSITIONS``: source phase -> allowed targets, spellings from
+``api/types.py`` ``TrainingJobPhase``); this pass extracts the transition
+graph the code actually implements and diffs the two:
+
+- every ``update_job_conditions(job, TARGET, ...)`` call site's target must
+  be a phase the table allows *some* source to reach (unknown targets are
+  typos or undeclared machine growth);
+- when the call site is dominated by a positive phase test -- an ancestor
+  ``if`` comparing ``<job>.status.phase == TrainingJobPhase.X`` (or
+  ``in (X, Y)``) in the taken branch -- the witnessed ``(X, TARGET)`` pair
+  must be in the table.  Negative tests (``!=`` / ``not in``) and
+  un-tested call sites constrain nothing.
+
+``TrainingJobPhase.X`` attributes are decoded through the project symbol
+table (``api/types.py``), so ``PodPhase`` comparisons never participate.
+Same-phase refreshes are always legal.  Dynamic targets (variables like a
+computed ``ending_phase``) are skipped -- the runtime ``is_job_completed``
+guard owns those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ModuleInfo, ProjectContext
+from tools.analyze.runner import register_project
+
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+TYPES_REL = "trainingjob_operator_tpu/api/types.py"
+TABLE_NAME = "PHASE_TRANSITIONS"
+PHASE_CLASS = "TrainingJobPhase"
+TRANSITION_FNS = {"update_job_conditions"}
+
+
+def _load_table(const_mod: ModuleInfo) -> Dict[str, Set[str]]:
+    if const_mod.ctx is None or const_mod.ctx.tree is None:
+        return {}
+    for node in const_mod.ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == TABLE_NAME
+                and isinstance(node.value, ast.Dict)):
+            continue
+        table: Dict[str, Set[str]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            targets: Set[str] = set()
+            if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                targets = {el.value for el in v.elts
+                           if isinstance(el, ast.Constant)
+                           and isinstance(el.value, str)}
+            table[k.value] = targets
+        return table
+    return {}
+
+
+def _phase_names(pc: ProjectContext) -> Dict[str, str]:
+    """``TrainingJobPhase`` attribute name -> phase string value."""
+    types_mod = pc.ensure_module(TYPES_REL)
+    if types_mod is None:
+        return {}
+    ci = types_mod.classes.get(PHASE_CLASS)
+    return dict(ci.string_attrs) if ci is not None else {}
+
+
+def _phase_value(node: ast.expr, attr_to_value: Dict[str, str],
+                 const_values: Dict[str, str]) -> Optional[str]:
+    """The phase string an expression statically denotes, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in const_values.values() else None
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == PHASE_CLASS \
+                and node.attr in attr_to_value:
+            return attr_to_value[node.attr]
+    return None
+
+
+def _is_job_phase_expr(node: ast.expr) -> bool:
+    """True for ``<something not pod-like>.status.phase``."""
+    if not (isinstance(node, ast.Attribute) and node.attr == "phase"):
+        return False
+    status = node.value
+    if not (isinstance(status, ast.Attribute) and status.attr == "status"):
+        return False
+    leaf = status.value
+    name = leaf.id if isinstance(leaf, ast.Name) else (
+        leaf.attr if isinstance(leaf, ast.Attribute) else "")
+    return "pod" not in name.lower()
+
+
+class _SourceSets(ast.NodeVisitor):
+    """For every transition call site, the set of source phases witnessed by
+    dominating positive ``.status.phase`` tests (None = unconstrained)."""
+
+    def __init__(self, attr_to_value: Dict[str, str],
+                 const_values: Dict[str, str]):
+        self.attr_to_value = attr_to_value
+        self.const_values = const_values
+        self.stack: List[Set[str]] = []
+        self.sites: List[Tuple[ast.Call, Optional[Set[str]]]] = []
+
+    def _positive_sources(self, test: ast.expr) -> Optional[Set[str]]:
+        """Phases implied by ``test`` being true, from == / in comparisons
+        on a job ``.status.phase``; None when the test says nothing."""
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            out: Optional[Set[str]] = None
+            for v in test.values:
+                got = self._positive_sources(v)
+                if got is not None:
+                    out = got if out is None else (out & got)
+            return out
+        if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+            return None
+        if not _is_job_phase_expr(test.left):
+            return None
+        op, rhs = test.ops[0], test.comparators[0]
+        if isinstance(op, ast.Eq):
+            v = _phase_value(rhs, self.attr_to_value, self.const_values)
+            return {v} if v is not None else None
+        if isinstance(op, ast.In) and isinstance(rhs, (ast.Tuple, ast.List,
+                                                       ast.Set)):
+            vals = {_phase_value(el, self.attr_to_value, self.const_values)
+                    for el in rhs.elts}
+            vals.discard(None)
+            return set(vals) if vals else None
+        return None
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        src = self._positive_sources(node.test)
+        self.stack.append(src if src is not None else set())
+        pushed = src is not None
+        if not pushed:
+            self.stack.pop()
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            self.stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name in TRANSITION_FNS:
+            constrained: Optional[Set[str]] = None
+            for s in self.stack:
+                constrained = set(s) if constrained is None else (
+                    constrained & s)
+            self.sites.append((node, constrained))
+        self.generic_visit(node)
+
+
+@register_project("TJA013", "phase-transition-exhaustiveness")
+def check(pc: ProjectContext) -> List[Finding]:
+    const_mod = pc.ensure_module(CONSTANTS_REL)
+    if const_mod is None:
+        return []
+    table = _load_table(const_mod)
+    if not table:
+        return []
+    attr_to_value = _phase_names(pc)
+    all_targets: Set[str] = set()
+    for targets in table.values():
+        all_targets |= targets
+
+    findings: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or not rel.startswith("trainingjob_operator_tpu/"):
+            continue
+        if not any(fn in ctx.source for fn in TRANSITION_FNS):
+            continue   # cheap text gate before the structured If-stack walk
+        walker = _SourceSets(attr_to_value, dict(const_mod.constants))
+        walker.visit(ctx.tree)
+        for call, sources in walker.sites:
+            target_expr = None
+            for kw in call.keywords:
+                if kw.arg == "ctype":
+                    target_expr = kw.value
+            if target_expr is None and len(call.args) >= 2:
+                target_expr = call.args[1]
+            if target_expr is None:
+                continue
+            target = _phase_value(target_expr, attr_to_value,
+                                  {"_": t for t in all_targets | set(table)})
+            if target is None and isinstance(target_expr, ast.Attribute) \
+                    and isinstance(target_expr.value, ast.Name) \
+                    and target_expr.value.id == PHASE_CLASS:
+                # TrainingJobPhase attr we couldn't decode (types.py absent
+                # from the analyzed tree): skip rather than guess.
+                continue
+            if target is None:
+                continue   # dynamic target (ending_phase variable etc.)
+            if target not in all_targets:
+                findings.append(Finding(
+                    "TJA013", "phase-transition-exhaustiveness", rel,
+                    call.lineno, call.col_offset, ERROR,
+                    f"phase {target!r} is set here but no PHASE_TRANSITIONS "
+                    "entry (api/constants.py) allows any source to reach "
+                    "it; declare the transition or fix the target"))
+                continue
+            for src in sorted(sources or ()):
+                if src == target:
+                    continue   # same-phase refresh is always legal
+                if target not in table.get(src, set()):
+                    findings.append(Finding(
+                        "TJA013", "phase-transition-exhaustiveness", rel,
+                        call.lineno, call.col_offset, ERROR,
+                        f"illegal phase transition {src!r} -> {target!r}: "
+                        "the dominating phase test witnesses the source, "
+                        "but PHASE_TRANSITIONS (api/constants.py) does not "
+                        "allow it; fix the code path or extend the table"))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -18,6 +18,7 @@ Two layers:
 
 from __future__ import annotations
 
+import ast
 import io
 import tokenize
 from typing import List, Tuple
@@ -73,6 +74,8 @@ def _scan_fstring_token(tok: tokenize.TokenInfo) -> List[Tuple[int, int]]:
 
 def _fstring_backslash_positions(source: str) -> List[Tuple[int, int]]:
     hits: List[Tuple[int, int]] = []
+    if "\\" not in source:
+        return hits   # no backslash anywhere: skip the (costly) tokenize
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
     except (tokenize.TokenError, SyntaxError, IndentationError):
@@ -113,6 +116,9 @@ def check(ctx: FileContext) -> List[Finding]:
             f"does not parse under Python "
             f"{MIN_GRAMMAR[0]}.{MIN_GRAMMAR[1]} grammar: {msg}"))
         return findings
+    if "\\" not in ctx.source \
+            or not any(isinstance(n, ast.JoinedStr) for n in ctx.nodes):
+        return findings   # no f-string + backslash combo: skip the tokenize
     for line, col in _fstring_backslash_positions(ctx.source):
         findings.append(Finding(
             "TJA001", "py-compat", ctx.path, line, col, ERROR,

@@ -40,9 +40,9 @@ def _root_name(node: ast.expr) -> Optional[str]:
     return node.id if isinstance(node, ast.Name) else None
 
 
-def _imported_names(tree: ast.Module) -> Set[str]:
+def _imported_names(nodes: list) -> Set[str]:
     names: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Import):
             for alias in node.names:
                 names.add((alias.asname or alias.name).split(".")[0])
@@ -52,8 +52,8 @@ def _imported_names(tree: ast.Module) -> Set[str]:
     return names
 
 
-def _sleep_imported_from_time(tree: ast.Module) -> bool:
-    for node in ast.walk(tree):
+def _sleep_imported_from_time(nodes: list) -> bool:
+    for node in nodes:
         if isinstance(node, ast.ImportFrom) and node.module == "time":
             if any((a.asname or a.name) == "sleep" for a in node.names):
                 return True
@@ -64,17 +64,15 @@ def _sleep_imported_from_time(tree: ast.Module) -> bool:
 def check(ctx: FileContext) -> List[Finding]:
     if ctx.tree is None or not in_scope(ctx.path):
         return []
-    imported = _imported_names(ctx.tree)
-    bare_sleep = _sleep_imported_from_time(ctx.tree)
+    imported = _imported_names(ctx.by_type(ast.Import, ast.ImportFrom))
+    bare_sleep = _sleep_imported_from_time(ctx.by_type(ast.ImportFrom))
     findings: List[Finding] = []
 
     def emit(node: ast.AST, msg: str) -> None:
         findings.append(Finding("TJA003", "reconcile-purity", ctx.path,
                                 node.lineno, node.col_offset, ERROR, msg))
 
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in ctx.by_type(ast.Call):
         fn = node.func
         if isinstance(fn, ast.Attribute):
             root = _root_name(fn.value)

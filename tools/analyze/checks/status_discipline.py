@@ -71,9 +71,8 @@ def _exempt_lines(ctx: FileContext) -> Set[Tuple[int, int]]:
     if not ctx.path.endswith(suffix):
         return set()
     spans: Set[Tuple[int, int]] = set()
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in names:
+    for node in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
+        if node.name in names:
             spans.add((node.lineno, max(getattr(node, "end_lineno", node.lineno),
                                         node.lineno)))
     return spans
@@ -103,7 +102,7 @@ def check(ctx: FileContext) -> List[Finding]:
             "(controller/status.py) so the completed-job guard and "
             "condition-flip invariants hold"))
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.by_type(ast.Assign, ast.AugAssign, ast.Call):
         if isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) else [node.target]
             for target in targets:

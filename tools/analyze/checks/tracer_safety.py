@@ -83,11 +83,11 @@ def _static_nums(call: ast.Call) -> Set[int]:
     return out
 
 
-def _traced_functions(tree: ast.Module) -> Dict[str, ast.Call]:
+def _traced_functions(nodes: list) -> Dict[str, ast.Call]:
     """function name -> the tracing Call that wraps it (for statics)."""
     wrapped: Dict[str, ast.Call] = {}
     funcs: Dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             funcs[node.name] = node
             for dec in node.decorator_list:
@@ -137,14 +137,16 @@ def check(ctx: FileContext) -> List[Finding]:
     if not any(d in marked for d in SCOPE_DIRS):
         return []
     findings: List[Finding] = []
-    funcs = {n.name: n for n in ast.walk(ctx.tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    funcs = {n.name: n
+             for n in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)}
 
     def emit(node: ast.AST, severity: str, msg: str) -> None:
         findings.append(Finding("TJA006", "tracer-safety", ctx.path,
                                 node.lineno, node.col_offset, severity, msg))
 
-    for name, wrap in _traced_functions(ctx.tree).items():
+    for name, wrap in _traced_functions(
+            ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Call)).items():
         fn = funcs[name]
         traced = _traced_params(fn, wrap)
         for node in ast.walk(fn):

@@ -9,9 +9,10 @@ converge on.
 
 from __future__ import annotations
 
+import ast
 import hashlib
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 ERROR = "error"
 WARNING = "warning"
@@ -64,6 +65,36 @@ class FileContext:
     source: str
     lines: List[str] = field(default_factory=list)
     tree: object = None       # ast.Module | None when the file doesn't parse
+    _nodes: Optional[list] = field(default=None, repr=False)
+    _buckets: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def nodes(self) -> list:
+        """Every AST node in the file (``ast.walk`` order), computed once and
+        shared by all passes.  With a dozen passes each re-walking every
+        tree, the walk itself dominates analyzer wall-clock; passes that
+        scan the whole file iterate this list instead."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree)) if self.tree is not None \
+                else []
+        return self._nodes
+
+    def by_type(self, *types: type) -> list:
+        """Nodes of the given exact AST classes, bucketed once per file.
+        Most passes scan for one or two node kinds; iterating just those
+        buckets skips the isinstance sieve over the other ~95% of nodes.
+        Order is walk order within a class, concatenated across classes."""
+        if self._buckets is None:
+            buckets: dict = {}
+            for n in self.nodes:
+                buckets.setdefault(type(n), []).append(n)
+            self._buckets = buckets
+        if len(types) == 1:
+            return self._buckets.get(types[0], [])
+        out: list = []
+        for t in types:
+            out.extend(self._buckets.get(t, ()))
+        return out
 
     def waived(self, line: int, check_name: str) -> bool:
         """True when ``line`` (or the line above) carries an explicit waiver:

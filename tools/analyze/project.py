@@ -1,0 +1,514 @@
+"""Whole-program context for interprocedural passes (TJA010+).
+
+Per-file passes (TJA001-TJA009) see one ``FileContext`` at a time; the
+operator's hardest contracts are *cross-file*: env injected in
+``controller/pod.py`` and read in ``workloads/``, event reasons registered in
+``api/constants.py`` and emitted controller-wide, locks acquired across mixin
+boundaries.  ``ProjectContext`` is built **once per run** from the per-file
+ASTs the runner already parsed (no second parse, no I/O beyond the file walk
+that already happened), so the whole-program layer stays in the same
+milliseconds budget as the per-file layer.
+
+What it provides:
+
+- a **module symbol table**: dotted module name -> top-level classes (with
+  their methods, base names, lock-creating attributes, and inferred
+  ``self._x = ClassName(...)`` attribute types), functions, imports, and
+  string constants;
+- an **import graph** (project-internal edges only), so checks can resolve
+  ``constants.FOO`` / ``from x import y`` references to their definitions;
+- a **method-level call/lock summary** per function and method: which lock
+  attributes it acquires, which callables it may call, and which calls and
+  nested acquisitions happen *while a lock is held* -- the raw material for
+  the TJA010 lock-order graph;
+- resolution helpers: base-class lookup across modules, a flattened
+  mixin-aware method table (``mro_methods``), and class-attribute enum
+  reading (``class_string_attrs``, used to decode ``TrainingJobPhase.X``).
+
+Everything is a conservative, syntactic approximation: dynamic dispatch,
+monkey-patching and reflection are invisible.  That is the right trade for a
+pre-test lint -- the passes built on top only report what they can witness
+in the AST, and waivers cover the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import FileContext
+
+#: threading factories whose assignment makes an attribute "a lock".
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: Lock factories that are reentrant: a self-cycle on one is legal.
+REENTRANT_FACTORIES = {"RLock", "Condition"}
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_factory_name(value: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when ``value`` is a call to one."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name if name in LOCK_FACTORIES else None
+
+
+def module_name_for(rel_path: str) -> Optional[str]:
+    """Dotted module name for a repo-relative ``.py`` path."""
+    if not rel_path.endswith(".py"):
+        return None
+    parts = rel_path[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts:
+        return None
+    return ".".join(parts)
+
+
+@dataclass
+class MethodSummary:
+    """Call/lock facts for one function or method body."""
+    qual: str                               # "pkg.mod.Class.method" / "pkg.mod.fn"
+    node: ast.AST = None
+    #: lock attribute names acquired directly (``with self.X:`` / ``X.acquire()``).
+    acquires: Set[str] = field(default_factory=set)
+    #: raw callee expressions seen anywhere: ("self", name) | ("name", name)
+    #: | ("attr", recv_leaf, name) -- resolved lazily by the checks.
+    calls: List[tuple] = field(default_factory=list)
+    #: (held lock attr, callee tuple) for calls made while a lock is held.
+    held_calls: List[tuple] = field(default_factory=list)
+    #: (outer lock attr, inner lock attr, lineno) for directly nested acquires.
+    nested_acquires: List[Tuple[str, str, int]] = field(default_factory=list)
+    #: lock attr -> first acquisition lineno (for findings).
+    acquire_lines: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    node: ast.ClassDef = None
+    qual: str = ""                          # "pkg.mod.Class"
+    bases: List[str] = field(default_factory=list)   # raw (possibly dotted) names
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    #: attr name -> lock factory kind, for attrs assigned a Lock()/RLock()/...
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    #: attr name -> raw class-name string from ``self._x = ClassName(...)``.
+    attr_ctors: Dict[str, str] = field(default_factory=dict)
+    #: attr name -> string value, for simple ``NAME = "str"`` class attributes.
+    string_attrs: Dict[str, str] = field(default_factory=dict)
+    summaries: Dict[str, MethodSummary] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    ctx: FileContext = None
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, ast.AST] = field(default_factory=dict)
+    fn_summaries: Dict[str, MethodSummary] = field(default_factory=dict)
+    #: local alias -> dotted target ("pkg.api.constants", "pkg.mod.fn").
+    imports: Dict[str, str] = field(default_factory=dict)
+    #: top-level NAME = "literal" string assignments.
+    constants: Dict[str, str] = field(default_factory=dict)
+    #: module-level lock names -> factory kind.
+    module_locks: Dict[str, str] = field(default_factory=dict)
+    #: module-level singletons: NAME -> raw class-name string from
+    #: ``NAME = ClassName(...)`` (e.g. ``METRICS = MetricsRegistry()``).
+    global_ctors: Dict[str, str] = field(default_factory=dict)
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _BodyWalker:
+    """One pass over a function body collecting the MethodSummary facts,
+    tracking the stack of currently-held lock attributes."""
+
+    def __init__(self, summary: MethodSummary, lock_attrs: Set[str],
+                 module_locks: Set[str]):
+        self.s = summary
+        self.lock_attrs = lock_attrs
+        self.module_locks = module_locks
+
+    def _lock_of(self, expr: ast.expr) -> Optional[str]:
+        """Lock attr/name candidate for a ``with`` item.  Any plain
+        ``with self.X:`` is recorded (the lock may be *created* in a sibling
+        mixin this walker can't see; checks filter against the composed
+        class's MRO).  Bare names and call-wrapped forms must name a known
+        lock."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            return attr
+        if isinstance(expr, ast.Name):
+            return expr.id if expr.id in self.module_locks else None
+        if isinstance(expr, ast.Call):
+            fn = expr.func
+            if isinstance(fn, ast.Attribute):
+                attr = _self_attr(fn.value)
+                if attr is None and isinstance(fn.value, ast.Name) \
+                        and fn.value.id in self.module_locks:
+                    attr = fn.value.id
+                if attr is not None and (attr in self.lock_attrs
+                                         or attr in self.module_locks):
+                    return attr
+        return None
+
+    def _callee(self, call: ast.Call) -> Optional[tuple]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return ("name", fn.id)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                return ("self", fn.attr)
+            leaf = recv.id if isinstance(recv, ast.Name) else (
+                _self_attr(recv) or (recv.attr if isinstance(recv, ast.Attribute)
+                                     else None))
+            if leaf is not None:
+                return ("attr", leaf, fn.attr)
+        return None
+
+    def _record_acquire(self, lock: str, lineno: int, held: List[str]) -> None:
+        self.s.acquires.add(lock)
+        self.s.acquire_lines.setdefault(lock, lineno)
+        for outer in held:
+            if outer != lock:
+                self.s.nested_acquires.append((outer, lock, lineno))
+
+    def _record_call(self, call: ast.Call, held: List[str]) -> None:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+            lock = self._lock_of(fn.value)
+            if lock is not None:
+                self._record_acquire(lock, call.lineno, held)
+        callee = self._callee(call)
+        if callee is not None:
+            self.s.calls.append(callee + (call.lineno,))
+            for lock in held:
+                self.s.held_calls.append((lock, callee, call.lineno))
+
+    def walk(self, node: ast.AST, held: List[str]) -> None:
+        """Visit every descendant of ``node`` (not ``node`` itself),
+        maintaining the stack of held locks through ``with`` blocks."""
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, held)
+
+    def visit(self, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self._record_acquire(lock, node.lineno, inner)
+                    inner = inner + [lock]
+                else:
+                    if isinstance(item.context_expr, ast.Call):
+                        self._record_call(item.context_expr, held)
+                    self.walk(item.context_expr, held)
+            for stmt in node.body:
+                self.visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def/lambda is a deferred execution context (gauge
+            # callbacks, thread targets): it runs when *invoked*, not here,
+            # so neither its acquisitions nor its calls belong in this
+            # summary -- attributing them poisons the enclosing method's
+            # may-acquire set with scrape-time work.
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held)
+        self.walk(node, held)
+
+
+class ProjectContext:
+    """The whole analyzed tree, cross-referenced.  Built once per run."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.files: Dict[str, FileContext] = {}
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}    # qual -> info
+        self._mro_cache: Dict[str, Dict[str, Tuple[ClassInfo, ast.AST]]] = {}
+        self._subclass_map: Optional[Dict[str, List[ClassInfo]]] = None
+        self._mro_classes_cache: Dict[str, List[ClassInfo]] = {}
+        self._covers: Dict[str, bool] = {}
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def build(cls, root: str, contexts: Dict[str, FileContext]) -> "ProjectContext":
+        pc = cls(root)
+        pc.files = dict(contexts)
+        for rel, ctx in contexts.items():
+            if ctx.tree is None:
+                continue
+            mod = module_name_for(rel)
+            if mod is None:
+                continue
+            pc.modules[mod] = pc._index_module(mod, ctx)
+        for info in pc.modules.values():
+            for ci in info.classes.values():
+                pc.classes[ci.qual] = ci
+        return pc
+
+    def _index_module(self, mod: str, ctx: FileContext) -> ModuleInfo:
+        info = ModuleInfo(name=mod, ctx=ctx)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    prefix = mod.split(".")
+                    # level 1 = current package for a module file.
+                    prefix = prefix[:-node.level]
+                    base = ".".join(prefix + ([base] if base else []))
+                for alias in node.names:
+                    info.imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}" if base else alias.name
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    info.constants[name] = node.value.value
+                kind = _lock_factory_name(node.value)
+                if kind is not None:
+                    info.module_locks[name] = kind
+                elif isinstance(node.value, ast.Call):
+                    ctor = _dotted(node.value.func)
+                    if ctor is not None:
+                        info.global_ctors[name] = ctor
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                ci = self._index_class(mod, node)
+                info.classes[node.name] = ci
+        # Summaries need the full lock attr/module-lock sets, so second pass.
+        for ci in info.classes.values():
+            lock_names = set(ci.lock_attrs)
+            for name, m in ci.methods.items():
+                s = MethodSummary(qual=f"{ci.qual}.{name}", node=m)
+                _BodyWalker(s, lock_names, set(info.module_locks)).walk(m, [])
+                ci.summaries[name] = s
+        for name, fn in info.functions.items():
+            s = MethodSummary(qual=f"{mod}.{name}", node=fn)
+            _BodyWalker(s, set(), set(info.module_locks)).walk(fn, [])
+            info.fn_summaries[name] = s
+        return info
+
+    def _index_class(self, mod: str, node: ast.ClassDef) -> ClassInfo:
+        ci = ClassInfo(name=node.name, module=mod, node=node,
+                       qual=f"{mod}.{node.name}")
+        for b in node.bases:
+            d = _dotted(b)
+            if d is not None:
+                ci.bases.append(d)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[item.name] = item
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Assign):
+                        kind = _lock_factory_name(sub.value)
+                        ctor = None
+                        if kind is None and isinstance(sub.value, ast.Call):
+                            ctor = _dotted(sub.value.func)
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            if kind is not None:
+                                ci.lock_attrs[attr] = kind
+                            elif ctor is not None:
+                                ci.attr_ctors.setdefault(attr, ctor)
+            elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name) \
+                    and isinstance(item.value, ast.Constant) \
+                    and isinstance(item.value.value, str):
+                ci.string_attrs[item.targets[0].id] = item.value.value
+        return ci
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_module(self, from_mod: str, alias: str) -> Optional[ModuleInfo]:
+        """ModuleInfo for a local name (``constants`` after
+        ``from ..api import constants``)."""
+        info = self.modules.get(from_mod)
+        if info is None:
+            return None
+        target = info.imports.get(alias, alias)
+        return self.modules.get(target)
+
+    def resolve_class(self, from_mod: str, name: str) -> Optional[ClassInfo]:
+        """ClassInfo for a (possibly dotted) class name as written in
+        ``from_mod``."""
+        info = self.modules.get(from_mod)
+        if info is None:
+            return None
+        if "." in name:
+            head, _, rest = name.partition(".")
+            target = info.imports.get(head, head)
+            cand = self.classes.get(f"{target}.{rest}")
+            if cand is not None:
+                return cand
+            sub = self.modules.get(f"{target}")
+            if sub is not None and rest in sub.classes:
+                return sub.classes[rest]
+            return self.classes.get(f"{head}.{rest}")
+        if name in info.classes:
+            return info.classes[name]
+        target = info.imports.get(name)
+        if target is not None:
+            mod, _, cls_name = target.rpartition(".")
+            sub = self.modules.get(mod)
+            if sub is not None and cls_name in sub.classes:
+                return sub.classes[cls_name]
+        return None
+
+    def mro_methods(self, ci: ClassInfo) -> Dict[str, Tuple[ClassInfo, ast.AST]]:
+        """Flattened method table: name -> (defining class, node), walking
+        bases left-to-right depth-first (Python's MRO for the simple
+        mixin-composition shapes this codebase uses)."""
+        cached = self._mro_cache.get(ci.qual)
+        if cached is not None:
+            return cached
+        table: Dict[str, Tuple[ClassInfo, ast.AST]] = {}
+        seen: Set[str] = set()
+
+        def visit(c: ClassInfo) -> None:
+            if c.qual in seen:
+                return
+            seen.add(c.qual)
+            for name, node in c.methods.items():
+                table.setdefault(name, (c, node))
+            for b in c.bases:
+                base = self.resolve_class(c.module, b)
+                if base is not None:
+                    visit(base)
+
+        visit(ci)
+        self._mro_cache[ci.qual] = table
+        return table
+
+    def mro_classes(self, ci: ClassInfo) -> List[ClassInfo]:
+        cached = self._mro_classes_cache.get(ci.qual)
+        if cached is not None:
+            return cached
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+
+        def visit(c: ClassInfo) -> None:
+            if c.qual in seen:
+                return
+            seen.add(c.qual)
+            out.append(c)
+            for b in c.bases:
+                base = self.resolve_class(c.module, b)
+                if base is not None:
+                    visit(base)
+
+        visit(ci)
+        self._mro_classes_cache[ci.qual] = out
+        return out
+
+    def subclasses_including(self, ci: ClassInfo) -> List[ClassInfo]:
+        """Every class whose MRO contains ``ci`` (including itself) -- the
+        instance shapes a ``self.X`` access may run under."""
+        if self._subclass_map is None:
+            # One sweep inverting every class's MRO beats re-scanning all
+            # classes per query (callers hit this for every lock and call).
+            inv: Dict[str, List[ClassInfo]] = {}
+            for other in self.classes.values():
+                for c in self.mro_classes(other):
+                    inv.setdefault(c.qual, []).append(other)
+            self._subclass_map = inv
+        return list(self._subclass_map.get(ci.qual, []))
+
+    def class_string_attrs(self, from_mod: str, name: str) -> Dict[str, str]:
+        """``NAME -> "value"`` class attributes for an enum-style class as
+        referenced from ``from_mod`` (e.g. ``TrainingJobPhase``)."""
+        ci = self.resolve_class(from_mod, name)
+        return dict(ci.string_attrs) if ci is not None else {}
+
+    def module_of_path(self, rel_path: str) -> Optional[ModuleInfo]:
+        mod = module_name_for(rel_path)
+        return self.modules.get(mod) if mod else None
+
+    def covers_package(self, prefix: str) -> bool:
+        """True when every ``.py`` file on disk under ``prefix`` (repo-
+        relative directory) is in the analyzed set.  Absence-based passes
+        ("nothing reads X") gate on this so a single-file run doesn't turn
+        partial visibility into false whole-program claims."""
+        cached = self._covers.get(prefix)
+        if cached is not None:
+            return cached
+        base = os.path.join(self.root, prefix.replace("/", os.sep))
+        ok = os.path.isdir(base)
+        if ok:
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and not d.startswith(".")]
+                for fn in filenames:
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root).replace(os.sep, "/")
+                    if rel not in self.files:
+                        ok = False
+                        break
+                if not ok:
+                    break
+        self._covers[prefix] = ok
+        return ok
+
+    def ensure_module(self, rel_path: str) -> Optional[ModuleInfo]:
+        """ModuleInfo for a repo-relative path; when the file was not part of
+        the analyzed set (a subset run like ``tools.analyze foo.py``), parse
+        and index it from disk on demand so registry-backed checks still see
+        ``api/constants.py`` / ``api/types.py``."""
+        mod = module_name_for(rel_path)
+        if mod is None:
+            return None
+        if mod in self.modules:
+            return self.modules[mod]
+        abs_path = os.path.join(self.root, rel_path.replace("/", os.sep))
+        if not os.path.exists(abs_path):
+            return None
+        try:
+            with open(abs_path, "r", encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=rel_path)
+        except (OSError, SyntaxError):
+            return None
+        ctx = FileContext(path=rel_path, abs_path=abs_path, source=source,
+                          lines=source.splitlines())
+        ctx.tree = tree
+        info = self._index_module(mod, ctx)
+        self.modules[mod] = info
+        for ci in info.classes.values():
+            self.classes[ci.qual] = ci
+        return info

@@ -1,9 +1,12 @@
 """Check registry, file walking, baseline handling, and output formats.
 
-The analyzer is a milliseconds-scale pre-test gate (docs/STATIC_ANALYSIS.md):
-every pass works off one shared ``ast`` parse per file, so the whole repo is
-analyzed in well under a second -- cheap enough to run before every pytest
-invocation via tests/test_static_analysis.py and ``make lint``.
+The analyzer is a sub-second pre-test gate (docs/STATIC_ANALYSIS.md): every
+pass works off one shared ``ast`` parse -- and one shared ``ast.walk``
+(``FileContext.nodes``/``by_type``) -- per file, and the whole-program
+``ProjectContext`` is built once per run, so the package is analyzed in well
+under a second (``make lint`` asserts < 2 s repo-wide via ``--max-seconds``)
+-- cheap enough to run before every pytest invocation via
+tests/test_static_analysis.py and ``make lint``.
 
 Baseline protocol: ``--write-baseline`` snapshots the current findings as
 grandfathered; subsequent runs report only *new* findings (and exit 0 when
@@ -19,9 +22,16 @@ import os
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from tools.analyze.findings import ERROR, FileContext, Finding, fingerprint_all
+from tools.analyze.project import ProjectContext
 
 #: check_name -> (check_id, run callable).  Populated by @register.
 REGISTRY: Dict[str, Tuple[str, Callable[[FileContext], List[Finding]]]] = {}
+
+#: Whole-program passes: check_name -> (check_id, fn(ProjectContext)).
+#: These run once per invocation, after every file is parsed, against the
+#: shared ProjectContext (symbol table + import/call/lock graphs).
+PROJECT_REGISTRY: Dict[str, Tuple[str, Callable[[ProjectContext],
+                                                List[Finding]]]] = {}
 
 #: Directories never analyzed (vendored/output trees).
 SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
@@ -40,13 +50,32 @@ def register(check_id: str, check_name: str):
     return wrap
 
 
+def register_project(check_id: str, check_name: str):
+    """Decorator: install ``fn(ProjectContext) -> List[Finding]`` in
+    PROJECT_REGISTRY (whole-program, runs once per invocation)."""
+    def wrap(fn):
+        PROJECT_REGISTRY[check_name] = (check_id, fn)
+        fn.check_id, fn.check_name = check_id, check_name
+        return fn
+    return wrap
+
+
 def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
-        broad_except, constant_drift, event_reasons, lock_discipline,
-        orphaned_thread, py_compat, reconcile_purity, status_discipline,
-        tracer_safety,
+        broad_except, constant_drift, dead_reasons, env_contract,
+        event_reasons, lock_discipline, lock_order, metric_drift,
+        orphaned_thread, phase_transitions, py_compat, reconcile_purity,
+        status_discipline, tracer_safety,
     )
+
+
+def all_checks() -> Dict[str, str]:
+    """check_id -> check_name across both registries (loads them)."""
+    _load_checks()
+    out = {cid: name for name, (cid, _fn) in REGISTRY.items()}
+    out.update({cid: name for name, (cid, _fn) in PROJECT_REGISTRY.items()})
+    return out
 
 
 def iter_py_files(paths: Iterable[str], root: str) -> List[str]:
@@ -85,20 +114,40 @@ def run_checks(paths: Iterable[str], root: Optional[str] = None,
     _load_checks()
     root = root or os.getcwd()
     selected = REGISTRY
+    selected_project = PROJECT_REGISTRY
     if only:
         wanted = set(only)
-        selected = {name: pair for name, pair in REGISTRY.items()
+
+        def pick(registry):
+            return {name: pair for name, pair in registry.items()
                     if name in wanted or pair[0] in wanted}
-        unknown = wanted - set(selected) - {pair[0] for pair in selected.values()}
+
+        selected, selected_project = pick(REGISTRY), pick(PROJECT_REGISTRY)
+        matched = set(selected) | set(selected_project) \
+            | {pair[0] for pair in selected.values()} \
+            | {pair[0] for pair in selected_project.values()}
+        unknown = wanted - matched
         if unknown:
-            raise ValueError(f"unknown check(s): {sorted(unknown)}; "
-                             f"known: {sorted(REGISTRY)}")
+            raise ValueError(
+                f"unknown check(s): {sorted(unknown)}; "
+                f"known: {sorted(REGISTRY) + sorted(PROJECT_REGISTRY)}")
     findings: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
     for abs_path in iter_py_files(paths, root):
         ctx = make_context(abs_path, root)
+        contexts[ctx.path] = ctx
         for name, (_cid, fn) in selected.items():
             for f in fn(ctx):
                 if not ctx.waived(f.line, name):
+                    findings.append(f)
+    if selected_project:
+        # One shared whole-program context for every interprocedural pass,
+        # built from the per-file ASTs parsed above (no re-parse).
+        project = ProjectContext.build(root, contexts)
+        for name, (_cid, fn) in selected_project.items():
+            for f in fn(project):
+                fctx = contexts.get(f.path)
+                if fctx is None or not fctx.waived(f.line, name):
                     findings.append(f)
     findings.sort(key=Finding.sort_key)
     return findings

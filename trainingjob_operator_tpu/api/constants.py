@@ -109,6 +109,51 @@ SHARDY_ENV = "TRAININGJOB_SHARDY"
 # meshes): device.id // k becomes the slice id, letting the DCN-aware paths
 # run end-to-end on a forced-host-device mesh.
 VIRTUAL_DEVICES_PER_SLICE_ENV = "TRAININGJOB_VIRTUAL_DEVICES_PER_SLICE"
+# Pallas kernel selection for ops/ ("auto"/"force"/"off"/"interpret"; see
+# ops.use_pallas) and flash-attention block-size overrides for odd shapes.
+PALLAS_ENV = "TRAININGJOB_PALLAS"
+FA_BLOCK_Q_ENV = "TRAININGJOB_FA_BLOCK_Q"
+FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
+# Seconds without a produced batch before the prefetching loader declares the
+# producer dead (data/loader.py watchdog).
+PREFETCH_STALL_ENV = "TRAININGJOB_PREFETCH_STALL_S"
+
+#: Env vars that are part of the contract but *user-set* (pod template or
+#: operator environment), never injected by the controller: workload tuning
+#: knobs.  TJA011 env-contract treats membership here as the injection
+#: evidence -- a contract var in neither an injection site nor this set is
+#: dead surface.
+USER_ENV_KNOBS = frozenset((
+    COMPILE_CACHE_ENV,
+    PROFILE_DIR_ENV,
+    PROFILE_STEPS_ENV,
+    STEP_TIMES_ENV,
+    JAX_PLATFORM_ENV,
+    MODEL_FLOPS_ENV,
+    PEAK_FLOPS_ENV,
+    LOG_JSON_ENV,
+    TRACE_DIR_ENV,
+    SHARDY_ENV,
+    VIRTUAL_DEVICES_PER_SLICE_ENV,
+    PALLAS_ENV,
+    FA_BLOCK_Q_ENV,
+    FA_BLOCK_K_ENV,
+    PREFETCH_STALL_ENV,
+))
+
+#: Env vars the controller injects for consumers *outside* this codebase --
+#: libtpu/XLA read the TPU_WORKER_* pair and the MEGASCALE_* coordinator,
+#: and TRAININGJOB_PORTS is the reference operator's contract with arbitrary
+#: framework entrypoints.  TJA011 treats membership here as read evidence.
+EXTERNAL_CONSUMER_ENV = frozenset((
+    TPU_WORKER_ID_ENV,
+    TPU_WORKER_HOSTNAMES_ENV,
+    MEGASCALE_COORDINATOR_ENV,
+    PORTS_ENV,
+    # Injected for *user* workloads to adapt to the launching runtime; the
+    # bundled workloads don't need it (they are runtime-agnostic).
+    RUNTIME_ENV,
+))
 
 # --- GKE TPU node selectors / resources (north star: BASELINE.json) ---------
 GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
@@ -179,6 +224,33 @@ EVENT_REASONS = frozenset((
     SUCCESSFUL_CREATE_SERVICE_REASON,
     SUCCESSFUL_DELETE_SERVICE_REASON,
 ))
+
+# --- legal phase transitions (TJA013 phase-transition-exhaustiveness) -------
+# The phase state machine, declared: source phase -> phases the status
+# machine may move it to.  Spellings match api/types.py TrainingJobPhase
+# (this module cannot import types.py -- types.py imports it).  Same-phase
+# refreshes are always legal and not listed.  Ending phases are terminal
+# (update_job_conditions' is_job_completed guard enforces it at runtime;
+# the analyzer enforces it at lint time).
+PHASE_TRANSITIONS = {
+    "": ("Pending", "Creating", "Running", "Terminating", "Failed"),
+    "Pending": ("Creating", "Running", "Scaling", "Restarting", "Terminating",
+                "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Creating": ("Pending", "Running", "Scaling", "Restarting", "Terminating",
+                 "Succeed", "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Running": ("Pending", "Creating", "Scaling", "Restarting", "Terminating",
+                "Succeed", "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Restarting": ("Pending", "Creating", "Running", "Scaling", "Terminating",
+                   "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Scaling": ("Pending", "Creating", "Running", "Restarting", "Terminating",
+                "Succeed", "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Terminating": ("Succeed", "Failed", "Timeout", "Preempted", "NodeFail"),
+    "Succeed": (),
+    "Failed": (),
+    "Timeout": (),
+    "Preempted": (),
+    "NodeFail": (),
+}
 
 # --- fatal container-waiting reasons (reference: constants.go:46-56) --------
 ERROR_CONTAINER_STATUS = (

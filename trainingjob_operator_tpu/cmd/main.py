@@ -50,7 +50,9 @@ def build_backend(opt: OperatorOptions, args):
         from trainingjob_operator_tpu.runtime.kube import KubeRuntime
 
         clientset = KubeClientset.from_options(opt)
-        return clientset, KubeRuntime(clientset)
+        return clientset, KubeRuntime(
+            clientset, telemetry_port=args.telemetry_port,
+            telemetry_advertise=args.telemetry_advertise_addr)
     raise SystemExit(f"unknown backend {opt.backend!r}")
 
 
@@ -69,6 +71,16 @@ def main(argv: Optional[list] = None) -> int:
                              "/readyz, /debug/threads, /debug/traces, "
                              "/debug/events and /debug/steps on this port "
                              "(0 = disabled).")
+    parser.add_argument("--telemetry-port", type=int, default=0,
+                        help="Kube backend: listen on this port for workload "
+                             "step telemetry and inject the sink address "
+                             "into pods (0 = telemetry disabled).")
+    parser.add_argument("--telemetry-advertise-addr", default="",
+                        help="Kube backend: address workloads should dial "
+                             "for the telemetry sink (host[:port]); defaults "
+                             "to the operator pod's IP at the bound port. "
+                             "Set it when the operator sits behind a "
+                             "Service or hostNetwork remap.")
     parser.add_argument("--log-json", action="store_true",
                         help="Emit structured JSON log lines (one object per "
                              "line) instead of text.")

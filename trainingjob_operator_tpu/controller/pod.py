@@ -678,6 +678,8 @@ class PodReconciler:
                     message = f"{pod.status.reason}, {pod.status.message}"
             else:
                 message = ""
+            if any(code != 0 for code in exit_codes):
+                self._record_exited_with_code(job, pod, exit_codes, message)
             return TrainingJobPhase.FAILED, is_restart, message
 
         if is_creating:
@@ -687,6 +689,31 @@ class PodReconciler:
         if is_succeeded:
             return TrainingJobPhase.SUCCEEDED, is_restart, ""
         return TrainingJobPhase.NONE, is_restart, ""
+
+    def _record_exited_with_code(self, job: TPUTrainingJob, pod: Pod,
+                                 exit_codes: List[int], message: str) -> None:
+        """One ExitedWithCode event per failed pod incarnation.
+
+        reconcile_containers re-evaluates a Failed pod on every resync until
+        it is deleted, and EventRecorder has no dedup -- without the per-uid
+        guard a pod lingering at its restart limit would emit the same event
+        every sync period.
+        """
+        reported = getattr(self, "_exited_reported", None)
+        if reported is None:
+            reported = self._exited_reported = {}
+        uid = f"{pod.metadata.uid or pod.name}"
+        if uid in reported:
+            return
+        while len(reported) >= 2048:   # bound memory across job churn
+            reported.pop(next(iter(reported)))
+        reported[uid] = True
+        codes = sorted({code for code in exit_codes if code != 0})
+        self.recorder.event(
+            job, EventRecorder.WARNING, constants.EXITED_WITH_CODE_REASON,
+            f"pod {pod.name} container(s) exited with code(s) "
+            f"{', '.join(str(c) for c in codes)}"
+            + (f": {message}" if message else ""))
 
     def _check_creating_failure(self, job: TPUTrainingJob, pod: Pod,
                                 reason: str) -> str:
@@ -791,6 +818,17 @@ class PodReconciler:
         if spec.restart_policy:
             # The job-level restart machinery owns restarts; the kubelet must
             # not restart containers underneath it (pod.go:532-535).
+            if pod.spec.restart_policy and pod.spec.restart_policy != "Never":
+                # The user's template asked the kubelet for something else:
+                # surface the override (once per pod creation) instead of
+                # silently dropping their setting.
+                self.recorder.event(
+                    job, EventRecorder.WARNING,
+                    constants.POD_TEMPLATE_RESTART_POLICY_REASON,
+                    f"pod template restartPolicy "
+                    f"{pod.spec.restart_policy!r} of {pod.metadata.name} is "
+                    f"overridden to 'Never': the replica spec restart policy "
+                    f"({spec.restart_policy}) owns restarts")
             pod.spec.restart_policy = "Never"
 
         self.pod_control.create_pod(job.namespace, pod, job)

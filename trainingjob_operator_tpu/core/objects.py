@@ -377,7 +377,11 @@ class PodSpec:
     init_containers: List[Container] = field(default_factory=list)
     node_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
-    restart_policy: str = RestartPolicy.ALWAYS
+    #: "" = unset (the cluster's own defaulting applies, like a Go zero
+    #: value).  Keeping absence representable lets the pod plane warn about
+    #: an *explicit* template restartPolicy it overrides without also
+    #: warning on every manifest that simply omitted the field.
+    restart_policy: str = ""
     scheduler_name: str = ""
     host_network: bool = False
     subdomain: str = ""
@@ -414,7 +418,7 @@ class PodSpec:
             init_containers=[Container.from_dict(c) for c in d.get("initContainers") or []],
             node_name=d.get("nodeName", ""),
             node_selector=dict(d.get("nodeSelector") or {}),
-            restart_policy=d.get("restartPolicy", RestartPolicy.ALWAYS),
+            restart_policy=d.get("restartPolicy", ""),
             scheduler_name=d.get("schedulerName", ""),
             host_network=bool(d.get("hostNetwork", False)),
             subdomain=d.get("subdomain", ""),

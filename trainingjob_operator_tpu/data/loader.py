@@ -16,6 +16,8 @@ import queue
 import threading
 from typing import Any, Callable
 
+from trainingjob_operator_tpu.api import constants
+
 
 _DONE = object()
 
@@ -26,7 +28,7 @@ def _stall_timeout() -> float:
     0.1 s -- a zero/negative value would busy-spin the consumer or crash
     queue.get)."""
     try:
-        v = float(os.environ.get("TRAININGJOB_PREFETCH_STALL_S", "300")
+        v = float(os.environ.get(constants.PREFETCH_STALL_ENV, "300")
                   or 300)
     except ValueError:
         v = 300.0

@@ -13,11 +13,13 @@ the workload layer too (SURVEY.md §7).
 
 import os
 
+from trainingjob_operator_tpu.api import constants
+
 
 def use_pallas() -> bool:
     """Pallas on real TPU unless explicitly disabled; interpret mode when
     TRAININGJOB_PALLAS=interpret (testing the kernels off-TPU)."""
-    mode = os.environ.get("TRAININGJOB_PALLAS", "auto")
+    mode = os.environ.get(constants.PALLAS_ENV, "auto")
     if mode in ("0", "off"):
         return False
     if mode == "interpret":
@@ -30,7 +32,7 @@ def use_pallas() -> bool:
 def pallas_interpret() -> bool:
     import jax
 
-    return (os.environ.get("TRAININGJOB_PALLAS") == "interpret"
+    return (os.environ.get(constants.PALLAS_ENV) == "interpret"
             or jax.default_backend() != "tpu")
 
 

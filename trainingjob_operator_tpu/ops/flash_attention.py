@@ -457,8 +457,10 @@ def default_blocks() -> "tuple[int, int]":
     (read at trace time; the on-chip tuner sweeps these without code edits)."""
     import os
 
-    bq = int(os.environ.get("TRAININGJOB_FA_BLOCK_Q", "0") or 0)
-    bk = int(os.environ.get("TRAININGJOB_FA_BLOCK_K", "0") or 0)
+    from trainingjob_operator_tpu.api import constants
+
+    bq = int(os.environ.get(constants.FA_BLOCK_Q_ENV, "0") or 0)
+    bk = int(os.environ.get(constants.FA_BLOCK_K_ENV, "0") or 0)
     return (bq or 128, bk or 128)
 
 
