@@ -273,3 +273,37 @@ class TestMoEDecode:
         out = np.asarray(moe_decode.generate(params, prompt, cfg, steps=6))
         assert out.shape == (2, 6)
         assert out.min() >= 0 and out.max() < cfg.vocab_size
+
+    def test_prefill_warns_when_capacity_admits_drops(self):
+        """capacity_factor < n_experts/experts_per_token means prefill's
+        dispatch can drop tokens the dropless decode path would route --
+        prefill must say so."""
+        import dataclasses
+        import warnings
+
+        from trainingjob_operator_tpu.models import moe, moe_decode
+
+        cfg = dataclasses.replace(self._cfg(), capacity_factor=1.0)
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg.vocab_size)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            moe_decode.prefill(params, tokens, cfg, max_len=8)
+        assert any(issubclass(w.category, RuntimeWarning)
+                   and "capacity_factor" in str(w.message) for w in caught)
+
+    def test_prefill_quiet_with_ample_capacity(self):
+        import warnings
+
+        from trainingjob_operator_tpu.models import moe, moe_decode
+
+        cfg = self._cfg()  # capacity_factor == n_experts/experts_per_token
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg.vocab_size)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            moe_decode.prefill(params, tokens, cfg, max_len=8)
+        assert not [w for w in caught
+                    if "capacity_factor" in str(w.message)]

@@ -224,18 +224,21 @@ class TestReflector:
             conn.close()
 
         sock = socket.socket()
-        sock.bind(("127.0.0.1", 0))
-        sock.listen(1)
-        threading.Thread(target=half_open_server, args=(sock,),
-                         daemon=True).start()
-        host, port = sock.getsockname()
-        rest = RestClient(ClusterConfig(server=f"http://{host}:{port}"))
-        t0 = time.time()
-        with pytest.raises(OSError):  # socket timeout (TimeoutError)
-            # server_timeout=1 -> socket deadline 1 + max(5, .25) = 6 s.
-            for _ in rest.watch("/api/v1/pods", timeout_seconds=1):
-                pass
-        assert time.time() - t0 < 15, "watch did not time out client-side"
+        try:
+            sock.bind(("127.0.0.1", 0))
+            sock.listen(1)
+            threading.Thread(target=half_open_server, args=(sock,),
+                             daemon=True).start()
+            host, port = sock.getsockname()
+            rest = RestClient(ClusterConfig(server=f"http://{host}:{port}"))
+            t0 = time.time()
+            with pytest.raises(OSError):  # socket timeout (TimeoutError)
+                # server_timeout=1 -> socket deadline 1 + max(5, .25) = 6 s.
+                for _ in rest.watch("/api/v1/pods", timeout_seconds=1):
+                    pass
+            assert time.time() - t0 < 15, "watch did not time out client-side"
+        finally:
+            sock.close()
 
     def test_reflector_backs_off_on_persistent_5xx(self, server):
         """ADVICE r2 low: persistent 5xx must re-list with backoff, not in a

@@ -1048,6 +1048,518 @@ class TestDeadEventReason:
         assert findings == []
 
 
+# -- the CFG itself (tools/analyze/cfg.py) -----------------------------------
+
+def _cfg_of(source, name=None):
+    import ast
+
+    from tools.analyze import cfg as cfglib
+
+    tree = ast.parse(textwrap.dedent(source))
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    fn = fns[0] if name is None else next(f for f in fns if f.name == name)
+    return cfglib.build_cfg(fn)
+
+
+class TestCFGShapes:
+    def test_try_finally_duplicates_the_finalbody(self):
+        """The finally body exists twice: a normal-path copy reaching the
+        after block, and an exceptional copy whose tail re-raises outward --
+        the linearization TJA015/TJA019 rely on."""
+        c = _cfg_of("""
+        def f(acquire, use):
+            s = acquire()
+            try:
+                use(s)
+            finally:
+                s.close()
+        """)
+        labels = [b.label for b in c.blocks]
+        assert "finally" in labels and "finally-exc" in labels
+        exc_copy = next(b for b in c.blocks if b.label == "finally-exc")
+        # use(s) raises into the exceptional copy...
+        try_block = next(b for b in c.blocks if b.label == "try")
+        assert (exc_copy, "exc") in try_block.succs
+        # ...which runs the close and re-raises to the function's exc exit.
+        assert any(kind == "exc" and nxt is c.exc_exit
+                   for nxt, kind in exc_copy.succs)
+
+    def test_return_through_finally_runs_an_abrupt_copy(self):
+        c = _cfg_of("""
+        def f(cleanup):
+            try:
+                return 1
+            finally:
+                cleanup()
+        """)
+        abrupt = [b for b in c.blocks if b.label == "finally-abrupt"]
+        assert len(abrupt) == 1
+        # The abrupt copy drains into the normal exit, not exc_exit.
+        assert any(kind == "finally" and nxt is c.exit
+                   for nxt, kind in abrupt[0].succs)
+
+    def test_while_else_edges(self):
+        import ast
+
+        c = _cfg_of("""
+        def f(cond, step, wrapup, done):
+            while cond():
+                step()
+            else:
+                wrapup()
+            done()
+        """)
+        fn = c.func
+        while_stmt = fn.body[0]
+        head = c.block_of[id(while_stmt)]
+        kinds = {kind: nxt for nxt, kind in head.succs if kind != "exc"}
+        assert kinds["true"].label == "loop-body"
+        assert kinds["false"].label == "loop-else"
+        # The body's back edge returns to the head.
+        assert any(kind == "loop" and nxt is head
+                   for b in c.blocks for nxt, kind in b.succs)
+        assert isinstance(while_stmt, ast.While)
+
+    def test_while_true_has_no_false_edge(self):
+        c = _cfg_of("""
+        def f(step):
+            while True:
+                step()
+        """)
+        head = next(b for b in c.blocks if b.label == "loop-head")
+        assert not any(kind == "false" for _n, kind in head.succs)
+
+    def test_nested_with_bodies_share_the_block(self):
+        """``with`` introduces no kill point, so nested with bodies extend
+        the current straight-line block."""
+        c = _cfg_of("""
+        def f(a, b, use, after):
+            with a() as x:
+                with b() as y:
+                    use(x, y)
+            after()
+        """)
+        fn = c.func
+        outer = fn.body[0]
+        inner = outer.body[0]
+        use_stmt = inner.body[0]
+        assert (c.block_of[id(outer)] is c.block_of[id(inner)]
+                is c.block_of[id(use_stmt)])
+
+    def test_break_and_continue_edges_target_after_and_head(self):
+        c = _cfg_of("""
+        def f(items, bad, stop):
+            for it in items:
+                if bad(it):
+                    continue
+                if stop(it):
+                    break
+            return 0
+        """)
+        kinds = {kind for b in c.blocks for _n, kind in b.succs}
+        assert "continue" in kinds and "break" in kinds
+
+    def test_cfg_built_once_across_passes(self, tmp_path):
+        """TJA015 and TJA019 both need f's CFG; the FileContext memo means
+        exactly one build."""
+        from tools.analyze import cfg as cfglib
+
+        src = """
+        import socket
+
+        def f(host):
+            s = socket.socket()
+            busy = True
+            s.connect((host, 1))
+            busy = False
+            s.close()
+        """
+        before = cfglib.BUILD_COUNT
+        findings = analyze(tmp_path, "m.py", src,
+                           only=["resource-leak", "finally-state-restore"])
+        assert cfglib.BUILD_COUNT - before == 1
+        # Both passes also find their half of the seeded bug.
+        assert ids(findings) == ["TJA015", "TJA019"]
+
+
+# -- TJA015 resource-leak ----------------------------------------------------
+
+class TestResourceLeak:
+    def test_fires_on_exception_and_return_path_leaks(self, tmp_path):
+        src = """
+        import socket
+
+        def exc_leak(host):
+            s = socket.create_connection((host, 80))
+            s.sendall(b"hi")
+            s.close()
+
+        def return_leak(ready):
+            server = socket.socket()
+            server.bind(("", 0))
+            if ready():
+                return 1
+            server.close()
+            return 0
+        """
+        findings = analyze(tmp_path, "m.py", src, only=["resource-leak"])
+        assert ids(findings) == ["TJA015"]
+        assert len(findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "'s'" in msgs and "exception path" in msgs
+        assert "'server'" in msgs and "return path" in msgs
+
+    def test_quiet_on_with_finally_escape_and_handoff(self, tmp_path):
+        src = """
+        import socket
+        import threading
+
+        def managed(host):
+            with socket.create_connection((host, 80)) as s:
+                s.sendall(b"hi")
+
+        def closed_in_finally(host):
+            s = socket.create_connection((host, 80))
+            try:
+                s.sendall(b"hi")
+            finally:
+                s.close()
+
+        def handed_off(host, pool):
+            s = socket.create_connection((host, 80))
+            pool.append(s)
+
+        def returned(host):
+            s = socket.create_connection((host, 80))
+            return s
+
+        def started(work):
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+        """
+        assert analyze(tmp_path, "m.py", src, only=["resource-leak"]) == []
+
+    def test_factory_raising_does_not_leak_on_its_own_edge(self, tmp_path):
+        """gen is not applied on the exception edge of the acquiring
+        statement itself: if socket() raises, nothing was bound."""
+        src = """
+        import socket
+
+        def f():
+            s = socket.socket()
+            s.close()
+        """
+        assert analyze(tmp_path, "m.py", src, only=["resource-leak"]) == []
+
+
+# -- TJA016 lock-held-blocking-call ------------------------------------------
+
+class TestLockHeldBlockingCall:
+    def test_fires_on_blocking_io_under_with_lock(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/plane.py": """\
+                import threading
+                import time
+
+                _lock = threading.Lock()
+
+
+                def slow_flush(sock, payload):
+                    with _lock:
+                        sock.sendall(payload)
+
+
+                class Pacer:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def pace(self):
+                        with self._lock:
+                            time.sleep(1.0)
+                """}, only=["lock-held-blocking-call"])
+        assert ids(findings) == ["TJA016"]
+        assert len(findings) == 2
+        msgs = " | ".join(f.message for f in findings)
+        assert "sendall" in msgs and "sleep" in msgs
+
+    def test_fires_on_manual_acquire_path(self, tmp_path):
+        """Witness 3: the must-analysis over acquire()/release() pairs."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/manual.py": """\
+                import threading
+
+
+                def held_recv(sock):
+                    lock = threading.Lock()
+                    lock.acquire()
+                    data = sock.recv(1)
+                    lock.release()
+                    return data
+                """}, only=["TJA016"])
+        assert ids(findings) == ["TJA016"]
+
+    def test_quiet_when_io_moved_out_or_bounded(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/good.py": """\
+                import threading
+
+                _lock = threading.Lock()
+
+
+                def snapshot_then_send(sock, params):
+                    with _lock:
+                        snap = dict(params)
+                    sock.sendall(repr(snap).encode())
+
+
+                def bounded_get(q):
+                    with _lock:
+                        return q.get(timeout=0.5)
+
+
+                def released_before_io(sock):
+                    lock = threading.Lock()
+                    lock.acquire()
+                    try:
+                        payload = b"x"
+                    finally:
+                        lock.release()
+                    sock.sendall(payload)
+                """}, only=["TJA016"])
+        assert findings == []
+
+    def test_fires_transitively_through_a_callee(self, tmp_path):
+        """Witness 1: the held call blocks two hops away."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/deep.py": """\
+                import threading
+                import time
+
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def flush(self):
+                        with self._lock:
+                            self._drain()
+
+                    def _drain(self):
+                        self._settle()
+
+                    def _settle(self):
+                        time.sleep(0.5)
+                """}, only=["TJA016"])
+        assert ids(findings) == ["TJA016"]
+        assert any("sleep" in f.message for f in findings)
+
+
+# -- TJA017 exception-escape -------------------------------------------------
+
+class TestExceptionEscape:
+    def test_fires_on_thread_target_with_escaping_callee(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/srv.py": """\
+                import threading
+
+
+                def parse(frame):
+                    if not frame:
+                        raise ValueError("empty frame")
+                    return frame
+
+
+                def handle(conn):
+                    data = parse(conn)
+                    return data
+
+
+                def serve(conn):
+                    t = threading.Thread(target=handle, args=(conn,),
+                                         daemon=True)
+                    t.start()
+                    t.join()
+                """}, only=["exception-escape"])
+        assert ids(findings) == ["TJA017"]
+        (f,) = findings
+        assert "ValueError" in f.message
+        # Anchored at the spawn site, not inside the target.
+        assert f.line == 16
+
+    def test_quiet_when_target_catches(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/srv.py": """\
+                import threading
+
+
+                def parse(frame):
+                    raise ValueError("empty frame")
+
+
+                def handle(conn):
+                    try:
+                        parse(conn)
+                    except (ValueError, OSError) as e:
+                        print(e)
+
+
+                def serve(conn):
+                    t = threading.Thread(target=handle, args=(conn,),
+                                         daemon=True)
+                    t.start()
+                    t.join()
+                """}, only=["TJA017"])
+        assert findings == []
+
+    def test_quiet_without_a_spawn_site(self, tmp_path):
+        """Escapes are reported only at Thread(target=...) anchors."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/lib.py": """\
+                def boom():
+                    raise RuntimeError("not a thread target")
+                """}, only=["TJA017"])
+        assert findings == []
+
+    def test_handler_body_raises_are_not_caught_by_own_try(self, tmp_path):
+        """Handlers guard only the try *body*: a raise inside the handler
+        still escapes."""
+        findings = analyze_tree(tmp_path, {
+            "trainingjob_operator_tpu/srv.py": """\
+                import threading
+
+
+                def handle(conn):
+                    try:
+                        conn.recv(1)
+                    except OSError:
+                        raise RuntimeError("rethrown")
+
+
+                def serve(conn):
+                    t = threading.Thread(target=handle, args=(conn,),
+                                         daemon=True)
+                    t.start()
+                    t.join()
+                """}, only=["TJA017"])
+        assert ids(findings) == ["TJA017"]
+        assert "RuntimeError" in findings[0].message
+
+
+# -- TJA018 retry-without-backoff --------------------------------------------
+
+class TestRetryWithoutBackoff:
+    def test_fires_on_hot_while_retry(self, tmp_path):
+        src = """
+        def hammer(client):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    continue
+        """
+        findings = analyze(tmp_path, "m.py", src,
+                           only=["retry-without-backoff"])
+        assert ids(findings) == ["TJA018"]
+        (f,) = findings
+        assert f.severity == "warning" and "OSError" in f.message
+
+    def test_quiet_with_backoff_in_handler(self, tmp_path):
+        src = """
+        import time
+
+        def patient(client):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    time.sleep(0.5)
+        """
+        assert analyze(tmp_path, "m.py", src,
+                       only=["retry-without-backoff"]) == []
+
+    def test_quiet_on_timeout_only_handler(self, tmp_path):
+        """A blocking call that timed out already paced the loop."""
+        src = """
+        import socket
+
+        def poll(sock):
+            while True:
+                try:
+                    return sock.recv(1)
+                except socket.timeout:
+                    continue
+        """
+        assert analyze(tmp_path, "m.py", src,
+                       only=["retry-without-backoff"]) == []
+
+    def test_quiet_on_for_loop_and_non_swallowing_handler(self, tmp_path):
+        src = """
+        def sweep(client, items):
+            for it in items:
+                try:
+                    client.send(it)
+                except OSError:
+                    continue
+
+        def bounded(client):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    raise
+        """
+        assert analyze(tmp_path, "m.py", src,
+                       only=["retry-without-backoff"]) == []
+
+
+# -- TJA019 finally-state-restore --------------------------------------------
+
+class TestFinallyStateRestore:
+    def test_fires_on_restore_skipping_the_exception_path(self, tmp_path):
+        src = """
+        class Watchdog:
+            def drain(self, flush_replicas):
+                self._suspended = True
+                flush_replicas()
+                self._suspended = False
+        """
+        findings = analyze(tmp_path, "m.py", src,
+                           only=["finally-state-restore"])
+        assert ids(findings) == ["TJA019"]
+        (f,) = findings
+        assert "self._suspended" in f.message and "finally" in f.message
+        assert f.line == 4
+
+    def test_quiet_when_restored_in_finally(self, tmp_path):
+        src = """
+        class Watchdog:
+            def drain(self, flush_replicas):
+                self._suspended = True
+                try:
+                    flush_replicas()
+                finally:
+                    self._suspended = False
+        """
+        assert analyze(tmp_path, "m.py", src,
+                       only=["finally-state-restore"]) == []
+
+    def test_quiet_on_single_assignment_and_init(self, tmp_path):
+        src = """
+        class C:
+            def __init__(self):
+                self._ready = False
+                self.boot()
+                self._ready = True
+
+            def set_once(self, work):
+                self._armed = True
+                work()
+        """
+        assert analyze(tmp_path, "m.py", src,
+                       only=["finally-state-restore"]) == []
+
+
 # -- runner: baseline, waivers, formats, CLI ---------------------------------
 
 class TestRunnerMachinery:
@@ -1102,14 +1614,50 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_fourteen_checks_registered(self):
+    def test_all_nineteen_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
-            "TJA007", "TJA008", "TJA009"}
+            "TJA007", "TJA008", "TJA009", "TJA015", "TJA018", "TJA019"}
         assert {cid for cid, _fn in runner.PROJECT_REGISTRY.values()} == {
-            "TJA010", "TJA011", "TJA012", "TJA013", "TJA014"}
-        assert len(runner.all_checks()) == 14
+            "TJA010", "TJA011", "TJA012", "TJA013", "TJA014", "TJA016",
+            "TJA017"}
+        assert len(runner.all_checks()) == 19
+
+    def test_sarif_roundtrip(self):
+        err = Finding("TJA015", "resource-leak", "a/b.py", 7, 2, "error",
+                      "socket 's' leaks")
+        warn = Finding("TJA018", "retry-without-backoff", "m.py", 3, 0,
+                       "warning", "hot retry loop")
+        doc = json.loads(format_findings([err, warn], "sarif"))
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run,) = doc["runs"]
+        # Every registered check becomes a rule, so code-scanning can show
+        # titles for findings from any pass.
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert rule_ids == set(runner.all_checks())
+        first, second = run["results"]
+        assert first["ruleId"] == "TJA015" and first["level"] == "error"
+        assert first["message"]["text"] == "socket 's' leaks"
+        loc = first["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "a/b.py"
+        assert loc["region"] == {"startLine": 7, "startColumn": 2}
+        # col 0 clamps to SARIF's 1-based startColumn.
+        region2 = second["locations"][0]["physicalLocation"]["region"]
+        assert second["level"] == "warning" and region2["startColumn"] == 1
+
+    def test_cli_accepts_sarif_format(self, tmp_path):
+        (tmp_path / "bad.py").write_text(
+            "def f():\n    try:\n        g()\n"
+            "    except Exception:\n        pass\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", str(tmp_path),
+             "--no-baseline", "--format=sarif"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["runs"][0]["results"][0]["ruleId"] == "TJA004"
 
     def test_every_check_has_a_docs_row(self):
         """Self-check: each registered ID must have a catalog row in

@@ -441,6 +441,43 @@ class TestPSWireFormatHardening:
             a.close()
             b.close()
 
+    def test_rejects_oversized_frame_before_buffering(self):
+        """A hostile peer claiming a multi-GiB section must be refused from
+        the 8-byte header alone -- _recv_exact never buffers the payload."""
+        import socket
+        import struct
+
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">II", ps_worker.MAX_FRAME_BYTES + 1, 0))
+            with pytest.raises(ValueError, match="oversized"):
+                ps_worker.recv_msg(b)
+            a.sendall(struct.pack(">II", 8, ps_worker.MAX_FRAME_BYTES + 1))
+            with pytest.raises(ValueError, match="oversized"):
+                ps_worker.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_rejects_blob_bytes_metadata_does_not_account_for(self):
+        """Every blob byte must be consumed by the metadata's arrays; a
+        frame whose lengths disagree is rejected, not silently truncated."""
+        import json
+        import socket
+        import struct
+
+        meta = json.dumps({"x": {"__nd__": 0, "dtype": "float32",
+                                 "shape": [1]}}).encode()
+        blobs = b"\x00" * 8  # the one declared float32 consumes only 4
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">II", len(meta), len(blobs)) + meta + blobs)
+            with pytest.raises(ValueError, match="desync"):
+                ps_worker.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
 
 class TestLlamaConfigDispatch:
     def test_unknown_config_fails_loudly(self, monkeypatch, capsys):

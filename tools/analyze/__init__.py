@@ -1,8 +1,9 @@
 """AST-based operator-lint suite (docs/STATIC_ANALYSIS.md).
 
-Fourteen repo-specific passes over stdlib ``ast`` — nine per-file, five
+Nineteen repo-specific passes over stdlib ``ast`` — twelve per-file, seven
 whole-program (a ``ProjectContext`` built once per run over the shared
-per-file trees):
+per-file trees); the TJA015+ passes are *path-sensitive*, running gen-kill
+dataflow over lazily-built per-function CFGs (cfg.py, dataflow.py):
 
 =======  ==============================  =======================================
 ID       name                            what it catches
@@ -33,6 +34,16 @@ TJA012   metric-name-drift               emitted Prometheus names vs the
 TJA013   phase-transition-exhaustiveness update_job_conditions call sites vs
                                          the PHASE_TRANSITIONS legal table
 TJA014   dead-event-reason               EVENT_REASONS members nothing uses
+TJA015   resource-leak                   sockets/files/processes acquired but
+                                         not released on some exit path
+TJA016   lock-held-blocking-call         blocking I/O reachable while a lock
+                                         is held (transitive + path-sensitive)
+TJA017   exception-escape                thread targets an uncaught exception
+                                         can kill silently
+TJA018   retry-without-backoff           while-retry loops re-entering remote
+                                         I/O with no pause on the back edge
+TJA019   finally-state-restore           toggles restored on the normal path
+                                         but not the exception path
 =======  ==============================  =======================================
 
 Run: ``python -m tools.analyze trainingjob_operator_tpu/`` (see __main__.py).

@@ -21,7 +21,7 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", default=["trainingjob_operator_tpu"],
                     help="files or directories to analyze "
                          "(default: trainingjob_operator_tpu)")
-    ap.add_argument("--format", choices=("text", "json", "github"),
+    ap.add_argument("--format", choices=("text", "json", "github", "sarif"),
                     default="text")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON of grandfathered findings "

@@ -1,0 +1,458 @@
+"""Per-function control-flow graphs over the shared per-file ASTs.
+
+The per-file passes (TJA001-TJA009) and the whole-program layer (TJA010+)
+reason about *names*: what is called, what is acquired, what is emitted.  The
+operator's hardest reliability properties are about *paths*: a socket must be
+closed on every exception path, a flag flipped before a blocking call must be
+restored in a ``finally``, a retry loop must back off on its back edge.  This
+module gives each function body a small CFG so the path-sensitive passes
+(TJA015-TJA019) can witness those paths instead of guessing from lexical
+shape.
+
+Design (the CPython ``symtable``+compile split, staticcheck's function-body
+facts):
+
+- **Basic blocks** hold maximal straight-line statement runs.  Branch points
+  (``if``/``while``/``for``) keep the *branching statement itself* as the
+  block's last entry -- only its test/iter expression is evaluated there
+  (``stmt_expressions`` says exactly what a statement evaluates at its block
+  position).
+- **Edges** are labeled: ``fall``, ``true``/``false``, ``loop`` (back edge),
+  ``break``/``continue``, ``return``, ``except`` (dispatch -> handler),
+  ``finally`` and ``exc``/``raise`` (exceptional flow).
+- **Exceptions** are modeled at statement granularity: a statement *may
+  raise* when it is a ``raise``/``assert`` or evaluates a call.  Every block
+  with a raising statement gets one ``exc`` edge to the innermost active
+  handler -- a synthetic *dispatch* block fanning out to the ``except``
+  clauses -- or, uncaught, to the function's ``exc_exit``.
+- **finally** bodies are *duplicated per exit kind* (normal / exceptional /
+  return / break / continue), the textbook linearization: the exceptional
+  copy ends at the outer handler, so "the restore happens in a finally" is
+  visible as an ordinary kill on the exception path, no special-casing in
+  the dataflow clients.
+
+CFGs are built lazily and memoized on ``FileContext`` (``ctx.cfg(fn)``), so
+five passes asking for the same function share one build and the analyzer
+stays inside its 2 s ``--max-seconds`` budget.  ``BUILD_COUNT`` exists for
+the tests to prove exactly that.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Incremented by every real CFG construction; tests assert builds == number
+#: of distinct functions, i.e. the FileContext memo actually shares.
+BUILD_COUNT = 0
+
+#: Edge kinds considered *exceptional*: dataflow propagates ``exc_out`` (not
+#: ``out``) along these.
+EXC_KINDS = frozenset(("exc", "raise"))
+
+#: Edge kinds a normal-control-flow walk follows.
+NORMAL_KINDS = frozenset(("fall", "true", "false", "loop", "break",
+                          "continue", "return", "except", "finally", "case"))
+
+
+class Block:
+    """One basic block.  ``stmts`` are real AST nodes (statements, or an
+    ``ast.ExceptHandler`` marking the match+bind point at a handler entry);
+    ``raising`` is a parallel bool list (statement may raise here)."""
+
+    __slots__ = ("bid", "label", "stmts", "raising", "succs", "preds",
+                 "handlers")
+
+    def __init__(self, bid: int, label: str = ""):
+        self.bid = bid
+        self.label = label
+        self.stmts: List[ast.AST] = []
+        self.raising: List[bool] = []
+        self.succs: List[Tuple["Block", str]] = []
+        self.preds: List[Tuple["Block", str]] = []
+        #: dispatch blocks only: the (handler node, entry block) fan-out.
+        self.handlers: List[Tuple[ast.ExceptHandler, "Block"]] = []
+
+    def edge(self, other: "Block", kind: str) -> None:
+        if (other, kind) not in self.succs:
+            self.succs.append((other, kind))
+            other.preds.append((self, kind))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<B{self.bid}{':' + self.label if self.label else ''}>"
+
+
+class CFG:
+    """Control-flow graph of one function body."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.blocks: List[Block] = []
+        self.entry: Optional[Block] = None
+        #: normal exit: explicit returns and falling off the end.
+        self.exit: Optional[Block] = None
+        #: exceptional exit: exceptions no handler in the function catches.
+        self.exc_exit: Optional[Block] = None
+        #: id(stmt) -> first block holding it (unique except finally copies).
+        self.block_of: Dict[int, Block] = {}
+
+    def walk_blocks(self, start: Block, kinds: frozenset = NORMAL_KINDS
+                    ) -> Iterable[Block]:
+        """Blocks reachable from ``start`` along edges in ``kinds``."""
+        seen = {start.bid}
+        stack = [start]
+        while stack:
+            b = stack.pop()
+            yield b
+            for nxt, kind in b.succs:
+                if kind in kinds and nxt.bid not in seen:
+                    seen.add(nxt.bid)
+                    stack.append(nxt)
+
+    def reaches(self, start: Block, goal: Block,
+                blocked: Optional[set] = None,
+                kinds: frozenset = NORMAL_KINDS) -> bool:
+        """True when ``goal`` is reachable from ``start`` without entering a
+        block whose bid is in ``blocked`` (path-sensitive "is there a way
+        around the guard" queries)."""
+        blocked = blocked or set()
+        if start.bid in blocked:
+            return False
+        seen = {start.bid}
+        stack = [start]
+        while stack:
+            b = stack.pop()
+            if b.bid == goal.bid:
+                return True
+            for nxt, kind in b.succs:
+                if (kind in kinds and nxt.bid not in seen
+                        and nxt.bid not in blocked):
+                    seen.add(nxt.bid)
+                    stack.append(nxt)
+        return False
+
+
+def stmt_expressions(stmt: ast.AST) -> List[ast.expr]:
+    """The expressions a statement evaluates *at its block position*.  A
+    branching statement appears in a block only for its test/iter; its body
+    lives in successor blocks."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AnnAssign):
+        return [e for e in (stmt.value, stmt.target) if e is not None]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return list(stmt.decorator_list)   # the def executes; the body later
+    if isinstance(stmt, ast.ClassDef):
+        return list(stmt.decorator_list) + list(stmt.bases)
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.ExceptHandler):
+        return []
+    return []
+
+
+def may_raise(stmt: ast.AST) -> bool:
+    """Conservative witness that executing ``stmt`` at its block position can
+    raise: explicit raise/assert, or any call in its evaluated expressions.
+    Attribute/subscript faults are deliberately NOT counted -- every line
+    would then be an exception source and path findings would drown."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for expr in stmt_expressions(stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Call, ast.Await, ast.Yield,
+                                 ast.YieldFrom)):
+                return True
+    return False
+
+
+def handler_type_names(handler: ast.ExceptHandler) -> List[str]:
+    """Leaf exception-type names an ``except`` clause catches; ``["*"]``
+    for a bare ``except:``."""
+    t = handler.type
+    if t is None:
+        return ["*"]
+    items = t.elts if isinstance(t, ast.Tuple) else [t]
+    names: List[str] = []
+    for item in items:
+        node = item
+        while isinstance(node, ast.Attribute):
+            node = node.value  # socket.timeout -> keep the leaf attr below
+        if isinstance(item, ast.Attribute):
+            names.append(item.attr)
+        elif isinstance(item, ast.Name):
+            names.append(item.id)
+        else:
+            names.append("*")  # dynamic: assume it catches anything
+    return names
+
+
+def _catches_all(handlers: List[ast.ExceptHandler]) -> bool:
+    for h in handlers:
+        names = handler_type_names(h)
+        if "*" in names or "BaseException" in names or "Exception" in names:
+            return True
+    return False
+
+
+class _Frame:
+    """One active exception-routing frame: a handler dispatch block, or a
+    pending ``finally`` whose exceptional copy is built lazily."""
+
+    __slots__ = ("kind", "dispatch", "node", "exc_copy")
+
+    def __init__(self, kind: str, dispatch: Optional[Block] = None,
+                 node: Optional[ast.Try] = None):
+        self.kind = kind            # "dispatch" | "finally"
+        self.dispatch = dispatch
+        self.node = node
+        self.exc_copy: Optional[Block] = None   # memoized exceptional copy
+
+
+class _Builder:
+    def __init__(self, func: ast.AST):
+        self.cfg = CFG(func)
+        self._n = 0
+        self.cfg.entry = self.new_block("entry")
+        self.cfg.exit = self.new_block("exit")
+        self.cfg.exc_exit = self.new_block("exc-exit")
+        #: (head, after, frame-depth) per enclosing loop.
+        self.loops: List[Tuple[Block, Block, int]] = []
+
+    def new_block(self, label: str = "") -> Block:
+        b = Block(self._n, label)
+        self._n += 1
+        self.cfg.blocks.append(b)
+        return b
+
+    # -- exception routing ----------------------------------------------------
+
+    def exc_entry(self, frames: List[_Frame]) -> Block:
+        """Where an exception goes from under ``frames``: the innermost
+        dispatch, running any intervening ``finally`` copies on the way."""
+        if not frames:
+            return self.cfg.exc_exit
+        top, rest = frames[-1], frames[:-1]
+        if top.kind == "dispatch":
+            return top.dispatch
+        if top.exc_copy is None:
+            # Exceptional finally copy: runs the finalbody, then re-raises
+            # outward.  Built once per frame no matter how many blocks raise
+            # under it.
+            entry = self.new_block("finally-exc")
+            tail = self.build_stmts(top.node.finalbody, entry, rest)
+            if tail is not None:
+                tail.edge(self.exc_entry(rest), "exc")
+            top.exc_copy = entry
+        return top.exc_copy
+
+    def _finally_chain(self, frames: List[_Frame], upto: int,
+                       target: Block) -> Block:
+        """Entry block of the chain of finally copies an abrupt exit (return
+        / break / continue) runs while unwinding ``frames[upto:]`` down to
+        ``target``."""
+        for i in range(upto, len(frames)):
+            f = frames[i]
+            if f.kind != "finally":
+                continue
+            entry = self.new_block("finally-abrupt")
+            tail = self.build_stmts(f.node.finalbody, entry, frames[:i])
+            if tail is not None:
+                tail.edge(target, "finally")
+            target = entry
+        return target
+
+    # -- statement building ---------------------------------------------------
+
+    def append(self, block: Block, stmt: ast.AST,
+               frames: List[_Frame]) -> None:
+        block.stmts.append(stmt)
+        raising = may_raise(stmt)
+        block.raising.append(raising)
+        self.cfg.block_of.setdefault(id(stmt), block)
+        if raising:
+            block.edge(self.exc_entry(frames), "exc")
+
+    def build_stmts(self, stmts: List[ast.stmt], block: Block,
+                    frames: List[_Frame]) -> Optional[Block]:
+        """Build ``stmts`` starting in ``block``; returns the open block the
+        sequence falls out of, or None when control cannot fall through."""
+        for stmt in stmts:
+            if block is None:
+                block = self.new_block("unreachable")
+            block = self.build_stmt(stmt, block, frames)
+        return block
+
+    def build_stmt(self, stmt: ast.stmt, block: Block,
+                   frames: List[_Frame]) -> Optional[Block]:
+        if isinstance(stmt, ast.Return):
+            self.append(block, stmt, frames)
+            block.edge(self._finally_chain(frames, 0, self.cfg.exit),
+                       "return")
+            return None
+        if isinstance(stmt, ast.Raise):
+            self.append(block, stmt, frames)
+            # append() already added the exc edge; re-label for readers.
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            if not self.loops:
+                return block  # malformed; keep going
+            head, after, depth = self.loops[-1]
+            target = after if isinstance(stmt, ast.Break) else head
+            self.cfg.block_of.setdefault(id(stmt), block)
+            block.stmts.append(stmt)
+            block.raising.append(False)
+            kind = "break" if isinstance(stmt, ast.Break) else "continue"
+            block.edge(self._finally_chain(frames, depth, target), kind)
+            return None
+        if isinstance(stmt, ast.If):
+            self.append(block, stmt, frames)
+            after = self.new_block("after-if")
+            then_entry = self.new_block("then")
+            block.edge(then_entry, "true")
+            then_end = self.build_stmts(stmt.body, then_entry, frames)
+            if then_end is not None:
+                then_end.edge(after, "fall")
+            if stmt.orelse:
+                else_entry = self.new_block("else")
+                block.edge(else_entry, "false")
+                else_end = self.build_stmts(stmt.orelse, else_entry, frames)
+                if else_end is not None:
+                    else_end.edge(after, "fall")
+            else:
+                block.edge(after, "false")
+            return after if after.preds else None
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._build_loop(stmt, block, frames)
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, block, frames)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.append(block, stmt, frames)
+            return self.build_stmts(stmt.body, block, frames)
+        if isinstance(stmt, ast.Match):
+            self.append(block, stmt, frames)
+            after = self.new_block("after-match")
+            for case in stmt.cases:
+                entry = self.new_block("case")
+                block.edge(entry, "case")
+                end = self.build_stmts(case.body, entry, frames)
+                if end is not None:
+                    end.edge(after, "fall")
+            block.edge(after, "false")   # no case may match
+            return after
+        # Straight-line statement (incl. nested def/class: defining only).
+        self.append(block, stmt, frames)
+        return block
+
+    def _build_loop(self, stmt: ast.stmt, block: Block,
+                    frames: List[_Frame]) -> Optional[Block]:
+        head = self.new_block("loop-head")
+        block.edge(head, "fall")
+        self.append(head, stmt, frames)
+        after = self.new_block("after-loop")
+        body_entry = self.new_block("loop-body")
+        head.edge(body_entry, "true")
+        self.loops.append((head, after, len(frames)))
+        body_end = self.build_stmts(stmt.body, body_entry, frames)
+        self.loops.pop()
+        if body_end is not None:
+            body_end.edge(head, "loop")
+        test = getattr(stmt, "test", None)
+        infinite = (isinstance(stmt, ast.While)
+                    and isinstance(test, ast.Constant) and bool(test.value))
+        if stmt.orelse and not infinite:
+            else_entry = self.new_block("loop-else")
+            head.edge(else_entry, "false")
+            else_end = self.build_stmts(stmt.orelse, else_entry, frames)
+            if else_end is not None:
+                else_end.edge(after, "fall")
+        elif not infinite:
+            head.edge(after, "false")
+        return after if after.preds else None
+
+    def _build_try(self, stmt: ast.Try, block: Block,
+                   frames: List[_Frame]) -> Optional[Block]:
+        after = self.new_block("after-try")
+        outer = list(frames)
+        body_frames = list(frames)
+        fin_frame: Optional[_Frame] = None
+        if stmt.finalbody:
+            fin_frame = _Frame("finally", node=stmt)
+            body_frames.append(fin_frame)
+        handler_frames = list(body_frames)   # handler bodies: own try inactive
+        dispatch: Optional[Block] = None
+        if stmt.handlers:
+            dispatch = self.new_block("dispatch")
+            body_frames.append(_Frame("dispatch", dispatch=dispatch))
+
+        body_entry = self.new_block("try")
+        block.edge(body_entry, "fall")
+        body_end = self.build_stmts(stmt.body, body_entry, body_frames)
+        if body_end is not None and stmt.orelse:
+            # else runs only on clean body completion; its exceptions bypass
+            # the handlers (handler_frames, not body_frames).
+            body_end = self.build_stmts(stmt.orelse, body_end, handler_frames)
+
+        ends: List[Block] = [b for b in (body_end,) if b is not None]
+        if dispatch is not None:
+            for h in stmt.handlers:
+                entry = self.new_block("except")
+                dispatch.edge(entry, "except")
+                dispatch.handlers.append((h, entry))
+                self.append(entry, h, handler_frames)
+                h_end = self.build_stmts(h.body, entry, handler_frames)
+                if h_end is not None:
+                    ends.append(h_end)
+            if not _catches_all(stmt.handlers):
+                dispatch.edge(self.exc_entry(handler_frames), "exc")
+
+        if stmt.finalbody:
+            # Normal-path copy: one shared copy from every clean end.
+            fin_entry = self.new_block("finally")
+            fin_end = self.build_stmts(stmt.finalbody, fin_entry, outer)
+            if fin_end is not None:
+                fin_end.edge(after, "fall")
+            for e in ends:
+                e.edge(fin_entry, "finally")
+        else:
+            for e in ends:
+                e.edge(after, "fall")
+        return after if after.preds else None
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef body.  Prefer
+    ``FileContext.cfg(func)`` -- it memoizes per node."""
+    global BUILD_COUNT
+    BUILD_COUNT += 1
+    b = _Builder(func)
+    body = func.body if isinstance(func, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else [func]
+    end = b.build_stmts(list(body), b.cfg.entry, [])
+    if end is not None:
+        end.edge(b.cfg.exit, "fall")
+    return b.cfg
+
+
+def functions_in(tree: ast.AST) -> List[ast.AST]:
+    """Every (possibly nested) function definition in a module tree."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]

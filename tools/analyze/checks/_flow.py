@@ -1,0 +1,171 @@
+"""Shared helpers for the path-sensitive passes (TJA015-TJA019).
+
+Small, name-level classifiers (what blocks, what backs off, what is a lock)
+plus lexical-scope utilities (parent chains, own-body walks that stop at
+nested ``def``s).  Kept out of the individual check modules because TJA016
+and TJA018 share the blocking/backoff vocabulary and all five share the
+scope utilities.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.analyze.findings import FileContext
+from tools.analyze.project import LOCK_FACTORIES
+
+#: Method names that block unconditionally (socket/HTTP/process I/O).
+BLOCKING_ATTRS = {"sleep", "sendall", "recv", "recvfrom", "accept",
+                  "connect", "getresponse", "communicate", "select"}
+
+#: Method names that block only when called with no positional argument and
+#: no ``timeout=`` (reconcile-purity's unbounded-wait rule): ``lock.acquire()``
+#: blocks, ``d.get(key)`` and ``",".join(parts)`` do not.
+UNBOUNDED_ATTRS = {"wait", "join", "acquire", "get"}
+
+#: Fully-dotted callables that block.
+BLOCKING_DOTTED = {"time.sleep", "socket.create_connection",
+                   "subprocess.run", "subprocess.check_output",
+                   "subprocess.check_call", "select.select"}
+
+
+def parents_of(ctx: FileContext) -> Dict[int, ast.AST]:
+    """id(node) -> parent for every node in the file, recorded by the same
+    single sweep that fills ``ctx.nodes`` (FileContext.parents)."""
+    return ctx.parents
+
+
+def enclosing(parents: Dict[int, ast.AST], node: ast.AST,
+              *types: type) -> Optional[ast.AST]:
+    """Nearest strict ancestor of ``node`` of one of ``types``."""
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, types):
+            return cur
+        cur = parents.get(id(cur))
+    return None
+
+
+def walk_local(root: ast.AST) -> Iterator[ast.AST]:
+    """Every node lexically in ``root``'s body, *excluding* nested
+    function/class bodies (deferred execution contexts) -- the same rule
+    project.py's _BodyWalker applies.  ``root`` itself is not yielded.
+
+    The node list is cached on ``root`` itself: seven call sites across the
+    path-sensitive passes sweep the same functions, and re-walking each body
+    per pass dominated the analyzer's --max-seconds budget."""
+    cached = getattr(root, "_tja_local_walk", None)
+    if cached is None:
+        cached = []
+        stack = [root]
+        first = True
+        while stack:
+            node = stack.pop()
+            if first:
+                first = False  # root itself: descend but do not yield
+            else:
+                cached.append(node)
+                if node.__class__ in _LOCAL_BARRIERS:
+                    continue
+            # Inlined iter_child_nodes: the generator-pair overhead per node
+            # is a visible slice of the analyzer's wall-clock budget.
+            for name in node._fields:
+                v = getattr(node, name, None)
+                if v.__class__ is list:
+                    for item in v:
+                        if isinstance(item, ast.AST):
+                            stack.append(item)
+                elif isinstance(v, ast.AST):
+                    stack.append(v)
+        root._tja_local_walk = cached
+    return iter(cached)
+
+
+_LOCAL_BARRIERS = {ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef}
+
+
+def call_dotted(call: ast.Call) -> Optional[str]:
+    """'time.sleep' / 'server.accept' / 'open' for a call's func chain."""
+    parts: List[str] = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return (bool(call.args)
+            or any(kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None)
+                for kw in call.keywords))
+
+
+def blocking_reason(call: ast.Call) -> Optional[str]:
+    """A short description when ``call`` is a blocking operation, else None.
+    Purely name-level; callers layer interprocedural may-block on top."""
+    dotted = call_dotted(call)
+    if dotted in BLOCKING_DOTTED or dotted == "sleep":
+        return dotted
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in BLOCKING_ATTRS:
+            return f"{dotted or fn.attr}()"
+        if fn.attr in UNBOUNDED_ATTRS and not _has_timeout(call):
+            return f"unbounded {dotted or fn.attr}()"
+    return None
+
+
+def is_backoff_call(call: ast.Call) -> bool:
+    """True for calls that pause before the next attempt: ``time.sleep``,
+    bounded ``wait(timeout)``, or anything named like a backoff helper."""
+    dotted = call_dotted(call) or ""
+    leaf = dotted.rsplit(".", 1)[-1]
+    if leaf == "sleep" or "backoff" in leaf.lower():
+        return True
+    if leaf == "wait" and isinstance(call.func, ast.Attribute) \
+            and _has_timeout(call):
+        return True
+    return False
+
+
+def local_lock_names(fn: ast.AST) -> Set[str]:
+    """Names bound to ``threading.Lock()``-family factories in ``fn``'s own
+    body (nested defs excluded) -- function-local and closure locks, which
+    project.py summaries deliberately do not model."""
+    out: Set[str] = set()
+    for node in walk_local(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = None
+            f = node.value.func
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+            if name in LOCK_FACTORIES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def scope_lock_names(parents: Dict[int, ast.AST], fn: ast.AST) -> Set[str]:
+    """Lock names visible to ``fn`` lexically: its own plus every enclosing
+    function's (closures like ps_worker's ``handle``)."""
+    out = local_lock_names(fn)
+    cur = enclosing(parents, fn, ast.FunctionDef, ast.AsyncFunctionDef)
+    while cur is not None:
+        out |= local_lock_names(cur)
+        cur = enclosing(parents, cur, ast.FunctionDef, ast.AsyncFunctionDef)
+    return out
+
+
+def functions_of(ctx: FileContext) -> List[ast.AST]:
+    """Every function definition in the file, nested included (the shared
+    by_type buckets make this a dict lookup, not a walk)."""
+    return ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)

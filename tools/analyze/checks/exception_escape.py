@@ -1,0 +1,256 @@
+"""TJA017 exception-escape: thread targets that can die silently.
+
+A ``threading.Thread`` target that lets an exception propagate doesn't crash
+the process -- the thread prints a traceback (or not, under a redirected
+stderr) and *vanishes*, while everything that depended on it waits forever:
+the pserver's ``handle`` thread dying on one malformed frame leaves the
+worker blocked in ``recv`` for the rest of the job; a controller worker loop
+dying strands every job hashed to it.  The reference operator's restart
+machine exists precisely because silent partial death is the worst failure
+mode.
+
+The pass computes, per function, the set of exception type names that can
+*escape* it:
+
+- explicit ``raise TypeName(...)`` sites and ``assert`` statements in the
+  function's own body (nested defs excluded -- deferred contexts);
+- transitively, escapes of resolvable callees: nested functions by lexical
+  name, module functions (directly or via imports), ``self.`` methods
+  through the project MRO;
+- minus whatever enclosing ``try``/``except`` clauses catch *at that site*
+  (lexical nesting gives exact handler scoping: handlers guard only the
+  ``try`` body, not their own bodies or the ``else``).
+
+A whole-project fixpoint closes recursion.  Findings fire only for **thread
+entry points** -- functions passed as ``Thread(target=...)`` (or ``run``
+methods of ``Thread`` subclasses) -- anchored at the spawn site.  Unresolved
+callees contribute nothing: this pass reports witnesses, not absence proofs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext, _self_attr
+from tools.analyze.runner import register_project
+from tools.analyze.checks._flow import (
+    call_dotted, enclosing, functions_of, parents_of, walk_local,
+)
+from tools.analyze.cfg import handler_type_names
+
+#: Deliberate process/thread teardown channels, never "silent death".
+EXEMPT = {"SystemExit", "KeyboardInterrupt", "GeneratorExit", "StopIteration"}
+
+
+def _raise_types(stmt: ast.Raise, parents) -> Set[str]:
+    exc = stmt.exc
+    if exc is None:
+        # bare re-raise: escapes whatever the enclosing handler caught.
+        h = enclosing(parents, stmt, ast.ExceptHandler)
+        return set(handler_type_names(h)) if h is not None else {"*"}
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Attribute):
+        return {exc.attr}
+    if isinstance(exc, ast.Name):
+        # ``raise ValueError`` (class) vs ``raise err`` (instance var):
+        # CamelCase names are types, lowercase ones are opaque re-raises.
+        return {exc.id} if exc.id[:1].isupper() else {"*"}
+    return {"*"}
+
+
+def _caught_at(site: ast.AST, fn: ast.AST, parents) -> Tuple[Set[str], bool]:
+    """(caught type names, catches_everything) from the ``try`` statements
+    whose *body* lexically contains ``site``, walking out to ``fn``."""
+    caught: Set[str] = set()
+    cur = site
+    node = parents.get(id(cur))
+    while node is not None and cur is not fn:
+        if isinstance(node, ast.Try) and any(b is cur for b in node.body):
+            for h in node.handlers:
+                names = set(handler_type_names(h))
+                caught |= names
+                if names & {"*", "BaseException", "Exception"}:
+                    return caught, True
+        cur, node = node, parents.get(id(node))
+    return caught, False
+
+
+class _Escapes:
+    """Per-function escape sets with a project-wide fixpoint."""
+
+    def __init__(self, pc: ProjectContext):
+        self.pc = pc
+        self.sets: Dict[int, Set[str]] = {}
+        self.sites: List[Tuple[ast.AST, dict, Optional[str],
+                               Optional[str]]] = []
+        # (fn node, parents map, module name, class name) per function.
+        self.by_name: Dict[Tuple[str, str], ast.AST] = {}
+        self._resolved: Dict[int, List[ast.AST]] = {}
+        self._caught: Dict[int, Tuple[Set[str], bool]] = {}
+        #: id(fn) -> {name: nested def node} directly inside fn's body.
+        self._local_defs: Dict[int, Dict[str, ast.AST]] = {}
+
+    def index(self) -> None:
+        for rel, ctx in self.pc.files.items():
+            if ctx.tree is None:
+                continue
+            mod = self.pc.module_of_path(rel)
+            parents = parents_of(ctx)
+            for fn in functions_of(ctx):
+                cls = enclosing(parents, fn, ast.ClassDef)
+                self.sites.append((fn, parents, mod.name if mod else None,
+                                   cls.name if cls else None))
+                self.sets[id(fn)] = set()
+                self._local_defs[id(fn)] = {
+                    n.name: n for n in walk_local(fn)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+
+    def _callee_nodes(self, call: ast.Call, fn: ast.AST, parents,
+                      mod_name: Optional[str],
+                      cls_name: Optional[str]) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        f = call.func
+        mod = self.pc.modules.get(mod_name) if mod_name else None
+        if isinstance(f, ast.Name):
+            # lexically visible nested def, walking enclosing functions out.
+            scope = fn
+            while scope is not None:
+                hit = self._local_defs.get(id(scope), {}).get(f.id)
+                if hit is not None:
+                    return [hit]
+                scope = enclosing(parents, scope, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)
+            if mod is not None:
+                if f.id in mod.functions:
+                    return [mod.functions[f.id]]
+                target = mod.imports.get(f.id)
+                if target:
+                    tmod, _, leaf = target.rpartition(".")
+                    mi = self.pc.modules.get(tmod)
+                    if mi is not None and leaf in mi.functions:
+                        return [mi.functions[leaf]]
+        elif isinstance(f, ast.Attribute):
+            attr = _self_attr(f.value)
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and mod is not None and cls_name in (mod.classes or {}):
+                ci = mod.classes[cls_name]
+                hit = self.pc.mro_methods(ci).get(f.attr)
+                if hit is not None:
+                    return [hit[1]]
+            dotted = call_dotted(call)
+            if dotted and mod is not None:
+                head, _, leaf = dotted.rpartition(".")
+                mi = self.pc.modules.get(mod.imports.get(head, head))
+                if mi is not None and leaf in mi.functions:
+                    return [mi.functions[leaf]]
+        return out
+
+    def solve(self) -> None:
+        """One AST sweep precomputes, per function, the constant escapes
+        (own raises/asserts, handler-filtered) and the call dependencies
+        (callee fn id + caught filter at the site); the fixpoint then
+        iterates only that structure -- no re-walking per round."""
+        self.index()
+        const: Dict[int, Set[str]] = {}
+        deps: Dict[int, List[Tuple[int, Set[str]]]] = {}
+        for fn, parents, mod_name, cls_name in self.sites:
+            fid = id(fn)
+            const[fid] = set()
+            deps[fid] = []
+            for node in walk_local(fn):
+                types: Set[str] = set()
+                callees: List[ast.AST] = []
+                if isinstance(node, ast.Raise):
+                    types = _raise_types(node, parents)
+                elif isinstance(node, ast.Assert):
+                    types = {"AssertionError"}
+                elif isinstance(node, ast.Call):
+                    callees = self._callee_nodes(node, fn, parents,
+                                                 mod_name, cls_name)
+                if not types and not callees:
+                    continue
+                caught, all_caught = _caught_at(node, fn, parents)
+                if all_caught:
+                    continue
+                const[fid] |= {t for t in types
+                               if t not in caught and t not in EXEMPT}
+                for callee in callees:
+                    deps[fid].append((id(callee), caught))
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for fid, acc in self.sets.items():
+                before = len(acc)
+                acc |= const.get(fid, set())
+                for callee_id, caught in deps.get(fid, ()):
+                    acc |= {t for t in self.sets.get(callee_id, set())
+                            if t not in caught and t not in EXEMPT}
+                if len(acc) != before:
+                    changed = True
+
+
+def _target_functions(pc: ProjectContext, esc: _Escapes
+                      ) -> List[Tuple[str, int, str, ast.AST]]:
+    """(path, spawn line, printable name, fn node) per thread entry point."""
+    out = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None:
+            continue
+        mod = pc.module_of_path(rel)
+        parents = parents_of(ctx)
+        for call in ctx.by_type(ast.Call):
+            dotted = call_dotted(call)
+            if dotted not in ("threading.Thread", "Thread"):
+                continue
+            tgt = next((kw.value for kw in call.keywords
+                        if kw.arg == "target"), None)
+            if tgt is None:
+                continue
+            node: Optional[ast.AST] = None
+            label = ast.unparse(tgt) if hasattr(ast, "unparse") else "target"
+            if isinstance(tgt, ast.Name):
+                fn = enclosing(parents, call, ast.FunctionDef,
+                               ast.AsyncFunctionDef)
+                hits = esc._callee_nodes(
+                    ast.Call(func=tgt, args=[], keywords=[]), fn or ctx.tree,
+                    parents, mod.name if mod else None, None)
+                node = hits[0] if hits else None
+                if node is None and mod is not None \
+                        and tgt.id in mod.functions:
+                    node = mod.functions[tgt.id]
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and mod is not None:
+                cls = enclosing(parents, call, ast.ClassDef)
+                if cls is not None and cls.name in mod.classes:
+                    hit = pc.mro_methods(mod.classes[cls.name]).get(tgt.attr)
+                    node = hit[1] if hit is not None else None
+            if node is not None:
+                out.append((rel, call.lineno, label, node))
+    return out
+
+
+@register_project("TJA017", "exception-escape")
+def check(pc: ProjectContext) -> List[Finding]:
+    esc = _Escapes(pc)
+    esc.solve()
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for rel, line, label, fn in _target_functions(pc, esc):
+        types = sorted(esc.sets.get(id(fn), set()) - EXEMPT)
+        if not types or (rel, line) in seen:
+            continue
+        seen.add((rel, line))
+        findings.append(Finding(
+            "TJA017", "exception-escape", rel, line, 0, ERROR,
+            f"thread target {label} can die silently: "
+            f"{', '.join(types)} escape(s) uncaught -- wrap the loop body "
+            f"in try/except and log (a dead thread hangs its peers)"))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -1,0 +1,109 @@
+"""TJA019 finally-state-restore: restores that skip the exception path.
+
+The toggle-around-a-blocking-region idiom::
+
+    self._suspended = True
+    drain_replicas()          # can raise
+    self._suspended = False   # never runs on the exception path
+
+leaves the flag stuck when the region raises: the watchdog stays suspended
+forever, the pacer never resumes, the guard never re-arms.  The restore
+belongs in a ``finally`` -- and because cfg.py duplicates ``finally`` bodies
+onto the exceptional copies, a correctly-written restore is an ordinary kill
+on the exception path and produces no finding.
+
+Formulation (forward *may* analysis, facts = individual toggle assignments):
+
+- **gen** at ``X = <constant>`` / ``self.a = <constant>`` where the constant
+  is a bool/None sentinel (toggles, not arithmetic);
+- **kill** at any other assignment to the same target (the restore);
+  ``AugAssign`` neither gens nor kills -- counters are not toggles.
+
+A toggle is flagged iff it is live into ``exc_exit`` but **not** live into
+``exit``: every normal path restores it (so the author demonstrably intends
+restoration) while some exception path does not.  The not-live-at-exit
+requirement is what keeps ordinary init-then-update assignments quiet.
+``__init__`` is excluded wholesale -- constructors initialize, they don't
+toggle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze import dataflow
+from tools.analyze.findings import FileContext, Finding, WARNING
+from tools.analyze.runner import register
+from tools.analyze.checks._flow import functions_of, walk_local
+
+
+def _toggle_target(stmt: ast.AST) -> Optional[str]:
+    """'name' / 'self.attr' for a single-target assignment, else None."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    t = stmt.targets[0]
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return f"self.{t.attr}"
+    return None
+
+
+def _is_sentinel(value: ast.expr) -> bool:
+    return isinstance(value, ast.Constant) \
+        and (value.value is None or isinstance(value.value, bool))
+
+
+class _Toggles(dataflow.Analysis):
+    """Facts: (target, id(assign stmt), lineno)."""
+
+    may = True
+
+    def gen(self, stmt: ast.AST):
+        tgt = _toggle_target(stmt)
+        if tgt is not None and _is_sentinel(stmt.value):
+            return [(tgt, id(stmt), stmt.lineno)]
+        return []
+
+    def kill(self, stmt: ast.AST, facts):
+        tgt = _toggle_target(stmt)
+        if tgt is None:
+            return []
+        return [f for f in facts if f[0] == tgt and f[1] != id(stmt)]
+
+
+@register("TJA019", "finally-state-restore")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    findings: List[Finding] = []
+    analysis = _Toggles()
+    for fn in functions_of(ctx):
+        if fn.name == "__init__":
+            continue
+        # Cheap gate: >= 2 sentinel assignments to one target, else no
+        # set/restore pair can exist and the CFG build is wasted.
+        counts = {}
+        for node in walk_local(fn):
+            if node.__class__ is not ast.Assign:
+                continue
+            tgt = _toggle_target(node)
+            if tgt is not None:
+                counts[tgt] = counts.get(tgt, 0) + 1
+        if not any(c >= 2 for c in counts.values()):
+            continue
+        cfg = ctx.cfg(fn)
+        sol = dataflow.solve(cfg, analysis)
+        stuck = sol.in_of(cfg.exc_exit) - sol.in_of(cfg.exit)
+        for tgt, _sid, line in sorted(stuck, key=lambda f: f[2]):
+            if counts.get(tgt, 0) < 2:
+                continue  # no restore anywhere: init, not a toggle pair
+            findings.append(Finding(
+                "TJA019", "finally-state-restore", ctx.path, line, 0,
+                WARNING,
+                f"{tgt} is toggled in {fn.name}() and restored on the "
+                f"normal path but not on the exception path; move the "
+                f"restore into a finally block"))
+    return findings

@@ -1,0 +1,293 @@
+"""TJA016 lock-held-blocking-call: I/O reachable while a lock is held.
+
+TJA010 proves lock *order*; this pass proves lock *latency*: a blocking
+callee -- socket ops, ``time.sleep``, unbounded ``join``/``wait``/``get``,
+HTTP, subprocess -- reachable while a lock is must-held.  One slow peer then
+stalls every thread contending for that lock: the pserver's ``handle``
+threads serializing ``send_msg`` under the shard lock block *all* workers
+behind one worker's congested socket.
+
+Three witnesses, in decreasing precision:
+
+1. **Summary-held calls** (PR 4's ``held_calls``): a method calls, under
+   ``with self._lock:``, a project callable that may block *transitively*
+   (fixpoint over the call graph, same resolver as TJA010).
+2. **Lexical with-bodies everywhere**, including nested/closure functions
+   the summaries deliberately skip: direct blocking calls (name-level
+   classifier in _flow.py) or may-blocking project callees inside
+   ``with <lock>:`` where the lock is a ``self.*`` lock attr, a module
+   lock, or a function-local/closure ``threading.Lock()``.
+3. **Path-sensitive manual locking**: ``l.acquire() ... l.release()`` pairs
+   tracked by a forward *must* analysis over the CFG -- a blocking call is
+   flagged only when the lock is held on *every* path reaching it, and the
+   engine's exception rule means a release in a ``finally`` is honored on
+   exceptional paths too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze import dataflow
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+from tools.analyze.checks._flow import (
+    blocking_reason, enclosing, functions_of, parents_of, walk_local,
+)
+from tools.analyze.checks.lock_order import _Resolver, _iter_summaries
+from tools.analyze.project import LOCK_FACTORIES
+
+
+class _FnFacts:
+    """One walk_local sweep per function, shared by every stage of this
+    pass (the repeated per-function walks were the analyzer's hottest
+    profile line before this was consolidated)."""
+
+    __slots__ = ("locks", "withs", "has_acquire", "blocking")
+
+    def __init__(self, fn: ast.AST):
+        self.locks: Set[str] = set()
+        self.withs: List[ast.AST] = []
+        self.has_acquire = False
+        self.blocking: List[Tuple[ast.Call, str]] = []
+        for node in walk_local(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                self.withs.append(node)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                f = node.value.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if name in LOCK_FACTORIES:
+                    self.locks |= {t.id for t in node.targets
+                                   if isinstance(t, ast.Name)}
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "acquire":
+                    self.has_acquire = True
+                why = blocking_reason(node)
+                if why is not None:
+                    self.blocking.append((node, why))
+
+
+def _may_block(pc: ProjectContext, res: _Resolver,
+               facts_of: Dict[int, _FnFacts]) -> Dict[str, str]:
+    """summary qual -> blocking reason, closed transitively over the call
+    graph (the TJA010 fixpoint shape, with reasons instead of lock sets)."""
+    reason: Dict[str, str] = {}
+    callees: Dict[str, Set[str]] = {}
+    for mod, cls, s in _iter_summaries(pc):
+        ff = facts_of.get(id(s.node))
+        if ff is not None and ff.blocking:
+            reason[s.qual] = ff.blocking[0][1]
+        outs: Set[str] = set()
+        for call in {c[:-1] for c in s.calls}:
+            for _m, _c, cs in res.callee_summaries(mod, cls, call):
+                outs.add(cs.qual)
+        callees[s.qual] = outs
+    changed = True
+    while changed:
+        changed = False
+        for q, outs in callees.items():
+            if q in reason:
+                continue
+            for o in outs:
+                if o in reason:
+                    reason[q] = f"{o.rsplit('.', 1)[-1]}() -> {reason[o]}"
+                    changed = True
+                    break
+    return reason
+
+
+def _lock_name_of(expr: ast.expr, self_locks: Set[str], module_locks: Set[str],
+                  scope_locks: Set[str]) -> Optional[str]:
+    """Printable lock name when a ``with`` item is a known lock."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and expr.attr in self_locks:
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name) and (expr.id in module_locks
+                                       or expr.id in scope_locks):
+        return expr.id
+    return None
+
+
+class _Held(dataflow.Analysis):
+    """Must-held lock names through manual acquire()/release() pairs."""
+
+    may = False
+
+    def __init__(self, lockish: Set[str]):
+        self.lockish = lockish
+
+    def _lock_call(self, stmt: ast.AST, attr: str) -> Optional[str]:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == attr:
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id in self.lockish:
+                    return recv.id
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self" \
+                        and recv.attr in self.lockish:
+                    return f"self.{recv.attr}"
+        return None
+
+    def gen(self, stmt: ast.AST):
+        got = self._lock_call(stmt, "acquire")
+        return [got] if got else []
+
+    def kill(self, stmt: ast.AST, facts):
+        got = self._lock_call(stmt, "release")
+        return [got] if got else []
+
+
+@register_project("TJA016", "lock-held-blocking-call")
+def check(pc: ProjectContext) -> List[Finding]:
+    res = _Resolver(pc)
+    facts_of: Dict[int, _FnFacts] = {}
+    fns_by_file: Dict[str, list] = {}
+    for rel, ctx in pc.files.items():
+        if ctx.tree is None:
+            continue
+        fns = functions_of(ctx)
+        fns_by_file[rel] = fns
+        for fn in fns:
+            facts_of[id(fn)] = _FnFacts(fn)
+    may_block = _may_block(pc, res, facts_of)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    def report(path: str, line: int, lock: str, why: str) -> None:
+        if (path, line) in seen:
+            return
+        seen.add((path, line))
+        findings.append(Finding(
+            "TJA016", "lock-held-blocking-call", path, line, 0, ERROR,
+            f"blocking call ({why}) while holding lock {lock}; move the "
+            f"I/O out of the locked region or bound it with a timeout"))
+
+    # 1. Transitive blocking through summary-held calls (with self.X:).
+    for mod, cls, s in _iter_summaries(pc):
+        for lock, callee, line in s.held_calls:
+            hit = res.lock_id(mod, cls, lock)
+            if hit is None:
+                continue
+            for _m, _c, cs in res.callee_summaries(mod, cls, callee):
+                why = may_block.get(cs.qual)
+                if why is not None:
+                    report(mod.ctx.path, line,
+                           hit[0].rsplit(".", 2)[-1], why)
+
+    # 2. Lexical with-lock bodies in every function, nested ones included.
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None:
+            continue
+        mod = pc.module_of_path(rel)
+        module_locks = set(mod.module_locks) if mod else set()
+        parents = parents_of(ctx)
+        for fn in fns_by_file.get(rel, ()):
+            ff = facts_of[id(fn)]
+            if not (ff.withs or ff.has_acquire):
+                continue
+            cls_node = enclosing(parents, fn, ast.ClassDef)
+            cls = None
+            self_locks: Set[str] = set()
+            if mod is not None and cls_node is not None \
+                    and cls_node.name in mod.classes:
+                cls = mod.classes[cls_node.name]
+                for k in pc.mro_classes(cls):
+                    self_locks |= set(k.lock_attrs)
+            scope_locks = set(ff.locks)
+            anc = enclosing(parents, fn, ast.FunctionDef,
+                            ast.AsyncFunctionDef)
+            while anc is not None:
+                aff = facts_of.get(id(anc))
+                if aff is not None:
+                    scope_locks |= aff.locks
+                anc = enclosing(parents, anc, ast.FunctionDef,
+                                ast.AsyncFunctionDef)
+            for w in ff.withs:
+                locks = [_lock_name_of(i.context_expr, self_locks,
+                                       module_locks, scope_locks)
+                         for i in w.items]
+                locks = [l for l in locks if l]
+                if not locks:
+                    continue
+                for node in walk_local(w):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    why = blocking_reason(node)
+                    if why is None and mod is not None:
+                        callee = _callee_tuple(node)
+                        if callee is not None:
+                            for _m, _c, cs in res.callee_summaries(
+                                    mod, cls, callee):
+                                why = may_block.get(cs.qual)
+                                if why is not None:
+                                    why = (f"{callee[-1]}() -> {why}"
+                                           if "->" not in why else why)
+                                    break
+                    if why is not None and not _is_lock_op(node, locks):
+                        report(rel, node.lineno, locks[0], why)
+
+            # 3. Manual acquire/release pairs, path-sensitively.
+            lockish = ({a for a in self_locks} | module_locks | scope_locks)
+            if not ff.has_acquire:
+                continue
+            cfg = ctx.cfg(fn)
+            sol = dataflow.solve(cfg, _Held(lockish))
+            for block in cfg.blocks:
+                for stmt, before, _after in sol.walk(block):
+                    if not before:
+                        continue
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Call):
+                            why = blocking_reason(node)
+                            if why is not None \
+                                    and not _is_lock_op(node, before):
+                                report(rel, node.lineno,
+                                       sorted(before)[0], why)
+
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _callee_tuple(call: ast.Call) -> Optional[tuple]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return ("name", fn.id)
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            return ("self", fn.attr)
+        if isinstance(recv, ast.Name):
+            return ("attr", recv.id, fn.attr)
+        if isinstance(recv, ast.Attribute) and isinstance(recv.value,
+                                                          ast.Name) \
+                and recv.value.id == "self":
+            return ("attr", recv.attr, fn.attr)
+    return None
+
+
+def _is_lock_op(call: ast.Call, held) -> bool:
+    """The acquire()/release() on the held lock itself is not 'blocking
+    I/O under the lock' -- it IS the lock."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute)
+            and fn.attr in ("acquire", "release")):
+        return False
+    recv = fn.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name) \
+            and recv.value.id == "self":
+        name = f"self.{recv.attr}"
+    return name is not None and any(name == h or h.endswith(name)
+                                    for h in held)
+
+

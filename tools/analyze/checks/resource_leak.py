@@ -1,0 +1,177 @@
+"""TJA015 resource-leak: acquired but not released on some exit path.
+
+The operator's long-lived processes hold OS resources behind plain locals:
+telemetry TCP sockets, pserver listen sockets, handler threads, spans.  A
+function that binds one (``server = socket.socket()``) and then hits an
+exception -- or an early ``return`` -- before ``close()`` leaks it; under a
+controller that restarts replicas for a living, those leaks compound until
+the pod dies on fd exhaustion (the reference's restart machine makes this a
+steady-state code path, not a rarity).
+
+This is the first CFG/dataflow consumer (cfg.py, dataflow.py): a forward
+*may* analysis whose facts are live acquisitions.
+
+- **gen**: ``name = <factory>(...)`` where the factory is a known resource
+  constructor (sockets, ``open``, HTTP connections, ``Popen``, ``Thread``,
+  ``.span()``).  ``with factory() as x:`` never generates -- the ``with``
+  releases it.
+- **kill**: a release/teardown method on the name (``close``/``join``/
+  ``start``/...), rebinding, or any *escape*: the name returned, yielded,
+  stored into an attribute/subscript/container, passed as a call argument,
+  or aliased -- ownership left the function, the leak (if any) is someone
+  else's contract.
+- On **exception edges** the engine drops gen (dataflow.py): if the factory
+  call itself raises, there is nothing to leak.
+
+A fact still live entering ``exc_exit`` leaks on an exception path; live
+entering ``exit`` it leaks on a normal return path (ps_worker's timeout
+``return 1`` with the listen socket open was the motivating catch).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.analyze import dataflow
+from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.runner import register
+from tools.analyze.checks._flow import call_dotted, functions_of, walk_local
+from tools.analyze.cfg import stmt_expressions
+
+#: factory (bare or dotted callee name) -> resource kind.
+FACTORIES = {
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "create_connection": "socket",
+    "open": "file",
+    "HTTPConnection": "connection",
+    "HTTPSConnection": "connection",
+    "subprocess.Popen": "process",
+    "Popen": "process",
+    "threading.Thread": "thread",
+    "Thread": "thread",
+}
+
+#: Method names on the resource that count as release/handoff.
+RELEASE_ATTRS = {"close", "detach", "shutdown", "terminate", "kill", "wait",
+                 "communicate", "start", "join", "cancel", "stop", "release",
+                 "end", "finish", "__exit__"}
+
+
+def _factory_kind(value: ast.expr) -> str:
+    if not isinstance(value, ast.Call):
+        return ""
+    dotted = call_dotted(value)
+    if dotted is None:
+        return ""
+    kind = FACTORIES.get(dotted)
+    if kind:
+        return kind
+    if dotted.endswith(".span") and "." in dotted:
+        return "span"
+    return ""
+
+
+def _bound_names(stmt: ast.AST) -> Iterator[str]:
+    """Names (re)bound by a statement: assignment targets, loop targets,
+    ``with ... as``, ``except ... as``."""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    elif isinstance(stmt, ast.ExceptHandler) and stmt.name:
+        yield stmt.name
+        return
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                yield node.id
+
+
+def _escaped_names(stmt: ast.AST) -> Set[str]:
+    """Names used anywhere a reference can outlive the statement: as a call
+    argument, in a returned/stored/aliased value, in a container literal.
+    The one *non*-escaping use is as the receiver of an attribute access
+    (``s.recv(...)`` keeps ``s`` owned here)."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = list(stmt_expressions(stmt))
+    # Assignment *value* escapes (alias/store); bare Name targets are
+    # rebinding, not escape, and stmt_expressions already includes targets
+    # only for Assign -- drop those.
+    if isinstance(stmt, ast.Assign):
+        stack = [stmt.value] + [t for t in stmt.targets
+                                if not isinstance(t, ast.Name)]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            continue  # receiver use: s.close() / s.family
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _released_names(stmt: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for expr in stmt_expressions(stmt):
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.attr in RELEASE_ATTRS):
+                out.add(node.func.value.id)
+    return out
+
+
+class _Live(dataflow.Analysis):
+    """Facts: (name, acquisition lineno, kind)."""
+
+    may = True
+
+    def gen(self, stmt: ast.AST):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            kind = _factory_kind(stmt.value)
+            if kind:
+                return [(stmt.targets[0].id, stmt.lineno, kind)]
+        return []
+
+    def kill(self, stmt: ast.AST, facts):
+        dead = set(_bound_names(stmt)) | _released_names(stmt) \
+            | _escaped_names(stmt)
+        return [f for f in facts if f[0] in dead]
+
+
+@register("TJA015", "resource-leak")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    findings: List[Finding] = []
+    analysis = _Live()
+    for fn in functions_of(ctx):
+        if not any(isinstance(n, ast.Call) and _factory_kind(n)
+                   for n in walk_local(fn)):
+            continue  # no factory anywhere: skip the CFG build entirely
+        cfg = ctx.cfg(fn)
+        sol = dataflow.solve(cfg, analysis)
+        leaks: Dict[Tuple[str, int, str], List[str]] = {}
+        for fact in sorted(sol.in_of(cfg.exc_exit)):
+            leaks.setdefault(fact, []).append("an exception path")
+        for fact in sorted(sol.in_of(cfg.exit)):
+            leaks.setdefault(fact, []).append("a return path")
+        for (name, line, kind), paths in sorted(leaks.items()):
+            findings.append(Finding(
+                "TJA015", "resource-leak", ctx.path, line, 0, ERROR,
+                f"{kind} {name!r} acquired in {fn.name}() is not released on "
+                f"{' or '.join(paths)}; close it in a finally/with so "
+                f"restarts don't leak it"))
+    return findings

@@ -1,0 +1,137 @@
+"""TJA018 retry-without-backoff: hot retry loops against remote peers.
+
+``while True: try: client.call() except Exception: continue`` is how one
+flapping apiserver turns into a tight loop of failing RPCs -- each iteration
+fails in microseconds, so the loop burns a core and hammers the exact
+endpoint that is trying to recover.  Every client-facing retry loop must
+pause on its back edge (sleep, bounded wait, rate limiter).
+
+The CFG makes "on its back edge" precise.  A finding requires all of:
+
+- a ``while`` loop (``for`` loops iterate *independent* items -- skipping a
+  bad record is not a retry);
+- a ``try`` in the loop body whose handler *swallows* (no ``raise``, no
+  ``return``, no ``break``: control re-enters the loop);
+- the handler catches something other than a timeout type (``socket.timeout``
+  / ``TimeoutError`` / queue ``Empty``/``Full`` -- there the blocking wait
+  itself already paced the loop);
+- the ``try`` body performs an I/O-ish call (sockets, HTTP, or a
+  client/conn/api-shaped receiver);
+- and, on the CFG, a normal-control path from the handler entry back to the
+  try entry that passes **no backoff call** (``_flow.is_backoff_call``) --
+  pacing at the loop top or in the handler both break the path, anywhere
+  else does not help.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.analyze.findings import Finding, WARNING
+from tools.analyze.findings import FileContext
+from tools.analyze.runner import register
+from tools.analyze.checks._flow import (
+    call_dotted, enclosing, functions_of, is_backoff_call, parents_of,
+    walk_local,
+)
+from tools.analyze.cfg import handler_type_names
+
+#: Handler types where the failed call was itself the pause.
+TIMEOUT_TYPES = {"timeout", "TimeoutError", "Empty", "Full"}
+
+#: Receiver names (underscores stripped) that mark a remote-API call.
+CLIENT_RECEIVERS = {"client", "api", "conn", "sock", "socket", "session",
+                    "server", "stub", "http", "channel"}
+
+#: Attribute callees that are remote I/O wherever they appear.
+IO_ATTRS = {"request", "urlopen", "sendall", "recv", "recvfrom", "connect",
+            "accept", "getresponse", "watch"}
+
+IO_NAMES = {"send_msg", "recv_msg", "create_connection", "urlopen"}
+
+
+def _receiver_leaf(call: ast.Call) -> Optional[str]:
+    node = call.func
+    if not isinstance(node, ast.Attribute):
+        return None
+    node = node.value
+    while isinstance(node, ast.Attribute):
+        # self._client.list -> "_client"; keep the attribute leaf.
+        return node.attr.strip("_").lower()
+    if isinstance(node, ast.Name):
+        return node.id.strip("_").lower()
+    return None
+
+
+def _is_api_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in IO_NAMES
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in IO_ATTRS:
+            return True
+        recv = _receiver_leaf(call)
+        if recv in CLIENT_RECEIVERS:
+            return True
+        dotted = call_dotted(call) or ""
+        root = dotted.split(".", 1)[0]
+        return root in ("socket", "urllib", "http")
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return False
+    return True
+
+
+def _handler_is_timeout_only(handler: ast.ExceptHandler) -> bool:
+    names = handler_type_names(handler)
+    return bool(names) and all(n in TIMEOUT_TYPES for n in names)
+
+
+@register("TJA018", "retry-without-backoff")
+def check(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    findings: List[Finding] = []
+    parents = parents_of(ctx)
+    for fn in functions_of(ctx):
+        tries = [n for n in walk_local(fn) if isinstance(n, ast.Try)]
+        if not tries:
+            continue
+        cfg = None
+        for t in tries:
+            loop = enclosing(parents, t, ast.While, ast.For, ast.AsyncFor,
+                             ast.FunctionDef, ast.AsyncFunctionDef)
+            if not isinstance(loop, ast.While):
+                continue
+            if not any(isinstance(n, ast.Call) and _is_api_call(n)
+                       for b in t.body for n in ([b] + list(ast.walk(b)))):
+                continue
+            for handler in t.handlers:
+                if not _swallows(handler) or _handler_is_timeout_only(handler):
+                    continue
+                if cfg is None:
+                    cfg = ctx.cfg(fn)
+                h_entry = cfg.block_of.get(id(handler))
+                t_entry = cfg.block_of.get(id(t.body[0]))
+                if h_entry is None or t_entry is None:
+                    continue
+                paced = {b.bid for b in cfg.blocks
+                         if any(isinstance(n, ast.Call) and is_backoff_call(n)
+                                for s in b.stmts
+                                for n in ast.walk(s))}
+                if cfg.reaches(h_entry, t_entry, blocked=paced):
+                    caught = ", ".join(handler_type_names(handler))
+                    findings.append(Finding(
+                        "TJA018", "retry-without-backoff", ctx.path,
+                        handler.lineno, 0, WARNING,
+                        f"retry loop in {fn.name}() re-enters the I/O call "
+                        f"after catching {caught} with no sleep/backoff on "
+                        f"the back edge; add time.sleep or a rate limiter "
+                        f"before retrying"))
+    findings.sort(key=Finding.sort_key)
+    return findings

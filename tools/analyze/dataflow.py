@@ -1,0 +1,196 @@
+"""Worklist dataflow over the per-function CFGs (cfg.py).
+
+A tiny classic gen-kill framework: a client subclasses ``Analysis``, names a
+direction (forward/backward) and a meet (may=union / must=intersection), and
+gets per-block ``in``/``out``/``exc_out`` fact sets from ``solve``.
+
+The one non-textbook rule -- load-bearing for TJA015/TJA019 -- is how facts
+flow along *exception* edges.  A statement that raises did not complete:
+
+    exc_fact(stmt) = facts_before(stmt) - kill(stmt)        # gen NOT applied
+
+If ``s = socket.socket()`` itself raises, the binding never happened, so the
+acquisition fact must not escape onto the exception path; if ``s.close()``
+raises, the socket is in teardown and we still treat it as released.  A
+block's ``exc_out`` is the union of that per-statement residue over its
+raising statements, and exceptional edges propagate ``exc_out`` where normal
+edges propagate ``out``.
+
+Must-analyses use optimistic iteration: blocks start at TOP (an "everything
+holds" sentinel) and TOP operands are skipped in the meet, the standard
+treatment for intersection lattices with unreachable joins.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Tuple
+
+from tools.analyze.cfg import CFG, Block, EXC_KINDS
+
+#: "Not yet computed" for must-analyses; distinct from the empty set.
+TOP = None
+
+
+class Analysis:
+    """Gen-kill dataflow client.  Facts are hashable opaque values."""
+
+    #: "forward" or "backward".
+    direction = "forward"
+    #: True -> meet is union (may / exists-a-path); False -> intersection
+    #: (must / all-paths).
+    may = True
+
+    def gen(self, stmt: ast.AST) -> Iterable:
+        return ()
+
+    def kill(self, stmt: ast.AST, facts: FrozenSet) -> Iterable:
+        """Facts killed by ``stmt``.  ``facts`` are the facts flowing in,
+        for clients whose kill depends on what is live (e.g. "any fact for
+        this variable name")."""
+        return ()
+
+    def entry_facts(self, cfg: CFG) -> Iterable:
+        """Facts at the function entry (backward: at the exits)."""
+        return ()
+
+
+class Solution:
+    """Per-block fact sets.  For forward analyses ``block_in`` is at block
+    entry, ``block_out`` at exit, ``exc_out`` what escapes on exceptions."""
+
+    def __init__(self, analysis: Analysis):
+        self.analysis = analysis
+        self.block_in: Dict[int, FrozenSet] = {}
+        self.block_out: Dict[int, FrozenSet] = {}
+        self.exc_out: Dict[int, FrozenSet] = {}
+
+    def in_of(self, block: Block) -> FrozenSet:
+        facts = self.block_in.get(block.bid, TOP)
+        return frozenset() if facts is TOP else facts
+
+    def out_of(self, block: Block) -> FrozenSet:
+        facts = self.block_out.get(block.bid, TOP)
+        return frozenset() if facts is TOP else facts
+
+    def exc_of(self, block: Block) -> FrozenSet:
+        facts = self.exc_out.get(block.bid, TOP)
+        return frozenset() if facts is TOP else facts
+
+    def walk(self, block: Block) -> Iterator[Tuple[ast.AST, FrozenSet,
+                                                   FrozenSet]]:
+        """(stmt, facts_before, facts_after) for each statement of a block,
+        in forward order -- the statement-granular view clients report from."""
+        facts = self.in_of(block)
+        for stmt in block.stmts:
+            killed = frozenset(self.analysis.kill(stmt, facts))
+            after = (facts - killed) | frozenset(self.analysis.gen(stmt))
+            yield stmt, facts, after
+            facts = after
+
+
+def _transfer(analysis: Analysis, block: Block,
+              facts: FrozenSet) -> Tuple[FrozenSet, FrozenSet]:
+    """Forward transfer of one block: (out, exc_out)."""
+    exc_acc: set = set()
+    any_raising = False
+    for stmt, raising in zip(block.stmts, block.raising):
+        killed = frozenset(analysis.kill(stmt, facts))
+        if raising:
+            any_raising = True
+            exc_acc |= (facts - killed)
+        facts = (facts - killed) | frozenset(analysis.gen(stmt))
+    if not any_raising:
+        # Dispatch blocks (and any empty block with an exc successor) pass
+        # their in-facts through unchanged on the exceptional edge.
+        exc_acc = set(facts)
+    return facts, frozenset(exc_acc)
+
+
+def _meet(analysis: Analysis, contributions: List[FrozenSet]) -> FrozenSet:
+    live = [c for c in contributions if c is not TOP]
+    if not live:
+        return TOP
+    if analysis.may:
+        out: set = set()
+        for c in live:
+            out |= c
+        return frozenset(out)
+    out = set(live[0])
+    for c in live[1:]:
+        out &= c
+    return frozenset(out)
+
+
+def solve(cfg: CFG, analysis: Analysis) -> Solution:
+    sol = Solution(analysis)
+    if analysis.direction == "backward":
+        return _solve_backward(cfg, analysis, sol)
+
+    sol.block_in = {b.bid: TOP for b in cfg.blocks}
+    sol.block_out = {b.bid: TOP for b in cfg.blocks}
+    sol.exc_out = {b.bid: TOP for b in cfg.blocks}
+    sol.block_in[cfg.entry.bid] = frozenset(analysis.entry_facts(cfg))
+
+    work = list(cfg.blocks)
+    on_work = {b.bid for b in work}
+    while work:
+        block = work.pop(0)
+        on_work.discard(block.bid)
+        if block is not cfg.entry:
+            contribs = []
+            for pred, kind in block.preds:
+                src = sol.exc_out if kind in EXC_KINDS else sol.block_out
+                contribs.append(src[pred.bid])
+            new_in = _meet(analysis, contribs)
+            if new_in is TOP:
+                continue  # no reachable predecessor computed yet
+            sol.block_in[block.bid] = new_in
+        facts = sol.block_in[block.bid]
+        if facts is TOP:
+            continue
+        out, exc = _transfer(analysis, block, facts)
+        if out != sol.block_out[block.bid] or exc != sol.exc_out[block.bid]:
+            sol.block_out[block.bid] = out
+            sol.exc_out[block.bid] = exc
+            for succ, _kind in block.succs:
+                if succ.bid not in on_work:
+                    on_work.add(succ.bid)
+                    work.append(succ)
+    return sol
+
+
+def _solve_backward(cfg: CFG, analysis: Analysis, sol: Solution) -> Solution:
+    """Backward may-analysis (liveness-style).  ``block_in`` holds facts at
+    block *entry* as seen walking backward (i.e. what is demanded before the
+    block); exceptional edges contribute like normal ones."""
+    sol.block_in = {b.bid: TOP for b in cfg.blocks}
+    sol.block_out = {b.bid: TOP for b in cfg.blocks}
+    exits = frozenset(analysis.entry_facts(cfg))
+    for b in (cfg.exit, cfg.exc_exit):
+        sol.block_out[b.bid] = exits
+
+    work = list(cfg.blocks)
+    on_work = {b.bid for b in work}
+    while work:
+        block = work.pop(0)
+        on_work.discard(block.bid)
+        if block not in (cfg.exit, cfg.exc_exit):
+            contribs = [sol.block_in[s.bid] for s, _k in block.succs]
+            new_out = _meet(analysis, contribs)
+            if new_out is TOP:
+                continue
+            sol.block_out[block.bid] = new_out
+        facts = sol.block_out[block.bid]
+        if facts is TOP:
+            continue
+        for stmt in reversed(block.stmts):
+            killed = frozenset(analysis.kill(stmt, facts))
+            facts = (facts - killed) | frozenset(analysis.gen(stmt))
+        if facts != sol.block_in[block.bid]:
+            sol.block_in[block.bid] = facts
+            for pred, _kind in block.preds:
+                if pred.bid not in on_work:
+                    on_work.add(pred.bid)
+                    work.append(pred)
+    return sol

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ast
 import hashlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -67,6 +68,8 @@ class FileContext:
     tree: object = None       # ast.Module | None when the file doesn't parse
     _nodes: Optional[list] = field(default=None, repr=False)
     _buckets: Optional[dict] = field(default=None, repr=False)
+    _cfgs: Optional[dict] = field(default=None, repr=False)
+    _parents: Optional[dict] = field(default=None, repr=False)
 
     @property
     def nodes(self) -> list:
@@ -75,9 +78,42 @@ class FileContext:
         tree, the walk itself dominates analyzer wall-clock; passes that
         scan the whole file iterate this list instead."""
         if self._nodes is None:
-            self._nodes = list(ast.walk(self.tree)) if self.tree is not None \
-                else []
+            self._build_walk()
         return self._nodes
+
+    @property
+    def parents(self) -> dict:
+        """id(node) -> parent for every node, recorded during the same
+        single sweep that fills ``nodes`` (a second full-tree pass just for
+        parent links measurably ate into the 2 s lint budget)."""
+        if self._parents is None:
+            self._build_walk()
+        return self._parents
+
+    def _build_walk(self) -> None:
+        # Manual BFS equivalent to ``ast.walk`` (same node order) with the
+        # child enumeration inlined: iter_child_nodes/iter_fields are two
+        # generators per node, and over ~450k nodes their resumption
+        # overhead alone is a visible slice of the wall-clock budget.
+        nodes: list = []
+        parents: dict = {}
+        if self.tree is not None:
+            queue = deque([self.tree])
+            while queue:
+                n = queue.popleft()
+                nodes.append(n)
+                for name in n._fields:
+                    v = getattr(n, name, None)
+                    if v.__class__ is list:
+                        for item in v:
+                            if isinstance(item, ast.AST):
+                                parents[id(item)] = n
+                                queue.append(item)
+                    elif isinstance(v, ast.AST):
+                        parents[id(v)] = n
+                        queue.append(v)
+        self._nodes = nodes
+        self._parents = parents
 
     def by_type(self, *types: type) -> list:
         """Nodes of the given exact AST classes, bucketed once per file.
@@ -95,6 +131,21 @@ class FileContext:
         for t in types:
             out.extend(self._buckets.get(t, ()))
         return out
+
+    def cfg(self, func_node):
+        """Control-flow graph of one function (cfg.py), built lazily and
+        memoized per AST node: the five path-sensitive passes (TJA015+) ask
+        for the same functions, and the project passes see the same
+        FileContext objects the runner parsed, so each function body is
+        built exactly once per run (the 2 s budget depends on it)."""
+        from tools.analyze.cfg import build_cfg  # local: avoid import cycle
+        if self._cfgs is None:
+            self._cfgs = {}
+        key = id(func_node)
+        got = self._cfgs.get(key)
+        if got is None:
+            got = self._cfgs[key] = build_cfg(func_node)
+        return got
 
     def waived(self, line: int, check_name: str) -> bool:
         """True when ``line`` (or the line above) carries an explicit waiver:

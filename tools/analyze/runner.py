@@ -64,9 +64,10 @@ def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
         broad_except, constant_drift, dead_reasons, env_contract,
-        event_reasons, lock_discipline, lock_order, metric_drift,
-        orphaned_thread, phase_transitions, py_compat, reconcile_purity,
-        status_discipline, tracer_safety,
+        event_reasons, exception_escape, finally_restore, lock_blocking,
+        lock_discipline, lock_order, metric_drift, orphaned_thread,
+        phase_transitions, py_compat, reconcile_purity, resource_leak,
+        retry_backoff, status_discipline, tracer_safety,
     )
 
 
@@ -184,7 +185,47 @@ def apply_baseline(findings: List[Finding],
 
 # -- output ------------------------------------------------------------------
 
+def format_sarif(findings: List[Finding]) -> str:
+    """Minimal SARIF 2.1.0: one run, rules from the registry, results with
+    a physical location + level -- enough for GitHub code-scanning upload,
+    replacing the bespoke ``github`` annotation format in CI."""
+    rules = [{
+        "id": cid,
+        "name": name,
+        "shortDescription": {"text": name},
+    } for cid, name in sorted(all_checks().items())]
+    results = [{
+        "ruleId": f.check_id,
+        "level": "error" if f.severity == ERROR else "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line,
+                           "startColumn": max(f.col, 1)},
+            },
+        }],
+    } for f in findings]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tools.analyze",
+                "informationUri":
+                    "https://example.invalid/docs/STATIC_ANALYSIS.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
 def format_findings(findings: List[Finding], fmt: str) -> str:
+    if fmt == "sarif":
+        return format_sarif(findings)
     if fmt == "json":
         return json.dumps([{
             "check_id": f.check_id, "check": f.check_name, "path": f.path,

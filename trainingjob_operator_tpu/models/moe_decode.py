@@ -14,11 +14,33 @@ train -> checkpoint -> sample loop for the second model family.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 from trainingjob_operator_tpu.models import decode as _decode
 from trainingjob_operator_tpu.models import llama as _llama
 from trainingjob_operator_tpu.models import moe
+
+
+def _check_capacity(config: moe.MoEConfig) -> None:
+    """Warn when prefill can drop tokens that decode would keep.
+
+    Prefill runs the training dispatch, whose per-expert capacity is
+    ``capacity_factor * k * T / E`` slots; decode's per-token gather is
+    dropless.  Whenever ``capacity_factor < E / k`` a sufficiently skewed
+    router can overflow an expert at prefill (dropped tokens contribute
+    zero from that expert) while the very same tokens, decoded one at a
+    time, would get their full top-k mix -- the cache and the sampled
+    continuation then disagree about the prompt's representations."""
+    c = config
+    threshold = c.n_experts / c.experts_per_token
+    if c.capacity_factor < threshold:
+        warnings.warn(
+            f"capacity_factor={c.capacity_factor} < n_experts/"
+            f"experts_per_token={threshold:g}: prefill may drop tokens "
+            f"that the dropless decode path would route, so prompt "
+            f"representations can differ between prefill and decode",
+            RuntimeWarning, stacklevel=3)
 
 
 def prefill(params, tokens, config: moe.MoEConfig, max_len: int, *,
@@ -27,11 +49,15 @@ def prefill(params, tokens, config: moe.MoEConfig, max_len: int, *,
 
     Delegates to the training ``moe.forward`` (``return_kv=True``) -- one
     implementation of the layer math, so sampling cannot desynchronize
-    from what was trained (routing decisions included)."""
+    from what was trained (routing decisions included).  One residual
+    mismatch is inherent: ``moe.forward`` dispatches with finite expert
+    capacity (tokens beyond it are dropped), while ``decode_step``'s
+    per-token gather is dropless -- see ``_check_capacity``."""
     c = config
     B, T = tokens.shape
     if T > max_len:
         raise ValueError(f"prompt {T} exceeds max_len {max_len}")
+    _check_capacity(c)
     logits_all, _aux, (k, v) = moe.forward(params, tokens, c, mesh=mesh,
                                            return_kv=True)
     return logits_all[:, -1, :], _decode.pack_cache(k, v, c, max_len)
@@ -44,7 +70,10 @@ def _routed_mlp_token(x, layer, config: moe.MoEConfig, compute):
     along the expert dim), so only k expert FFNs' bytes stream from HBM --
     the capacity machinery of training-time dense dispatch is pointless
     for one token and is skipped entirely (a single token can never
-    overflow an expert)."""
+    overflow an expert).  That makes decode *dropless* where prefill is
+    not: under a tight ``capacity_factor`` the same token can be dropped
+    by prefill's dispatch yet fully routed here (``_check_capacity``
+    warns when the configuration admits this)."""
     import jax
     import jax.numpy as jnp
 

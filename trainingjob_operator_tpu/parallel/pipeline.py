@@ -68,6 +68,13 @@ def partial_manual_shard_map():
     Partial-manual (manual over a SUBSET of mesh axes, auto over the rest)
     landed in jax 0.8+; on older runtimes gpipe falls back to the pure-GSPMD
     vmap formulation (correct, but the stage body cannot host Pallas calls).
+
+    The replication-check kwarg was renamed ``check_rep`` -> ``check_vma``
+    across jax versions, so the same ``inspect.signature`` probe that gates
+    on ``axis_names`` also decides how to spell it: callers always pass
+    ``check_vma=`` and the returned wrapper translates (or drops) it, so a
+    version skew downgrades to the documented fallback instead of surfacing
+    as a trace-time TypeError.
     """
     try:
         import inspect
@@ -75,9 +82,26 @@ def partial_manual_shard_map():
         from jax import shard_map
     except ImportError:
         return None
-    if "axis_names" not in inspect.signature(shard_map).parameters:
+    params = inspect.signature(shard_map).parameters
+    if "axis_names" not in params:
         return None
-    return shard_map
+    return _adapt_check_kwarg(shard_map, params)
+
+
+def _adapt_check_kwarg(shard_map, params):
+    """Wrap ``shard_map`` so callers can always spell ``check_vma=``."""
+    if "check_vma" in params:
+        return shard_map
+
+    @functools.wraps(shard_map)
+    def compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            val = kwargs.pop("check_vma")
+            if "check_rep" in params:
+                kwargs["check_rep"] = val
+        return shard_map(*args, **kwargs)
+
+    return compat
 
 
 def gpipe(block_fn: Callable, stacked_layers: Any, h, mesh,

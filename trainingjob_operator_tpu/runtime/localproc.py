@@ -298,18 +298,29 @@ class LocalProcRuntime(PodStateRuntime):
             log_path = self._log_dir / f"{pod.namespace}_{pod.name}_{int(time.time()*1000)}.log"
             try:
                 log_file = open(log_path, "wb")
-                popen = subprocess.Popen(
-                    argv, env=env, stdout=log_file, stderr=subprocess.STDOUT,
-                    cwd=container.working_dir or None,
-                    start_new_session=True)
-                log_file.close()
             except OSError as e:
                 log.error("launch %s failed: %s", pod.name, e)
                 sp.set_status("error")
                 self._report_exit(pod, 127, node=node, reason="LaunchError")
                 return
+            try:
+                popen = subprocess.Popen(
+                    argv, env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                    cwd=container.working_dir or None,
+                    start_new_session=True)
+                # Hand the pid to the proc record before anything else can
+                # raise: once spawned, the child must be reachable from
+                # kubelet state (a later flush/status error would otherwise
+                # orphan a live process behind a LaunchError report).
+                proc.popen = popen
+            except OSError as e:
+                log.error("launch %s failed: %s", pod.name, e)
+                sp.set_status("error")
+                self._report_exit(pod, 127, node=node, reason="LaunchError")
+                return
+            finally:
+                log_file.close()
 
-            proc.popen = popen
             proc.node = node
             proc.log_path = str(log_path)
             self._mark_running(pod, proc)

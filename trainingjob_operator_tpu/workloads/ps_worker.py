@@ -68,11 +68,20 @@ def send_msg(sock: socket.socket, obj: Any) -> None:
     sock.sendall(struct.pack(">II", len(meta), len(blobs)) + meta + blobs)
 
 
+#: Per-section frame cap.  The MNIST protocol moves ~200 KiB of parameters;
+#: an unauthenticated peer claiming a 4 GiB section (the >II ceiling) would
+#: otherwise make _recv_exact buffer it all before any validation runs.
+MAX_FRAME_BYTES = 64 << 20
+
+
 def recv_msg(sock: socket.socket) -> Any:
     header = _recv_exact(sock, 8)
     if header is None:
         return None
     meta_len, blob_len = struct.unpack(">II", header)
+    if meta_len > MAX_FRAME_BYTES or blob_len > MAX_FRAME_BYTES:
+        raise ValueError(f"refusing oversized frame (meta={meta_len}, "
+                         f"blobs={blob_len} bytes)")
     meta = _recv_exact(sock, meta_len)
     blobs = _recv_exact(sock, blob_len) if blob_len else b""
     if meta is None or blobs is None:
@@ -98,7 +107,13 @@ def recv_msg(sock: socket.socket) -> Any:
             return {k: build(v) for k, v in x.items()}
         return x
 
-    return build(json.loads(meta))
+    out = build(json.loads(meta))
+    if offsets[0] != len(blobs):
+        # Raise (not assert: -O strips asserts) so a frame whose metadata
+        # doesn't account for every blob byte is rejected, not truncated.
+        raise ValueError(f"frame desync: metadata consumed {offsets[0]} of "
+                         f"{len(blobs)} blob bytes")
+    return out
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -177,54 +192,67 @@ def run_pserver(rdv) -> int:
     done = threading.Event()
     done_count = [0]
 
-    server = socket.socket()
-    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    server.bind(("", bind_port))
-    server.listen(16)
-    server.settimeout(0.5)
-    print(f"pserver {rdv.replica_index}/{n_ps} serving {sorted(mine)} "
-          f"on :{bind_port}", flush=True)
-
     def handle(conn: socket.socket) -> None:
-        with conn:
-            while True:
-                msg = recv_msg(conn)
-                if msg is None:
-                    return
-                op = msg.get("op")
-                if op == "pull":
-                    with lock:
-                        send_msg(conn, {"params": params})
-                elif op == "push":
-                    lr = float(msg.get("lr", 1e-2))
-                    with lock:
-                        for k, g in msg["grads"].items():
-                            if k in params:
-                                params[k] -= lr * g
-                    send_msg(conn, {"ok": True})
-                elif op == "done":
-                    with lock:
-                        done_count[0] += 1
-                        if done_count[0] >= expected_workers:
-                            done.set()
-                    send_msg(conn, {"ok": True})
-                else:
-                    send_msg(conn, {"error": f"unknown op {op!r}"})
-
-    threads: List[threading.Thread] = []
-    deadline = time.time() + float(os.environ.get("PS_TIMEOUT", "300"))
-    while not done.is_set():
-        if time.time() > deadline:
-            print("pserver: timed out waiting for workers", flush=True)
-            return 1
         try:
-            conn, _ = server.accept()
-        except socket.timeout:
-            continue
-        th = threading.Thread(target=handle, args=(conn,), daemon=True)
-        th.start()
-        threads.append(th)
-    server.close()
+            with conn:
+                while True:
+                    msg = recv_msg(conn)
+                    if msg is None:
+                        return
+                    op = msg.get("op")
+                    if op == "pull":
+                        # Snapshot under the lock, serialize+send outside it:
+                        # one worker's congested socket must not block every
+                        # other handler thread on the shard lock.  The copy
+                        # is required -- push mutates the arrays in place.
+                        with lock:
+                            snap = {k: v.copy() for k, v in params.items()}
+                        send_msg(conn, {"params": snap})
+                    elif op == "push":
+                        lr = float(msg.get("lr", 1e-2))
+                        with lock:
+                            for k, g in msg["grads"].items():
+                                if k in params:
+                                    params[k] -= lr * g
+                        send_msg(conn, {"ok": True})
+                    elif op == "done":
+                        with lock:
+                            done_count[0] += 1
+                            if done_count[0] >= expected_workers:
+                                done.set()
+                        send_msg(conn, {"ok": True})
+                    else:
+                        send_msg(conn, {"error": f"unknown op {op!r}"})
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
+            # A malformed/oversized/torn frame from one peer must not kill
+            # this thread silently -- drop the connection, keep serving.
+            print(f"pserver handler: dropping connection: {e!r}", flush=True)
+
+    server = socket.socket()
+    try:
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("", bind_port))
+        server.listen(16)
+        server.settimeout(0.5)
+        print(f"pserver {rdv.replica_index}/{n_ps} serving {sorted(mine)} "
+              f"on :{bind_port}", flush=True)
+
+        threads: List[threading.Thread] = []
+        deadline = time.time() + float(os.environ.get("PS_TIMEOUT", "300"))
+        while not done.is_set():
+            if time.time() > deadline:
+                print("pserver: timed out waiting for workers", flush=True)
+                return 1
+            try:
+                conn, _ = server.accept()
+            except socket.timeout:
+                continue
+            th = threading.Thread(target=handle, args=(conn,), daemon=True)
+            th.start()
+            threads.append(th)
+    finally:
+        server.close()
     print(f"pserver {rdv.replica_index}: all {expected_workers} workers done",
           flush=True)
     return 0
