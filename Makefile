@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test dryrun bench install ci trace-demo telemetry-demo
+.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo fleet-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -15,8 +15,13 @@ VDEV ?= 8
 lint:
 	$(PY) -m tools.analyze trainingjob_operator_tpu/ tools/ tests/ --format=github --max-seconds 2
 
+# Fast suite: the 10k-job fleet run (tests/test_fleet.py) hides behind the
+# slow marker; `make test-slow` opts in.
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=$(VDEV) \
@@ -36,7 +41,16 @@ trace-demo:
 telemetry-demo:
 	$(PY) -m tools.telemetry_demo
 
+# Seeded ~200-job churn run against the sim cluster (docs/FLEET.md); exits
+# non-zero unless the fleet converges with zero invariant violations.
+# TRAININGJOB_FLEET_SEED / TRAININGJOB_FLEET_JOBS override the defaults.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m trainingjob_operator_tpu.fleet.harness \
+		--jobs $${TRAININGJOB_FLEET_JOBS:-200} \
+		--seed $${TRAININGJOB_FLEET_SEED:-0} \
+		--duration 3 --replicas-min 1 --replicas-max 4 --workers 4 --quiet
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint test dryrun
+ci: lint test dryrun fleet-smoke

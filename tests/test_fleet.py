@@ -1,0 +1,244 @@
+"""Fleet control-plane tests: churn determinism, the O(changed-pods) status
+index, the informer job index, and the churn harness itself (a fast seeded
+smoke in tier-1; the 10k-job / 100k-replica run behind ``-m slow``)."""
+
+import os
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import TPUTrainingJob
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.informers import Informer
+from trainingjob_operator_tpu.controller.control import gen_owner_reference
+from trainingjob_operator_tpu.controller.controller import job_index_key
+from trainingjob_operator_tpu.controller.pod_index import PodPhaseIndex
+from trainingjob_operator_tpu.core.objects import ObjectMeta, Pod, PodPhase
+from trainingjob_operator_tpu.fleet.churn import (
+    FATE_POD_FAIL,
+    ChurnGenerator,
+    ChurnProfile,
+)
+from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+
+class TestChurnDeterminism:
+    def test_same_seed_same_schedule(self):
+        profile = ChurnProfile(jobs=150, duration=10.0, seed=42)
+        a = ChurnGenerator(profile).plan()
+        b = ChurnGenerator(profile).plan()
+        assert a == b  # JobPlan is a frozen dataclass: field-exact equality
+
+    def test_different_seed_different_schedule(self):
+        a = ChurnGenerator(ChurnProfile(jobs=50, seed=1)).plan()
+        b = ChurnGenerator(ChurnProfile(jobs=50, seed=2)).plan()
+        assert a != b
+
+    def test_schedule_shape(self):
+        profile = ChurnProfile(jobs=100, duration=5.0, seed=0)
+        plans = ChurnGenerator(profile).plan()
+        assert len(plans) == 100
+        assert all(0.0 <= p.create_at <= 5.0 for p in plans)
+        assert plans[-1].create_at == pytest.approx(5.0)
+        lo, hi = profile.replicas
+        assert all(lo <= p.replicas <= hi for p in plans)
+        for p in plans:
+            if p.disrupt_at:
+                assert p.disrupt_at > p.create_at
+            if p.fate == FATE_POD_FAIL:
+                assert 0 <= p.fail_index < p.replicas
+
+
+def _job(name="j", uid="u1"):
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.metadata.uid = uid
+    return job
+
+
+def _pod(job, rtype, index, phase, node=""):
+    pod = Pod(metadata=ObjectMeta(
+        name=f"{job.metadata.name}-{rtype}-{index}",
+        namespace=job.metadata.namespace,
+        labels={
+            constants.GROUP_NAME_LABEL: constants.GROUP_NAME,
+            constants.JOB_NAME_LABEL: job.metadata.name,
+            constants.REPLICA_NAME_LABEL: rtype,
+            constants.REPLICA_INDEX_LABEL: str(index),
+        },
+        owner_references=[gen_owner_reference(job)]))
+    pod.spec.node_name = node
+    pod.status.phase = phase
+    return pod
+
+
+class TestPodPhaseIndex:
+    def test_counts_match_pod_set(self):
+        job = _job()
+        idx = PodPhaseIndex()
+        idx.observe(_pod(job, "trainer", 0, PodPhase.RUNNING, node="n0"))
+        idx.observe(_pod(job, "trainer", 1, PodPhase.PENDING, node="n0"))
+        idx.observe(_pod(job, "trainer", 2, PodPhase.PENDING))
+        idx.observe(_pod(job, "trainer", 3, PodPhase.SUCCEEDED, node="n0"))
+        idx.observe(_pod(job, "trainer", 4, PodPhase.FAILED, node="n0"))
+        rs, population = idx.replica_status(
+            "default/j", "u1", "trainer", width=5, restarted=False)
+        assert population == 5
+        assert (rs.active, rs.scheduled, rs.pending, rs.succeeded, rs.failed) \
+            == (1, 1, 1, 1, 1)
+        assert rs.restarting == 0
+
+    def test_restarted_job_counts_pending_as_restarting(self):
+        job = _job()
+        idx = PodPhaseIndex()
+        idx.observe(_pod(job, "trainer", 0, PodPhase.PENDING, node="n0"))
+        rs, _ = idx.replica_status(
+            "default/j", "u1", "trainer", width=1, restarted=True)
+        assert rs.restarting == 1 and rs.scheduled == 0
+
+    def test_update_replaces_record(self):
+        """A pod observed again (phase moved) must not double-count."""
+        job = _job()
+        idx = PodPhaseIndex()
+        idx.observe(_pod(job, "trainer", 0, PodPhase.PENDING))
+        idx.observe(_pod(job, "trainer", 0, PodPhase.RUNNING, node="n0"))
+        rs, population = idx.replica_status(
+            "default/j", "u1", "trainer", width=1, restarted=False)
+        assert population == 1
+        assert rs.active == 1 and rs.pending == 0
+
+    def test_width_and_uid_filters(self):
+        """Out-of-width pods (elastic shrink leftovers) and pods owned by a
+        same-name previous incarnation are excluded."""
+        job = _job(uid="u1")
+        old = _job(uid="u0")
+        idx = PodPhaseIndex()
+        idx.observe(_pod(job, "trainer", 0, PodPhase.RUNNING, node="n0"))
+        idx.observe(_pod(job, "trainer", 7, PodPhase.RUNNING, node="n0"))
+        stale = _pod(old, "trainer", 1, PodPhase.RUNNING, node="n0")
+        stale.metadata.name = "j-trainer-1"  # same naming, old uid
+        idx.observe(stale)
+        rs, population = idx.replica_status(
+            "default/j", "u1", "trainer", width=4, restarted=False)
+        assert population == 1 and rs.active == 1
+
+    def test_delete_and_forget(self):
+        job = _job()
+        idx = PodPhaseIndex()
+        p = _pod(job, "trainer", 0, PodPhase.RUNNING, node="n0")
+        idx.observe(p)
+        assert idx.pod_count("default/j") == 1
+        idx.observe_delete(p)
+        assert idx.pod_count("default/j") == 0
+        idx.observe(p)
+        idx.forget_job("default/j")
+        assert idx.total_pods() == 0
+
+    def test_orphan_pods_ignored(self):
+        idx = PodPhaseIndex()
+        orphan = Pod(metadata=ObjectMeta(name="stray", namespace="default"))
+        orphan.status.phase = PodPhase.RUNNING
+        idx.observe(orphan)
+        assert idx.total_pods() == 0
+
+
+class TestInformerJobIndex:
+    def test_by_index_tracks_adds_updates_deletes(self):
+        cs = Clientset()
+        informer = Informer(cs.tracker, Pod.KIND)
+        informer.add_index(constants.JOB_INDEX, job_index_key)
+        job_a, job_b = _job("a", "ua"), _job("b", "ub")
+        cs.pods.create(_pod(job_a, "trainer", 0, PodPhase.PENDING))
+        cs.pods.create(_pod(job_a, "trainer", 1, PodPhase.PENDING))
+        cs.pods.create(_pod(job_b, "trainer", 0, PodPhase.PENDING))
+        # An unlabeled pod never lands in any bucket.
+        cs.pods.create(Pod(metadata=ObjectMeta(name="stray",
+                                               namespace="default")))
+
+        names = {p.metadata.name
+                 for p in informer.by_index(constants.JOB_INDEX, "default/a")}
+        assert names == {"a-trainer-0", "a-trainer-1"}
+        assert len(informer.by_index(constants.JOB_INDEX, "default/b")) == 1
+        assert informer.by_index(constants.JOB_INDEX, "default/nope") == []
+
+        # Updates keep the bucket entry current (object identity refreshed).
+        pod = cs.pods.get("default", "a-trainer-0")
+        pod.status.phase = PodPhase.RUNNING
+        cs.pods.update(pod)
+        phases = {p.metadata.name: p.status.phase
+                  for p in informer.by_index(constants.JOB_INDEX, "default/a")}
+        assert phases["a-trainer-0"] == PodPhase.RUNNING
+
+        # by_index hands out copies: mutating a result must not poison the
+        # cache.
+        informer.by_index(constants.JOB_INDEX,
+                          "default/a")[0].metadata.labels.clear()
+        assert len(informer.by_index(constants.JOB_INDEX, "default/a")) == 2
+
+        cs.pods.delete("default", "a-trainer-0", grace_period=0)
+        names = {p.metadata.name
+                 for p in informer.by_index(constants.JOB_INDEX, "default/a")}
+        assert names == {"a-trainer-1"}
+        informer.stop()
+
+    def test_index_seeded_from_existing_store(self):
+        cs = Clientset()
+        job = _job("pre", "up")
+        cs.pods.create(_pod(job, "trainer", 0, PodPhase.RUNNING, node="n0"))
+        informer = Informer(cs.tracker, Pod.KIND)
+        informer.add_index(constants.JOB_INDEX, job_index_key)
+        assert len(informer.by_index(constants.JOB_INDEX, "default/pre")) == 1
+        informer.stop()
+
+
+class TestFleetSmoke:
+    def test_small_fleet_converges(self):
+        """Seeded ~40-job churn run: every fate settles, no orphans, and the
+        latency recorder actually recorded transitions."""
+        profile = ChurnProfile(jobs=40, duration=1.5, seed=11,
+                               replicas=(1, 4))
+        harness = FleetHarness(profile, workers=2, resync_period=5.0,
+                               gc_interval=5.0, converge_timeout=60.0)
+        report = harness.run()
+        assert report.converged, report.violations[:10]
+        assert report.violations == []
+        assert report.jobs == 40
+        assert report.sync_count > 0 and report.reconciles_per_s > 0
+        assert report.event_to_visible_ms["count"] > 0
+        assert report.event_to_visible_ms["by_kind"]["create"] > 0
+        assert report.workqueue_depth_high_water >= 1
+        # Terminal/steady phases only -- nothing stuck mid-flight.
+        assert set(report.phase_counts) <= {"Succeed", "Running", "Preempted"}
+
+    def test_report_roundtrips_to_json_dict(self):
+        profile = ChurnProfile(jobs=6, duration=0.5, seed=3, replicas=(1, 2))
+        report = FleetHarness(profile, workers=2, resync_period=5.0,
+                              gc_interval=5.0, converge_timeout=45.0).run()
+        d = report.to_dict()
+        assert d["converged"] is True
+        assert isinstance(d["event_to_visible_ms"], dict)
+        import json
+        json.dumps(d)  # must be JSON-serializable as-is
+
+
+@pytest.mark.slow
+class TestFleetAtScale:
+    def test_10k_jobs_100k_replicas_converge(self):
+        """The tentpole acceptance run: 10k jobs / ~100k replicas of seeded
+        churn must converge with zero invariant violations.  Tier-1 excludes
+        it (-m 'not slow').  Calibration: 1000 jobs / ~10k replicas converges
+        in ~13 min on one core (sim-bound at ~140 reconciles/s), so the
+        timeout scales with the job count -- at the full 10k this is a
+        multi-hour soak on a single core, proportionally faster with real
+        parallelism.  TRAININGJOB_FLEET_JOBS downsizes the run."""
+        jobs = int(os.environ.get(constants.FLEET_JOBS_ENV, "10000"))
+        seed = int(os.environ.get(constants.FLEET_SEED_ENV, "1"))
+        profile = ChurnProfile(jobs=jobs, duration=180.0, seed=seed,
+                               replicas=(8, 12))
+        harness = FleetHarness(profile, workers=8, resync_period=120.0,
+                               resync_shards=16, gc_interval=600.0,
+                               pods_per_node=256, sim_tick=0.5,
+                               converge_timeout=max(2400.0, jobs * 1.5))
+        report = harness.run()
+        assert report.replicas_total >= jobs * 9  # ~10 avg from (8, 12)
+        assert report.converged, report.violations[:20]
+        assert report.event_to_visible_ms["count"] > 0

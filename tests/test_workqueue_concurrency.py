@@ -1,0 +1,185 @@
+"""Workqueue semantics under concurrency: the guarantees that make
+``--thread-num N`` safe (client-go parity, SURVEY.md §5.2).
+
+- A key is never processed by two workers at once, however hard the queue
+  is hammered with adds/delayed adds from outside.
+- A re-add landing while the key is being processed is not lost: it is
+  redelivered after done().
+- Rate-limited requeues back off exponentially per key and reset on forget.
+- add_after coalesces duplicate delayed keys to the earliest deadline and
+  delivers exactly once; shut_down cancels pending delayed items.
+"""
+
+import collections
+import random
+import threading
+import time
+
+from conftest import wait_for
+
+from trainingjob_operator_tpu.client.workqueue import RateLimitingQueue
+
+
+class TestHammer:
+    def test_no_key_processed_concurrently(self):
+        """6 workers, 8 keys, 3000 mixed adds: the per-key concurrency
+        counter must never reach 2."""
+        q = RateLimitingQueue("hammer")
+        keys = [f"k{i}" for i in range(8)]
+        lock = threading.Lock()
+        active = collections.Counter()
+        processed = collections.Counter()
+        violations = []
+        stop = threading.Event()
+
+        def worker():
+            while True:
+                item, shutdown = q.get(timeout=0.2)
+                if shutdown:
+                    return
+                if item is None:
+                    if stop.is_set():
+                        return
+                    continue
+                with lock:
+                    active[item] += 1
+                    if active[item] > 1:
+                        violations.append(item)
+                time.sleep(0.001)
+                with lock:
+                    active[item] -= 1
+                    processed[item] += 1
+                q.done(item)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(6)]
+        for t in workers:
+            t.start()
+
+        rng = random.Random(0)
+        for _ in range(3000):
+            key = rng.choice(keys)
+            if rng.random() < 0.3:
+                q.add_after(key, rng.uniform(0.0, 0.005))
+            else:
+                q.add(key)
+
+        # Drain: every delayed item delivered, ready queue empty, nothing
+        # mid-processing.
+        assert wait_for(
+            lambda: q.waiting() == 0 and len(q) == 0 and not q._processing,
+            timeout=30.0)
+        stop.set()
+        for t in workers:
+            t.join(timeout=5.0)
+        q.shut_down()
+
+        assert violations == []
+        assert all(processed[k] >= 1 for k in keys), processed
+        # Dedup means far fewer deliveries than adds.
+        assert sum(processed.values()) <= 3000
+
+    def test_readd_during_processing_redelivered(self):
+        q = RateLimitingQueue("dirty")
+        q.add("k")
+        item, _ = q.get(timeout=1.0)
+        assert item == "k"
+        # Re-adds while processing mark dirty (and dedup among themselves).
+        q.add("k")
+        q.add("k")
+        assert len(q) == 0   # not queued: "k" is being processed
+        q.done("k")
+        item, _ = q.get(timeout=1.0)
+        assert item == "k"   # redelivered exactly once
+        q.done("k")
+        item, _ = q.get(timeout=0.05)
+        assert item is None
+        q.shut_down()
+
+
+class TestRateLimiting:
+    def test_backoff_is_per_key_and_forgettable(self):
+        q = RateLimitingQueue("backoff", base_delay=0.05, max_delay=1.0)
+        # Third failure for "a" -> 0.2 s; first for "b" -> 0.05 s.
+        q.add_rate_limited("a")
+        item, _ = q.get(timeout=2.0)
+        assert item == "a"
+        q.done("a")
+        q.add_rate_limited("a")
+        q.add_rate_limited("a")
+        q.add_rate_limited("b")
+        assert q.num_requeues("a") == 3
+        assert q.num_requeues("b") == 1
+        assert q.retries_total == 4
+        first, _ = q.get(timeout=2.0)
+        second, _ = q.get(timeout=2.0)
+        # b's shorter backoff delivers it first despite being added last;
+        # the pump pops in deadline order even when both are overdue.
+        assert [first, second] == ["b", "a"]
+        q.done("b")
+        q.done("a")
+        q.forget("a")
+        assert q.num_requeues("a") == 0
+        q.shut_down()
+
+
+class TestDelayCoalescing:
+    def test_coalesces_to_earliest_deadline(self):
+        q = RateLimitingQueue("coalesce")
+        q.add_after("k", 30.0)
+        q.add_after("k", 0.05)       # earlier: supersedes the 30 s entry
+        assert q.coalesced_total == 1
+        assert q.waiting() == 1
+        item, _ = q.get(timeout=5.0)
+        assert item == "k"
+        q.done("k")
+        assert q.waiting() == 0
+        # The superseded 30 s heap entry must not fire a second delivery.
+        item, _ = q.get(timeout=0.1)
+        assert item is None
+
+        # Later-than-pending deadlines are absorbed outright.
+        q.add_after("k", 0.05)
+        q.add_after("k", 30.0)
+        assert q.coalesced_total == 2
+        assert q.waiting() == 1
+        item, _ = q.get(timeout=5.0)
+        assert item == "k"
+        q.done("k")
+        q.shut_down()
+
+    def test_shutdown_cancels_pending_delays(self):
+        q = RateLimitingQueue("cancel")
+        q.add_after("k", 0.2)
+        assert q.waiting() == 1
+        q.shut_down()
+        assert q.waiting() == 0
+        item, shutdown = q.get(timeout=0.5)
+        assert shutdown and item is None
+        # Nothing fires later either.
+        time.sleep(0.3)
+        assert len(q) == 0
+
+    def test_add_after_zero_is_immediate(self):
+        q = RateLimitingQueue("zero")
+        q.add_after("k", 0.0)
+        item, _ = q.get(timeout=1.0)
+        assert item == "k"
+        q.done("k")
+        q.shut_down()
+
+
+class TestScaleCounters:
+    def test_depth_high_water_and_queue_wait(self):
+        q = RateLimitingQueue("counters")
+        for i in range(5):
+            q.add(f"i{i}")
+        assert q.depth_high_water == 5
+        item, _ = q.get(timeout=1.0)
+        wait = q.pop_wait(item)
+        assert wait is not None and wait >= 0.0
+        assert q.pop_wait(item) is None    # consumed
+        q.done(item)
+        # done() without a re-add leaves no residue for the item.
+        assert q.num_requeues(item) == 0
+        q.shut_down()

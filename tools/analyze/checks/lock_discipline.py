@@ -137,17 +137,27 @@ def check(ctx: FileContext) -> List[Finding]:
     if ctx.tree is None:
         return []
     findings: List[Finding] = []
+    # One sweep over the file's cached Assign bucket, attributed to the
+    # nearest enclosing class via the shared parents map -- re-walking
+    # every method body per class was a visible slice of the lint budget.
+    parents = ctx.parents
+    lock_attrs_by_class: Dict[int, Set[str]] = {}
+    for node in ctx.by_type(ast.Assign):
+        if not _is_lock_factory(node.value):
+            continue
+        attrs = {a for a in (_self_attr(t) for t in node.targets)
+                 if a is not None}
+        if not attrs:
+            continue
+        anc = parents.get(id(node))
+        while anc is not None and not isinstance(anc, ast.ClassDef):
+            anc = parents.get(id(anc))
+        if anc is not None:
+            lock_attrs_by_class.setdefault(id(anc), set()).update(attrs)
     for cls in ctx.by_type(ast.ClassDef):
         methods = [n for n in cls.body
                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-        lock_attrs: Set[str] = set()
-        for m in methods:
-            for node in ast.walk(m):
-                if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
-                    for t in node.targets:
-                        attr = _self_attr(t)
-                        if attr is not None:
-                            lock_attrs.add(attr)
+        lock_attrs = lock_attrs_by_class.get(id(cls), set())
         if not lock_attrs:
             continue
 

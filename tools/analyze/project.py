@@ -210,9 +210,19 @@ class _BodyWalker:
 
     def walk(self, node: ast.AST, held: List[str]) -> None:
         """Visit every descendant of ``node`` (not ``node`` itself),
-        maintaining the stack of held locks through ``with`` blocks."""
-        for child in ast.iter_child_nodes(node):
-            self.visit(child, held)
+        maintaining the stack of held locks through ``with`` blocks.
+        Child enumeration is inlined (same trick as FileContext._build_walk):
+        iter_child_nodes/iter_fields generator resumptions over every method
+        body in the tree are a visible slice of the lint budget."""
+        visit = self.visit
+        for name in node._fields:
+            v = getattr(node, name, None)
+            if v.__class__ is list:
+                for item in v:
+                    if isinstance(item, ast.AST):
+                        visit(item, held)
+            elif isinstance(v, ast.AST):
+                visit(v, held)
 
     def visit(self, node: ast.AST, held: List[str]) -> None:
         if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -308,6 +318,39 @@ class ProjectContext:
             elif isinstance(node, ast.ClassDef):
                 ci = self._index_class(mod, node)
                 info.classes[node.name] = ci
+        # Self-attribute inference (lock attrs + attr constructors): one
+        # sweep over the file's cached Assign bucket attributed to the
+        # enclosing top-level class via the parents map.  An ast.walk per
+        # method body here was a visible slice of the lint budget.
+        by_node = {id(ci.node): ci for ci in info.classes.values()}
+        parents = ctx.parents
+        for sub in ctx.by_type(ast.Assign):
+            kind = _lock_factory_name(sub.value)
+            ctor = None
+            if kind is None:
+                if isinstance(sub.value, ast.Call):
+                    ctor = _dotted(sub.value.func)
+                if ctor is None:
+                    continue
+            attrs = [a for a in (_self_attr(t) for t in sub.targets)
+                     if a is not None]
+            if not attrs:
+                continue
+            anc = parents.get(id(sub))
+            owner = None
+            while anc is not None:
+                if isinstance(anc, ast.ClassDef):
+                    owner = by_node.get(id(anc))
+                    if owner is not None:
+                        break
+                anc = parents.get(id(anc))
+            if owner is None:
+                continue
+            for attr in attrs:
+                if kind is not None:
+                    owner.lock_attrs[attr] = kind
+                else:
+                    owner.attr_ctors.setdefault(attr, ctor)
         # Summaries need the full lock attr/module-lock sets, so second pass.
         for ci in info.classes.values():
             lock_names = set(ci.lock_attrs)
@@ -331,20 +374,8 @@ class ProjectContext:
         for item in node.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 ci.methods[item.name] = item
-                for sub in ast.walk(item):
-                    if isinstance(sub, ast.Assign):
-                        kind = _lock_factory_name(sub.value)
-                        ctor = None
-                        if kind is None and isinstance(sub.value, ast.Call):
-                            ctor = _dotted(sub.value.func)
-                        for t in sub.targets:
-                            attr = _self_attr(t)
-                            if attr is None:
-                                continue
-                            if kind is not None:
-                                ci.lock_attrs[attr] = kind
-                            elif ctor is not None:
-                                ci.attr_ctors.setdefault(attr, ctor)
+                # lock_attrs/attr_ctors are filled by _index_module's single
+                # file-level Assign sweep (parents-attributed).
             elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
                     and isinstance(item.targets[0], ast.Name) \
                     and isinstance(item.value, ast.Constant) \

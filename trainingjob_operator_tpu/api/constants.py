@@ -33,6 +33,12 @@ GANG_LABEL = "TPUGang"
 # API calls; placing the visible subset first-come steals its capacity).
 GANG_SIZE_LABEL = "TPUGangSize"
 
+# Name of the informer secondary index mapping a pod/service to its owning
+# job ("ns/jobname" from the GroupName+TrainingJobName label pair --
+# controller.job_index_key).  An indexed lookup is O(job's objects); the
+# lister list it replaces deepcopied the whole store per reconcile.
+JOB_INDEX = "by-job"
+
 # --- identity env vars injected into every container
 # (reference: constants.go:13-21, pkg/controller/pod.go:600-628) -------------
 REPLICA_NAME_ENV = "TRAININGJOB_REPLICA_NAME"
@@ -111,6 +117,11 @@ SHARDY_ENV = "TRAININGJOB_SHARDY"
 VIRTUAL_DEVICES_PER_SLICE_ENV = "TRAININGJOB_VIRTUAL_DEVICES_PER_SLICE"
 # Pallas kernel selection for ops/ ("auto"/"force"/"off"/"interpret"; see
 # ops.use_pallas) and flash-attention block-size overrides for odd shapes.
+# Fleet churn-harness defaults (fleet/harness.py CLI, `make fleet-smoke`):
+# the seed feeding the deterministic churn generator and the number of jobs
+# driven.  User-set, never injected into containers.
+FLEET_SEED_ENV = "TRAININGJOB_FLEET_SEED"
+FLEET_JOBS_ENV = "TRAININGJOB_FLEET_JOBS"
 PALLAS_ENV = "TRAININGJOB_PALLAS"
 FA_BLOCK_Q_ENV = "TRAININGJOB_FA_BLOCK_Q"
 FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
@@ -139,6 +150,8 @@ USER_ENV_KNOBS = frozenset((
     FA_BLOCK_Q_ENV,
     FA_BLOCK_K_ENV,
     PREFETCH_STALL_ENV,
+    FLEET_SEED_ENV,
+    FLEET_JOBS_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --

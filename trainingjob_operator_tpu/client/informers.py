@@ -10,6 +10,7 @@ update/delete handler triples the controller wires up
 
 from __future__ import annotations
 
+import copy
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -59,6 +60,13 @@ class Informer:
         self._lock = threading.Lock()
         self._handlers: List[Dict[str, Callable]] = []
         self._last_seen: Dict[str, Any] = {}
+        # index name -> key fn; index name -> index key -> {obj key: obj}.
+        # Maintained incrementally from the same event stream the handlers
+        # see, so an indexed lookup is O(bucket) instead of an O(store)
+        # deepcopy list -- the difference between a reconcile that scales
+        # with the job's pods and one that scales with the cluster.
+        self._index_fns: Dict[str, Callable[[Any], Optional[str]]] = {}
+        self._indices: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self._unsub = tracker.watch(kind, self._on_event)
         with self._lock:
             for obj in tracker.list(kind):
@@ -79,6 +87,44 @@ class Informer:
             for obj in self._tracker.list(self._kind):
                 on_add(obj)
 
+    def add_index(self, name: str, key_fn: Callable[[Any], Optional[str]]) -> None:
+        """Register a secondary index (reference: cache.Indexer).  ``key_fn``
+        maps an object to its index key, or None to leave it unindexed.
+        Existing cached objects are indexed immediately; later watch events
+        keep the buckets current."""
+        with self._lock:
+            self._index_fns[name] = key_fn
+            buckets: Dict[str, Dict[str, Any]] = {}
+            self._indices[name] = buckets
+            for obj_key, obj in self._last_seen.items():
+                idx_key = key_fn(obj)
+                if idx_key is not None:
+                    buckets.setdefault(idx_key, {})[obj_key] = obj
+
+    def by_index(self, name: str, key: str) -> List[Any]:
+        """All cached objects whose index key equals ``key`` (deepcopied, like
+        a lister read: callers may mutate freely)."""
+        with self._lock:
+            bucket = self._indices.get(name, {}).get(key)
+            if not bucket:
+                return []
+            return [copy.deepcopy(obj) for obj in bucket.values()]
+
+    def _reindex(self, key: str, old: Optional[Any], new: Optional[Any]) -> None:
+        """Move ``key`` between index buckets.  Caller holds ``_lock``."""
+        for name, key_fn in self._index_fns.items():
+            buckets = self._indices[name]
+            old_key = key_fn(old) if old is not None else None
+            new_key = key_fn(new) if new is not None else None
+            if old_key is not None and old_key != new_key:
+                bucket = buckets.get(old_key)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        buckets.pop(old_key, None)
+            if new_key is not None:
+                buckets.setdefault(new_key, {})[key] = new
+
     def _on_event(self, event: WatchEvent) -> None:
         obj = event.obj
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
@@ -87,8 +133,10 @@ class Informer:
             old = self._last_seen.get(key)
             if event.type == DELETED:
                 self._last_seen.pop(key, None)
+                self._reindex(key, old if old is not None else obj, None)
             else:
                 self._last_seen[key] = obj
+                self._reindex(key, old, obj)
         for h in handlers:
             if event.type == ADDED and h["add"]:
                 h["add"](obj)

@@ -8,18 +8,34 @@ Semantics preserved from client-go:
 - An item present in the queue is not added again (dedup).
 - An item being processed (between Get and Done) that is re-added is marked
   dirty and requeued on Done -- the single-writer-per-key guarantee the
-  reconcile loop's correctness rests on (SURVEY.md §5.2).
+  reconcile loop's correctness rests on (SURVEY.md §5.2).  This is what makes
+  raising ``thread_num`` safe: however many workers drain the queue, a key is
+  never reconciled by two of them at once (tests/test_workqueue_concurrency.py
+  hammers exactly this).
 - ``add_rate_limited`` applies per-item exponential backoff
   (base 5 ms, cap 1000 s -- client-go's DefaultControllerRateLimiter
   ItemExponentialFailureRateLimiter parameters); ``forget`` resets it.
+- ``add_after`` coalesces duplicate delayed keys to the EARLIEST pending
+  deadline (client-go delayingQueue waitForPriorityQueue semantics): a job
+  that arms a delayed re-sync on every reconcile must not grow the heap by
+  one entry per sync.  Superseded heap entries are dropped lazily on pop.
+- ``shut_down`` cancels all pending delayed deliveries (the single pump
+  thread exits and the waiting heap is cleared) -- a fleet-scale run that
+  armed thousands of delayed re-syncs leaks nothing on teardown.
+
+Scale counters (read by the controller's metrics gauges and bench.py):
+``retries_total`` (rate-limited requeues), ``depth_high_water`` (max ready
+depth observed), and per-item queue-wait tracking (``pop_wait``) feeding the
+``trainingjob_reconcile_latency_ms`` histogram.
 """
 
 from __future__ import annotations
 
+import collections
 import heapq
 import threading
 import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 
 class RateLimitingQueue:
@@ -29,19 +45,42 @@ class RateLimitingQueue:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._cond = threading.Condition()
-        self._queue: List[Any] = []          # FIFO of ready items
+        self._queue: Deque[Any] = collections.deque()  # FIFO of ready items
         self._queued: Set[Any] = set()        # items in _queue
         self._processing: Set[Any] = set()
         self._dirty: Set[Any] = set()         # re-added while processing
         self._waiting: List[Tuple[float, int, Any]] = []  # delayed heap
         self._waiting_seq = 0
+        # item -> its earliest pending deadline; the authoritative view of the
+        # delayed set (heap entries that disagree are stale and skipped).
+        self._waiting_deadlines: Dict[Any, float] = {}
         self._failures: Dict[Any, int] = {}
+        # First-enqueue timestamp while the item sits ready, moved to
+        # _wait_seconds on get() (single processor per key -> no races).
+        self._enqueued_at: Dict[Any, float] = {}
+        self._wait_seconds: Dict[Any, float] = {}
         self._shutdown = False
+        #: Scale observability (monotonic; read without the lock is fine).
+        self.retries_total = 0
+        self.coalesced_total = 0
+        self.depth_high_water = 0
         self._pump = threading.Thread(target=self._pump_waiting, daemon=True,
                                       name=f"workqueue-{name}-delay")
         self._pump.start()
 
     # -- add variants --------------------------------------------------------
+
+    def _append_ready(self, item: Any) -> None:
+        """Append to the ready FIFO.  Caller holds ``_cond``."""
+        self._queue.append(item)
+        # analyzer: allow[lock-discipline] every caller (add, done,
+        # _pump_waiting) invokes this helper with self._cond already held;
+        # the mutation is lock-protected, just not lexically.
+        self._queued.add(item)
+        self._enqueued_at.setdefault(item, time.monotonic())
+        if len(self._queue) > self.depth_high_water:
+            self.depth_high_water = len(self._queue)
+        self._cond.notify_all()
 
     def add(self, item: Any) -> None:
         with self._cond:
@@ -52,9 +91,7 @@ class RateLimitingQueue:
                 return
             if item in self._queued:
                 return
-            self._queue.append(item)
-            self._queued.add(item)
-            self._cond.notify_all()
+            self._append_ready(item)
 
     def add_after(self, item: Any, delay: float) -> None:
         if delay <= 0:
@@ -63,14 +100,24 @@ class RateLimitingQueue:
         with self._cond:
             if self._shutdown:
                 return
+            deadline = time.monotonic() + delay
+            current = self._waiting_deadlines.get(item)
+            if current is not None:
+                # Coalesce to the earliest deadline; the later entry stays in
+                # the heap and is discarded on pop (deadline mismatch).
+                self.coalesced_total += 1
+                if current <= deadline:
+                    return
+            self._waiting_deadlines[item] = deadline
             self._waiting_seq += 1
-            heapq.heappush(self._waiting, (time.monotonic() + delay, self._waiting_seq, item))
+            heapq.heappush(self._waiting, (deadline, self._waiting_seq, item))
             self._cond.notify_all()
 
     def add_rate_limited(self, item: Any) -> None:
         with self._cond:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
+            self.retries_total += 1
         delay = min(self._base_delay * (2 ** failures), self._max_delay)
         self.add_after(item, delay)
 
@@ -97,20 +144,29 @@ class RateLimitingQueue:
                 self._cond.wait(timeout=remaining)
             if self._shutdown and not self._queue:
                 return None, True
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             self._queued.discard(item)
             self._processing.add(item)
+            ts = self._enqueued_at.pop(item, None)
+            if ts is not None:
+                self._wait_seconds[item] = time.monotonic() - ts
             return item, False
+
+    def pop_wait(self, item: Any) -> Optional[float]:
+        """Seconds the item most recently spent ready-queued before its get()
+        (None when unknown).  Valid between get() and done() -- the
+        single-writer-per-key guarantee makes the per-item slot race-free."""
+        with self._cond:
+            return self._wait_seconds.pop(item, None)
 
     def done(self, item: Any) -> None:
         with self._cond:
             self._processing.discard(item)
+            self._wait_seconds.pop(item, None)
             if item in self._dirty:
                 self._dirty.discard(item)
                 if item not in self._queued:
-                    self._queue.append(item)
-                    self._queued.add(item)
-                    self._cond.notify_all()
+                    self._append_ready(item)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -118,9 +174,19 @@ class RateLimitingQueue:
         with self._cond:
             return len(self._queue)
 
+    def waiting(self) -> int:
+        """Delayed items pending delivery (post-coalescing)."""
+        with self._cond:
+            return len(self._waiting_deadlines)
+
     def shut_down(self) -> None:
         with self._cond:
             self._shutdown = True
+            # Cancel pending delayed deliveries: nothing may fire after
+            # shutdown, and a fleet run's thousands of armed re-syncs must
+            # not pin their keys in memory.
+            self._waiting.clear()
+            self._waiting_deadlines.clear()
             self._cond.notify_all()
 
     def _pump_waiting(self) -> None:
@@ -130,11 +196,12 @@ class RateLimitingQueue:
                     return
                 now = time.monotonic()
                 while self._waiting and self._waiting[0][0] <= now:
-                    _, _, item = heapq.heappop(self._waiting)
+                    deadline, _, item = heapq.heappop(self._waiting)
+                    if self._waiting_deadlines.get(item) != deadline:
+                        continue  # superseded by an earlier re-add: stale
+                    del self._waiting_deadlines[item]
                     if item not in self._queued and item not in self._processing:
-                        self._queue.append(item)
-                        self._queued.add(item)
-                        self._cond.notify_all()
+                        self._append_ready(item)
                     elif item in self._processing:
                         self._dirty.add(item)
                 # Sleep until the next delayed item is due; add_after/shut_down

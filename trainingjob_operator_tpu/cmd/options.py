@@ -36,6 +36,10 @@ class OperatorOptions:
     enable_creating_failed: bool = False
     namespace: str = ""                        # "" = all namespaces
     resync_period: float = 10.0
+    # Shards the periodic resync snapshot into this many hash-stable buckets
+    # enqueued evenly across the period, so a fleet-sized job set never lands
+    # on the workqueue as one storm (controller._resync_loop).
+    resync_shards: int = 8
     gc_interval: float = 600.0                 # reference: controller.go:204
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     backend: str = "sim"                       # sim | localproc | kube
@@ -61,6 +65,9 @@ class OperatorOptions:
                             help="Namespace to watch (default: all).")
         parser.add_argument("--resync-period", type=float, default=10.0,
                             help="Informer resync interval, seconds.")
+        parser.add_argument("--resync-shards", type=int, default=8,
+                            help="Buckets the resync enqueue is spread across "
+                                 "within each period (jitter at fleet scale).")
         parser.add_argument("--creating-restart-period", type=float, default=0.0,
                             dest="creating_restart_time",
                             help="Window during which container-create errors retry, seconds.")
@@ -94,6 +101,7 @@ class OperatorOptions:
             thread_num=args.thread_num,
             namespace=args.namespace,
             resync_period=args.resync_period,
+            resync_shards=args.resync_shards,
             creating_restart_time=args.creating_restart_time,
             creating_duration_time=args.creating_duration_time,
             enable_creating_failed=args.enable_creating_failed,

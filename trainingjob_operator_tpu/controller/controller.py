@@ -12,7 +12,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Set
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.api.defaults import set_defaults
@@ -40,6 +41,7 @@ from trainingjob_operator_tpu.controller.garbage_collection import GarbageCollec
 from trainingjob_operator_tpu.api.tpu import resolve_slice_shape
 from trainingjob_operator_tpu.controller.naming import effective_replicas, job_selector
 from trainingjob_operator_tpu.controller.pod import PodReconciler
+from trainingjob_operator_tpu.controller.pod_index import PodPhaseIndex
 from trainingjob_operator_tpu.controller.service import ServiceReconciler
 from trainingjob_operator_tpu.controller.status import StatusManager, update_job_conditions
 from trainingjob_operator_tpu.core.objects import Node, OwnerReference, Pod, Service
@@ -49,6 +51,41 @@ from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.controller")
+
+# Buckets for millisecond-valued latency histograms (the registry default is
+# seconds-scaled and would collapse everything into its top bucket).
+LATENCY_MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 15000.0, 60000.0)
+
+
+def _material_status(status: Any) -> dict:
+    """Status dict with the per-sync-volatile timestamps stripped, for the
+    did-anything-change write gate.  ``update_job_conditions`` refreshes the
+    current condition's lastProbeTime on every sync; writing that refresh
+    back would echo a MODIFIED event that re-enqueues the job, whose sync
+    refreshes the probe time again -- a self-sustaining write loop per idle
+    job.  Phase, reasons, messages, counters, and transition times all still
+    count as material."""
+    d = status.to_dict()
+    d.pop("lastReconcileTime", None)
+    conds = d.get("conditions")
+    if conds:
+        d["conditions"] = [{k: v for k, v in c.items() if k != "lastProbeTime"}
+                           for c in conds]
+    return d
+
+
+def job_index_key(obj: Any) -> Optional[str]:
+    """Informer index key: "ns/jobname" from the operator's two-label
+    selector (naming.job_selector), None for objects we never own.  Orphans
+    carrying the labels index too -- adoption must still see them."""
+    labels = obj.metadata.labels
+    if labels.get(constants.GROUP_NAME_LABEL) != constants.GROUP_NAME:
+        return None
+    job_name = labels.get(constants.JOB_NAME_LABEL)
+    if not job_name:
+        return None
+    return f"{obj.metadata.namespace}/{job_name}"
 
 
 class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
@@ -73,6 +110,20 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.pod_lister = pod_informer.lister
         self.service_lister = service_informer.lister
         self.node_lister = self.informer_factory.lister(Node.KIND)
+        # Indexed cache lookups (get_pods_by_job/get_services_by_job read
+        # these instead of relisting the store per reconcile).
+        self.pod_informer = pod_informer
+        self.service_informer = service_informer
+        pod_informer.add_index(constants.JOB_INDEX, job_index_key)
+        service_informer.add_index(constants.JOB_INDEX, job_index_key)
+        # O(changed-pods) status recomputation: one record per pod, updated
+        # from informer deltas by the pod handlers below.
+        self.pod_phase_index = PodPhaseIndex()
+        # Job-key set maintained from informer add/delete deltas: feeds the
+        # trainingjob_jobs gauge and the resync snapshot without O(all-jobs)
+        # lister relists per scrape/tick.
+        self._job_keys: Set[str] = set()
+        self._job_keys_lock = threading.Lock()
 
         # Handler registration (reference: controller.go:118-156).
         job_informer.add_event_handler(
@@ -110,12 +161,20 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     # -- job event handlers (reference: trainingjob.go:17-51) ----------------
 
     def add_trainingjob(self, job: TPUTrainingJob) -> None:
+        with self._job_keys_lock:
+            self._job_keys.add(meta_namespace_key(job))
         self.enqueue_job(job)
 
     def update_trainingjob(self, old: TPUTrainingJob, cur: TPUTrainingJob) -> None:
         if old.metadata.resource_version == cur.metadata.resource_version:
             return
-        self.enqueue_job(cur, rate_limited=True)
+        # Deviation from the reference (trainingjob.go:29 AddRateLimited):
+        # plain add.  Most MODIFIED events are echoes of our own status
+        # writes; the delayed-heap path re-fires each echo individually,
+        # while add() dedups against the ready queue and the in-flight key
+        # (dirty-mark), collapsing a write burst into one re-sync.  Under
+        # fleet churn this halves the sync count (docs/FLEET.md).
+        self.enqueue_job(cur)
         # TimeLimit added/changed while running: arm a delayed re-sync
         # (trainingjob.go:38-45).
         if (cur.status.start_running_time is not None
@@ -126,6 +185,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             self.enqueue_job(cur, delay=max(cur.spec.time_limit - passed, 0.0))
 
     def delete_trainingjob(self, job: TPUTrainingJob) -> None:
+        with self._job_keys_lock:
+            self._job_keys.discard(meta_namespace_key(job))
         self.enqueue_job(job)
 
     def enqueue_job(self, job: TPUTrainingJob, rate_limited: bool = False,
@@ -134,6 +195,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         key = meta_namespace_key(job)
         if rate_limited:
             self.work_queue.add_rate_limited(key)
+            self.metrics.inc("trainingjob_workqueue_retries_total")
         elif delay > 0:
             self.work_queue.add_after(key, delay)
         else:
@@ -159,8 +221,13 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # instance and shadow the running one).
         self.metrics.gauge("trainingjob_workqueue_depth",
                            lambda: float(len(self.work_queue)))
+        self.metrics.gauge("trainingjob_workqueue_depth_high_water",
+                           lambda: float(self.work_queue.depth_high_water))
+        # Counter maintained from informer add/delete deltas -- a scrape must
+        # not pay an O(all-jobs) lister relist (at 10k jobs that is 10k
+        # deepcopies per scrape).
         self.metrics.gauge("trainingjob_jobs",
-                           lambda: float(len(self.trainingjob_lister.list(None))))
+                           lambda: float(len(self._job_keys)))
         # Telemetry watchdog findings (StepStalled/StepResumed) become job
         # events and a reconcile kick so the Running message refreshes.
         TELEMETRY.set_event_sink(self._telemetry_event)
@@ -189,6 +256,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
 
     def stop(self) -> None:
         self.metrics.remove_gauge("trainingjob_workqueue_depth")
+        self.metrics.remove_gauge("trainingjob_workqueue_depth_high_water")
         self.metrics.remove_gauge("trainingjob_jobs")
         TELEMETRY.set_event_sink(None)
         self._ready.clear()
@@ -214,10 +282,31 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.enqueue_job(job, rate_limited=True)
 
     def _resync_loop(self) -> None:
-        """Periodic full re-enqueue (reference: informer resync, 10 s)."""
-        while not self._stop.wait(self.options.resync_period):
-            for job in self.trainingjob_lister.list(self.options.namespace or None):
-                self.enqueue_job(job)
+        """Periodic full re-enqueue (reference: informer resync, 10 s),
+        sharded and jittered for fleet scale: one snapshot of the informer-
+        maintained key set per period (no O(all-jobs) lister relist), split
+        into ``resync_shards`` hash-stable buckets enqueued evenly across the
+        period -- 10k jobs arrive as a drizzle the workers absorb, not a
+        single enqueue-storm that spikes queue depth and event->visible
+        latency for everything behind it."""
+        shards = max(1, int(self.options.resync_shards))
+        interval = self.options.resync_period / shards
+        while not self._stop.is_set():
+            with self._job_keys_lock:
+                keys = list(self._job_keys)
+            namespace = self.options.namespace
+            if namespace:
+                keys = [k for k in keys if k.split("/", 1)[0] == namespace]
+            buckets: List[List[str]] = [[] for _ in range(shards)]
+            for key in keys:
+                # crc32, not hash(): per-key phase must be stable across runs
+                # (PYTHONHASHSEED randomizes str hashing per process).
+                buckets[zlib.crc32(key.encode("utf-8")) % shards].append(key)
+            for bucket in buckets:
+                if self._stop.wait(interval):
+                    return
+                for key in bucket:
+                    self.work_queue.add(key)
 
     def _worker(self) -> None:
         """Reference: worker/processNextWorkItem (controller.go:236-268)."""
@@ -230,17 +319,26 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             return False
         if item is None:
             return True
+        started = time.monotonic()
+        queue_wait = self.work_queue.pop_wait(item) or 0.0
         try:
             forget = self.sync_handler(item)
             if forget:
                 self.work_queue.forget(item)
             else:
                 self.work_queue.add_rate_limited(item)
+                self.metrics.inc("trainingjob_workqueue_retries_total")
         except Exception:
             log.exception("sync %r failed", item)
             self.work_queue.add_rate_limited(item)
+            self.metrics.inc("trainingjob_workqueue_retries_total")
         finally:
             self.work_queue.done(item)
+            # Enqueue -> reconcile-finished: queue wait plus sync duration.
+            self.metrics.observe(
+                "trainingjob_reconcile_latency_ms",
+                (queue_wait + time.monotonic() - started) * 1000.0,
+                buckets=LATENCY_MS_BUCKETS)
         return True
 
     # -- sync (reference: syncHandler, controller.go:270-312) ----------------
@@ -333,8 +431,11 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         old_status = job.deepcopy().status
         old_annotations = dict(job.metadata.annotations)
         selector = job_selector(job.name)
-        pods = self.get_pods_by_job(job, selector)
-        services = self.get_services_by_job(job, selector)
+        with TRACER.span("list_owned") as sp:
+            pods = self.get_pods_by_job(job, selector)
+            services = self.get_services_by_job(job, selector)
+            sp.set_attribute("pods", len(pods))
+            sp.set_attribute("services", len(services))
 
         job_key = meta_namespace_key(job)
         self._register_peak_flops(job, job_key)
@@ -379,7 +480,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         message = "; ".join(aggregation_msg)
         with TRACER.span("update_status"):
             self.update_status(job, pods, services, ending_phases, message)
-        if (job.status.to_dict() != old_status.to_dict()
+        if (_material_status(job.status) != _material_status(old_status)
                 or job.metadata.annotations != old_annotations):
             job.status.last_reconcile_time = time.time()
             with TRACER.span("write_status", phase=job.status.phase):

@@ -61,6 +61,10 @@ class PodReconciler:
     # -- informer handlers (reference: pod.go:23-123) ------------------------
 
     def add_pod(self, pod: Pod) -> None:
+        # Index maintenance precedes the ownership gate: the phase index keys
+        # on the owner ref directly (it must see deletes even after the owner
+        # job is gone from the lister).
+        self.pod_phase_index.observe(pod)
         if pod.metadata.deletion_timestamp is not None:
             return
         job = self._resolve_controller_ref(pod.metadata.namespace,
@@ -76,6 +80,7 @@ class PodReconciler:
     def update_pod(self, old: Pod, cur: Pod) -> None:
         if old.metadata.resource_version == cur.metadata.resource_version:
             return
+        self.pod_phase_index.observe(cur)
         job = self._resolve_controller_ref(cur.metadata.namespace,
                                            cur.metadata.controller_of())
         if job is None:
@@ -83,6 +88,7 @@ class PodReconciler:
         self.enqueue_job(job)
 
     def delete_pod(self, pod: Pod) -> None:
+        self.pod_phase_index.observe_delete(pod)
         job = self._resolve_controller_ref(pod.metadata.namespace,
                                            pod.metadata.controller_of())
         if job is None:
@@ -96,7 +102,16 @@ class PodReconciler:
     # -- claiming (reference: pod.go:125-150) --------------------------------
 
     def get_pods_by_job(self, job: TPUTrainingJob, selector: Dict[str, str]) -> List[Pod]:
-        all_pods = self.pod_lister.list(job.namespace, selector)
+        # Indexed informer-cache lookup: O(job's pods), not an O(cluster)
+        # tracker relist.  The bucket is keyed on the same two labels as the
+        # selector (see controller.job_index_key), so orphans with matching
+        # labels still surface for adoption; _claim_pods keeps uid discipline.
+        informer = getattr(self, "pod_informer", None)
+        if informer is not None:
+            all_pods = informer.by_index(
+                constants.JOB_INDEX, f"{job.namespace}/{job.name}")
+        else:
+            all_pods = self.pod_lister.list(job.namespace, selector)
         return self._claim_pods(job, all_pods)
 
     def _claim_pods(self, job: TPUTrainingJob, pods: List[Pod]) -> List[Pod]:

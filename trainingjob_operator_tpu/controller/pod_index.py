@@ -1,0 +1,145 @@
+"""Per-job pod-phase index maintained from informer deltas.
+
+At fleet scale the controller cannot afford to re-derive replica counters by
+walking a job's full pod list on every sync if the lookup itself costs an
+O(cluster) relist -- and it doubly cannot afford the O(all-pods) scans the
+gauges and the resync loop used to do.  This index is the O(changed) answer:
+every pod informer delta updates one record (``observe``/``observe_delete``
+are O(1)), and a status recomputation reads the job's compact record set
+instead of deepcopied Pod objects.
+
+Consistency model: records are written by the informer dispatch thread (the
+same commit-ordered stream the informer cache sees), so a sync racing a
+just-delivered event may read counters one event stale -- but that event's
+handler re-enqueues the job, so the next sync converges.  That is exactly the
+eventual-consistency contract reconciles already live under.  As
+belt-and-braces, ``StatusManager.update_status`` only trusts the index when
+its population for the (job, group, width) agrees with the claimed-pod
+snapshot, falling back to the list recount otherwise.
+
+Records are keyed by the pod's controller owner reference (name + uid), so a
+deleted-and-recreated job with the same name never inherits counts from the
+old incarnation's lingering pods.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import ReplicaStatus
+from trainingjob_operator_tpu.core.objects import Pod, PodPhase
+
+
+class _PodRecord:
+    __slots__ = ("rtype", "index", "phase", "has_node", "owner_uid")
+
+    def __init__(self, rtype: str, index: Optional[int], phase: str,
+                 has_node: bool, owner_uid: str):
+        self.rtype = rtype
+        self.index = index
+        self.phase = phase
+        self.has_node = has_node
+        self.owner_uid = owner_uid
+
+
+def _owner_job_key(pod: Pod):
+    """(job key, owner uid) from the pod's controlling owner reference, or
+    None for orphans (they are indexed once adoption lands as a MODIFIED)."""
+    ref = pod.metadata.controller_of()
+    if ref is None or ref.kind != constants.KIND:
+        return None
+    return f"{pod.metadata.namespace}/{ref.name}", ref.uid
+
+
+class PodPhaseIndex:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # job key -> pod "ns/name" -> record
+        self._jobs: Dict[str, Dict[str, _PodRecord]] = {}
+
+    # -- maintenance (called from the pod informer handlers) -----------------
+
+    def observe(self, pod: Pod) -> None:
+        owner = _owner_job_key(pod)
+        if owner is None:
+            return
+        job_key, uid = owner
+        rtype = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL)
+        if rtype is None:
+            return
+        # Mirrors naming.pod_index: absent/garbled -> None (never counted).
+        idx_label = pod.metadata.labels.get(constants.REPLICA_INDEX_LABEL, "")
+        index: Optional[int] = int(idx_label) if idx_label.isdigit() else None
+        rec = _PodRecord(rtype, index, pod.status.phase,
+                         bool(pod.spec.node_name), uid)
+        pod_key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            self._jobs.setdefault(job_key, {})[pod_key] = rec
+
+    def observe_delete(self, pod: Pod) -> None:
+        owner = _owner_job_key(pod)
+        if owner is None:
+            return
+        job_key, _ = owner
+        pod_key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            records = self._jobs.get(job_key)
+            if records is not None:
+                records.pop(pod_key, None)
+                if not records:
+                    self._jobs.pop(job_key, None)
+
+    def forget_job(self, job_key: str) -> None:
+        with self._lock:
+            self._jobs.pop(job_key, None)
+
+    # -- reads ---------------------------------------------------------------
+
+    def replica_status(self, job_key: str, owner_uid: str, rtype: str,
+                       width: int, restarted: bool
+                       ) -> Tuple[ReplicaStatus, int]:
+        """(counters, population) for the job's group, counting only records
+        below the elastic width (reservation probes and not-yet-drained
+        out-of-range pods sit above it) -- the index twin of
+        StatusManager._recount_replica_status."""
+        rt = rtype.lower()
+        rs = ReplicaStatus()
+        population = 0
+        with self._lock:
+            records = self._jobs.get(job_key)
+            if not records:
+                return rs, 0
+            for rec in records.values():
+                if rec.rtype != rt or rec.owner_uid != owner_uid:
+                    continue
+                if rec.index is None or rec.index >= width:
+                    continue
+                population += 1
+                if rec.phase == PodPhase.PENDING:
+                    if restarted:
+                        rs.restarting += 1
+                    elif rec.has_node:
+                        rs.scheduled += 1
+                    else:
+                        rs.pending += 1
+                elif rec.phase == PodPhase.RUNNING:
+                    rs.active += 1
+                elif rec.phase == PodPhase.SUCCEEDED:
+                    rs.succeeded += 1
+                else:  # Failed / Unknown
+                    rs.failed += 1
+        return rs, population
+
+    def pod_count(self, job_key: str) -> int:
+        with self._lock:
+            return len(self._jobs.get(job_key, ()))
+
+    def total_pods(self) -> int:
+        with self._lock:
+            return sum(len(records) for records in self._jobs.values())
+
+    def job_keys(self):
+        with self._lock:
+            return list(self._jobs)

@@ -82,7 +82,13 @@ class ServiceReconciler:
 
     def get_services_by_job(self, job: TPUTrainingJob,
                             selector: Dict[str, str]) -> List[Service]:
-        all_services = self.service_lister.list(job.namespace, selector)
+        # Indexed informer-cache lookup, same shape as get_pods_by_job.
+        informer = getattr(self, "service_informer", None)
+        if informer is not None:
+            all_services = informer.by_index(
+                constants.JOB_INDEX, f"{job.namespace}/{job.name}")
+        else:
+            all_services = self.service_lister.list(job.namespace, selector)
         claimed = []
         for svc in all_services:
             ref = svc.metadata.controller_of()

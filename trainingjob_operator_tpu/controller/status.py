@@ -153,14 +153,27 @@ class StatusManager:
     def update_status(self, job: TPUTrainingJob, pods: List[Pod],
                       services: List[Service],
                       ending_phases: Dict[str, str], message: str) -> None:
+        phase_index = getattr(self, "pod_phase_index", None)
+        job_key = meta_namespace_key(job)
         for rtype in job.spec.replica_specs:
             self._initialize_replica_status(job, rtype)
             rt_pods = filter_for_replica_type(pods, rtype.lower())
             # Reservation (probe) pods and not-yet-drained out-of-range pods
             # sit above the elastic width and must not count.
-            self._recount_replica_status(
-                job, rtype,
-                pods_below_width(rt_pods, effective_replicas(job, rtype)))
+            width = effective_replicas(job, rtype)
+            counted = pods_below_width(rt_pods, width)
+            if phase_index is not None:
+                # O(changed-pods) fast path: counters from the informer-delta
+                # index.  Only trusted when its population agrees with the
+                # claimed-pod snapshot (the index may be one event stale; the
+                # event that made it stale has already re-enqueued this job).
+                rs, population = phase_index.replica_status(
+                    job_key, job.metadata.uid, rtype,
+                    width, job.status.restart_counts.get(rtype, 0) > 0)
+                if population == len(counted):
+                    job.status.replica_statuses[rtype] = rs
+                    continue
+            self._recount_replica_status(job, rtype, counted)
 
         # Elastic-resize drain: wait for the resized group's pods to vanish,
         # then clear the marker so the next sync recreates the group at the
@@ -268,7 +281,13 @@ class StatusManager:
                     GOODPUT.on_complete(meta_namespace_key(job), now)
                     TELEMETRY.on_complete(meta_namespace_key(job))
                 else:
-                    self.enqueue_job(job, rate_limited=True)
+                    # Drain progress arrives as pod DELETED events that
+                    # re-enqueue this job; the delayed poll is only a safety
+                    # net and coalesces per key (add_after).  Rate-limited
+                    # requeue here spun at the 5 ms backoff base for every
+                    # draining job -- at fleet scale that was most of the
+                    # sync volume.
+                    self.enqueue_job(job, delay=0.5)
                 return
 
         # Time limit (status.go:189-198).

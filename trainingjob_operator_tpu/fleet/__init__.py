@@ -1,0 +1,22 @@
+"""Fleet-scale churn harness: drive thousands of concurrent TPUTrainingJobs
+over the sim runtime with a seeded disruption schedule and assert the control
+plane converges (docs/FLEET.md)."""
+
+from trainingjob_operator_tpu.fleet.churn import ChurnGenerator, ChurnProfile, JobPlan
+
+__all__ = [
+    "ChurnGenerator",
+    "ChurnProfile",
+    "JobPlan",
+    "FleetHarness",
+    "FleetReport",
+]
+
+
+def __getattr__(name):
+    # Lazy: `python -m trainingjob_operator_tpu.fleet.harness` would otherwise
+    # trip runpy's found-in-sys.modules warning via an eager import here.
+    if name in ("FleetHarness", "FleetReport"):
+        from trainingjob_operator_tpu.fleet import harness
+        return getattr(harness, name)
+    raise AttributeError(name)

@@ -1,0 +1,142 @@
+"""Seeded churn-schedule generation for the fleet harness.
+
+A ``ChurnProfile`` describes a fleet statistically (how many jobs, how fast
+they arrive, how wide they are, what fraction get disrupted and how); a
+``ChurnGenerator`` expands it into a concrete, fully deterministic schedule
+of ``JobPlan``s.  All randomness flows through one ``random.Random(seed)``
+so the same (profile, seed) pair always produces byte-identical plans --
+the property the determinism test and `make fleet-smoke` rely on.
+
+Arrivals are a Poisson process normalized onto ``[0, duration]``: draw
+exponential inter-arrival gaps, then rescale the cumulative times so the
+last job lands at ``duration``.  Normalizing (instead of tuning a rate)
+keeps the wall-clock envelope of a run independent of the job count.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+#: The disruption fates a planned job can be assigned.
+FATE_COMPLETE = "complete"    # runs to Succeed on its own
+FATE_STEADY = "steady"        # runs "forever"; must settle at Running
+FATE_PREEMPT = "preempt"      # operator-level preemption via annotation
+FATE_POD_FAIL = "pod_fail"    # one pod killed with 137; EXIT_CODE restart
+FATE_DELETE = "delete"        # client deletes the CR mid-flight
+
+FATES = (FATE_COMPLETE, FATE_STEADY, FATE_PREEMPT, FATE_POD_FAIL, FATE_DELETE)
+
+
+@dataclass(frozen=True)
+class ChurnProfile:
+    """Statistical description of a fleet run.  Frozen so a profile can be
+    shared between a run and its replay without aliasing surprises."""
+
+    jobs: int = 200
+    #: Seconds over which creates arrive (Poisson, normalized).
+    duration: float = 4.0
+    seed: int = 0
+    #: Replica width drawn uniformly from this inclusive range.
+    replicas: Tuple[int, int] = (2, 12)
+    #: run-seconds annotation range for completing jobs.
+    run_seconds: Tuple[float, float] = (0.05, 0.4)
+    #: run-seconds for jobs that must still be Running at the end.
+    steady_run_seconds: float = 3600.0
+    #: Seconds after a job's create at which its disruption (preempt /
+    #: pod_fail / delete) fires, drawn uniformly.
+    disruption_delay: Tuple[float, float] = (0.3, 1.2)
+    #: Relative fate weights; zero removes a fate from the draw.
+    fate_weights: Dict[str, float] = field(default_factory=lambda: {
+        FATE_COMPLETE: 0.45,
+        FATE_STEADY: 0.15,
+        FATE_PREEMPT: 0.12,
+        FATE_POD_FAIL: 0.18,
+        FATE_DELETE: 0.10,
+    })
+    namespace: str = "default"
+
+    def total_replicas(self) -> int:
+        """Upper bound used for capacity provisioning (exact total comes
+        from the generated plan)."""
+        return self.jobs * self.replicas[1]
+
+
+@dataclass(frozen=True)
+class JobPlan:
+    """One job's concrete fate.  Everything the harness needs to create,
+    disrupt, and later judge the job is pinned here at plan time."""
+
+    name: str
+    namespace: str
+    create_at: float          # seconds from run start
+    replicas: int
+    fate: str
+    run_seconds: float
+    #: When the disruption fires (absolute, seconds from run start);
+    #: 0.0 for fates without one.
+    disrupt_at: float = 0.0
+    #: Replica index the pod_fail fate kills.
+    fail_index: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class ChurnGenerator:
+    """Expands a :class:`ChurnProfile` into a deterministic ``JobPlan`` list."""
+
+    def __init__(self, profile: ChurnProfile):
+        self.profile = profile
+
+    def plan(self) -> List[JobPlan]:
+        p = self.profile
+        rng = random.Random(p.seed)
+        arrivals = self._arrival_times(rng, p.jobs, p.duration)
+        fates = [f for f in FATES if p.fate_weights.get(f, 0.0) > 0.0]
+        weights = [p.fate_weights[f] for f in fates]
+
+        plans: List[JobPlan] = []
+        for i, at in enumerate(arrivals):
+            fate = rng.choices(fates, weights=weights, k=1)[0]
+            replicas = rng.randint(*p.replicas)
+            if fate == FATE_COMPLETE:
+                run_seconds = rng.uniform(*p.run_seconds)
+            else:
+                # Disrupted and steady jobs must outlive the run on their
+                # own -- the schedule, not the workload, ends them.
+                run_seconds = p.steady_run_seconds
+            disrupt_at = 0.0
+            fail_index = 0
+            if fate in (FATE_PREEMPT, FATE_POD_FAIL, FATE_DELETE):
+                disrupt_at = at + rng.uniform(*p.disruption_delay)
+                if fate == FATE_POD_FAIL:
+                    fail_index = rng.randrange(replicas)
+            plans.append(JobPlan(
+                name=f"fleet-{p.seed}-{i:05d}",
+                namespace=p.namespace,
+                create_at=at,
+                replicas=replicas,
+                fate=fate,
+                run_seconds=run_seconds,
+                disrupt_at=disrupt_at,
+                fail_index=fail_index,
+            ))
+        return plans
+
+    @staticmethod
+    def _arrival_times(rng: random.Random, n: int, duration: float) -> List[float]:
+        if n <= 0:
+            return []
+        gaps = [rng.expovariate(1.0) for _ in range(n)]
+        total = 0.0
+        times = []
+        for g in gaps:
+            total += g
+            times.append(total)
+        if total <= 0.0:
+            return [0.0] * n
+        scale = duration / total
+        return [t * scale for t in times]

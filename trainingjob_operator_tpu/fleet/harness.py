@@ -1,0 +1,630 @@
+"""Fleet harness: thousands of churning jobs against the sim cluster.
+
+Drives a seeded :mod:`~trainingjob_operator_tpu.fleet.churn` schedule --
+Poisson creates, operator-level preemptions (annotation), pod kills (exit
+137 + EXIT_CODE restart), mid-flight CR deletes -- through a real
+``TrainingJobController`` + ``SimRuntime`` pair sharing one object tracker,
+then judges convergence:
+
+- every job settles at the phase its fate predicts (Succeed / Running /
+  Preempted / restarted-Running), or is gone if it was deleted;
+- expectations never wedge (an unsettled job with unsatisfied expectations
+  is reported as such, not just "wrong phase");
+- after a GC sweep no pod outlives its owning job.
+
+Along the way it measures event-to-pod-visible latency per transition kind
+(job create -> first pod ADDED, preempt-annotate -> phase visibly moves,
+pod kill -> replacement pod ADDED) straight off the tracker's watch stream,
+so the number reflects what a client would see, not controller internals.
+
+The controller can be handed a latency-injecting clientset view
+(``api_latency``): every *write* verb sleeps like a round trip to a real
+API server while reads stay cache-fast (informers/listers are local caches
+in real deployments too).  That is what makes worker-parallelism measurable
+under the GIL -- workers overlap API waits, not Python bytecode -- and is
+the basis of the ``control_plane`` bench leg (bench.py).
+
+CLI (``make fleet-smoke``)::
+
+    python -m trainingjob_operator_tpu.fleet.harness --jobs 200 --seed 0
+
+Seed/job-count defaults honor TRAININGJOB_FLEET_SEED / TRAININGJOB_FLEET_JOBS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import math
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.tracker import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    NotFoundError,
+    WatchEvent,
+)
+from trainingjob_operator_tpu.cmd.options import OperatorOptions
+from trainingjob_operator_tpu.controller.controller import (
+    LATENCY_MS_BUCKETS,
+    TrainingJobController,
+)
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodTemplateSpec,
+)
+from trainingjob_operator_tpu.fleet.churn import (
+    FATE_COMPLETE,
+    FATE_DELETE,
+    FATE_POD_FAIL,
+    FATE_PREEMPT,
+    FATE_STEADY,
+    ChurnGenerator,
+    ChurnProfile,
+    JobPlan,
+)
+from trainingjob_operator_tpu.runtime.sim import (
+    EXIT_CODE_ANNOTATION,
+    RUN_SECONDS_ANNOTATION,
+    SimRuntime,
+)
+from trainingjob_operator_tpu.utils.metrics import METRICS
+
+RTYPE = "trainer"
+
+#: Phases a fate is allowed to settle at.
+_SETTLED_PHASES = {
+    FATE_COMPLETE: (TrainingJobPhase.SUCCEEDED,),
+    FATE_STEADY: (TrainingJobPhase.RUNNING,),
+    FATE_PREEMPT: (TrainingJobPhase.PREEMPTED,),
+    FATE_POD_FAIL: (TrainingJobPhase.RUNNING,),
+}
+
+
+class _LatencyClient:
+    """Typed-client proxy charging a fixed sleep per *mutating* verb.
+
+    Reads (`get`/`list`) pass through untouched: against a real cluster the
+    controller reads from informer caches, so only writes pay a round trip.
+    """
+
+    def __init__(self, inner: Any, latency: float):
+        self._inner = inner
+        self._latency = latency
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def _pay(self) -> None:
+        time.sleep(self._latency)
+
+    def create(self, obj):
+        self._pay()
+        return self._inner.create(obj)
+
+    def update(self, obj):
+        self._pay()
+        return self._inner.update(obj)
+
+    def update_status(self, obj):
+        self._pay()
+        return self._inner.update_status(obj)
+
+    def delete(self, namespace, name, grace_period=None):
+        self._pay()
+        return self._inner.delete(namespace, name, grace_period)
+
+
+def latency_clientset(cs: Clientset, api_latency: float) -> Clientset:
+    """A second view over ``cs.tracker`` whose write verbs sleep
+    ``api_latency`` seconds.  Hand this to the controller; keep the raw
+    clientset for the sim (kubelet writes are node-local in real life)."""
+    ctl = Clientset(tracker=cs.tracker)
+    if api_latency > 0.0:
+        ctl.trainingjobs = _LatencyClient(ctl.trainingjobs, api_latency)
+        ctl.pods = _LatencyClient(ctl.pods, api_latency)
+        ctl.services = _LatencyClient(ctl.services, api_latency)
+        ctl.events = _LatencyClient(ctl.events, api_latency)
+    return ctl
+
+
+class _LatencyRecorder:
+    """Event -> pod-visible latency, measured off the tracker watch stream.
+
+    ``mark_*`` is called by the driver immediately *before* it issues the
+    triggering API call (so the sample can never go negative against the
+    asynchronous controller); the watch handlers complete the pair when the
+    effect becomes visible to any watching client.
+    """
+
+    def __init__(self, cs: Clientset):
+        self._lock = threading.Lock()
+        self._pending_create: Dict[str, float] = {}   # job key -> t0
+        self._pending_preempt: Dict[str, float] = {}  # job key -> t0
+        self._pending_fail: Dict[str, float] = {}     # pod key -> t0
+        self.samples: Dict[str, List[float]] = {
+            "create": [], "preempt": [], "pod_fail": []}
+        self._unsubs = [
+            cs.tracker.watch(constants.KIND, self._on_job_event),
+            cs.tracker.watch(Pod.KIND, self._on_pod_event),
+        ]
+
+    def close(self) -> None:
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs = []
+
+    # -- driver side ---------------------------------------------------------
+
+    def mark_create(self, job_key: str) -> None:
+        with self._lock:
+            self._pending_create[job_key] = time.monotonic()
+
+    def mark_preempt(self, job_key: str) -> None:
+        with self._lock:
+            self._pending_preempt[job_key] = time.monotonic()
+
+    def mark_pod_fail(self, pod_key: str) -> None:
+        with self._lock:
+            self._pending_fail[pod_key] = time.monotonic()
+
+    # -- watch side ----------------------------------------------------------
+
+    def _sample(self, kind: str, t0: float) -> None:
+        ms = (time.monotonic() - t0) * 1000.0
+        self.samples[kind].append(ms)
+        METRICS.observe("trainingjob_event_to_visible_ms", ms,
+                        buckets=LATENCY_MS_BUCKETS, kind=kind)
+
+    def _on_job_event(self, event: WatchEvent) -> None:
+        job = event.obj
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        with self._lock:
+            if event.type == DELETED:
+                self._pending_preempt.pop(key, None)
+                self._pending_create.pop(key, None)
+                return
+            if event.type == MODIFIED and key in self._pending_preempt:
+                # Visible as soon as the phase moves off the pre-preempt
+                # steady state -- Terminating first, then Preempted.
+                if job.status.phase in (TrainingJobPhase.TERMINATING,
+                                        TrainingJobPhase.PREEMPTED):
+                    self._sample("preempt", self._pending_preempt.pop(key))
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        if event.type != ADDED:
+            return
+        pod = event.obj
+        pod_key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL)
+        job_key = f"{pod.metadata.namespace}/{job_name}" if job_name else None
+        with self._lock:
+            if pod_key in self._pending_fail:
+                # The replacement pod reuses the (job, rtype, index) name.
+                self._sample("pod_fail", self._pending_fail.pop(pod_key))
+            elif job_key is not None and job_key in self._pending_create:
+                self._sample("create", self._pending_create.pop(job_key))
+
+    # -- reporting -----------------------------------------------------------
+
+    def percentiles(self) -> Dict[str, Any]:
+        allv = sorted(v for vs in self.samples.values() for v in vs)
+
+        def pct(q: float) -> float:
+            if not allv:
+                return 0.0
+            idx = min(len(allv) - 1, max(0, math.ceil(q * len(allv)) - 1))
+            return allv[idx]
+
+        return {
+            "count": len(allv),
+            "p50": round(pct(0.50), 3),
+            "p99": round(pct(0.99), 3),
+            "max": round(allv[-1], 3) if allv else 0.0,
+            "by_kind": {k: len(v) for k, v in self.samples.items()},
+        }
+
+
+@dataclass
+class FleetReport:
+    """Everything a run proved (or failed to): the harness's verdict plus
+    the control-plane numbers bench.py republishes."""
+
+    jobs: int
+    replicas_total: int
+    workers: int
+    seed: int
+    converged: bool
+    violations: List[str]
+    wall_seconds: float
+    sync_count: int
+    reconciles_per_s: float
+    event_to_visible_ms: Dict[str, Any]
+    workqueue_depth_high_water: int
+    workqueue_retries_total: int
+    workqueue_coalesced_total: int
+    phase_counts: Dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "jobs": self.jobs,
+            "replicas_total": self.replicas_total,
+            "workers": self.workers,
+            "seed": self.seed,
+            "converged": self.converged,
+            "violations": self.violations,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "sync_count": self.sync_count,
+            "reconciles_per_s": round(self.reconciles_per_s, 2),
+            "event_to_visible_ms": self.event_to_visible_ms,
+            "workqueue_depth_high_water": self.workqueue_depth_high_water,
+            "workqueue_retries_total": self.workqueue_retries_total,
+            "workqueue_coalesced_total": self.workqueue_coalesced_total,
+            "phase_counts": self.phase_counts,
+        }
+
+
+def build_job(plan: JobPlan, with_ports: bool = False) -> TPUTrainingJob:
+    """A sim-runnable job from a plan.  No container ports by default: the
+    service reconciler then creates nothing, which keeps a 100k-replica run
+    about pods (ports=True doubles the object count for DNS realism)."""
+    ports = ([ContainerPort(name="aitj-7777", container_port=7777)]
+             if with_ports else [])
+    template = PodTemplateSpec(
+        metadata=ObjectMeta(annotations={
+            RUN_SECONDS_ANNOTATION: f"{plan.run_seconds:.3f}",
+            EXIT_CODE_ANNOTATION: "0",
+        }),
+        spec=PodSpec(containers=[Container(name="aitj-main", ports=ports)]))
+    job = TPUTrainingJob(metadata=ObjectMeta(
+        name=plan.name, namespace=plan.namespace))
+    replica_kw: Dict[str, Any] = {}
+    if plan.fate == FATE_POD_FAIL:
+        replica_kw = dict(restart_policy=RestartPolicy.EXIT_CODE,
+                          restart_scope=RestartScope.ALL)
+    job.spec.replica_specs[RTYPE] = ReplicaSpec(
+        replicas=plan.replicas, template=template, **replica_kw)
+    if plan.fate == FATE_POD_FAIL:
+        job.spec.restarting_exit_code = "137,143"
+    return job
+
+
+class FleetHarness:
+    """One fleet run: build cluster, drive the schedule, judge convergence."""
+
+    def __init__(self, profile: ChurnProfile, workers: int = 4,
+                 pace: bool = True, api_latency: float = 0.0,
+                 resync_period: float = 2.0, resync_shards: int = 8,
+                 gc_interval: float = 5.0, pods_per_node: int = 64,
+                 converge_timeout: float = 60.0, with_ports: bool = False,
+                 sim_tick: float = 0.02,
+                 progress: Optional[Callable[[str], None]] = None):
+        self.profile = profile
+        self.workers = workers
+        self.pace = pace
+        self.api_latency = api_latency
+        self.resync_period = resync_period
+        self.resync_shards = resync_shards
+        self.gc_interval = gc_interval
+        self.pods_per_node = pods_per_node
+        self.converge_timeout = converge_timeout
+        self.with_ports = with_ports
+        # Sim kubelet tick: the per-tick lifecycle walk is O(live pods), so a
+        # fleet-sized run wants a coarser tick than the 5 ms test default.
+        self.sim_tick = sim_tick
+        self._progress = progress or (lambda _msg: None)
+        self.violations: List[str] = []
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> FleetReport:
+        plans = ChurnGenerator(self.profile).plan()
+        total_replicas = sum(p.replicas for p in plans)
+
+        cs = Clientset()
+        cs_ctl = latency_clientset(cs, self.api_latency)
+        tc = TrainingJobController(cs_ctl, options=OperatorOptions(
+            resync_period=self.resync_period,
+            resync_shards=self.resync_shards,
+            gc_interval=self.gc_interval,
+            thread_num=self.workers,
+        ))
+        sim = SimRuntime(cs, tick=self.sim_tick,
+                         pods_per_node=self.pods_per_node)
+        for i in range(max(1, math.ceil(total_replicas / self.pods_per_node))):
+            sim.add_node(f"fleet-n{i:04d}")
+        recorder = _LatencyRecorder(cs)
+
+        sync_count_before = self._sync_count()
+        sim.start()
+        tc.run(workers=self.workers)
+        started = time.monotonic()
+        try:
+            self._drive(cs, sim, recorder, plans, started)
+            converged = self._await_convergence(cs, tc, plans)
+            self._gc_sweep(cs, tc)
+            wall = time.monotonic() - started
+        finally:
+            tc.stop()
+            sim.stop()
+            recorder.close()
+
+        sync_count = self._sync_count() - sync_count_before
+        phase_counts = self._phase_counts(cs)
+        return FleetReport(
+            jobs=len(plans),
+            replicas_total=total_replicas,
+            workers=self.workers,
+            seed=self.profile.seed,
+            converged=converged and not self.violations,
+            violations=list(self.violations),
+            wall_seconds=wall,
+            sync_count=sync_count,
+            reconciles_per_s=(sync_count / wall) if wall > 0 else 0.0,
+            event_to_visible_ms=recorder.percentiles(),
+            workqueue_depth_high_water=tc.work_queue.depth_high_water,
+            workqueue_retries_total=tc.work_queue.retries_total,
+            workqueue_coalesced_total=tc.work_queue.coalesced_total,
+            phase_counts=phase_counts,
+        )
+
+    @staticmethod
+    def _sync_count() -> int:
+        return int(METRICS.snapshot().get(
+            "trainingjob_reconcile_latency_ms_count", 0))
+
+    # -- schedule driver -----------------------------------------------------
+
+    def _drive(self, cs: Clientset, sim: SimRuntime,
+               recorder: _LatencyRecorder, plans: List[JobPlan],
+               started: float) -> None:
+        events: List[Tuple[float, int, str, JobPlan]] = []
+        seq = 0
+        for plan in plans:
+            heapq.heappush(events, (plan.create_at, seq, "create", plan))
+            seq += 1
+            if plan.disrupt_at > 0.0:
+                heapq.heappush(events, (plan.disrupt_at, seq, plan.fate, plan))
+                seq += 1
+
+        fail_attempts: Dict[str, int] = {}
+        fired = 0
+        while events:
+            at, _, kind, plan = heapq.heappop(events)
+            if self.pace:
+                delay = at - (time.monotonic() - started)
+                if delay > 0:
+                    time.sleep(delay)
+            if kind == "create":
+                recorder.mark_create(plan.key)
+                cs.trainingjobs.create(build_job(plan, self.with_ports))
+            elif kind == FATE_PREEMPT:
+                self._fire_preempt(cs, recorder, plan)
+            elif kind == FATE_DELETE:
+                try:
+                    cs.trainingjobs.delete(plan.namespace, plan.name)
+                except NotFoundError:
+                    self.violations.append(
+                        f"{plan.key}: vanished before scheduled delete")
+            elif kind == FATE_POD_FAIL:
+                if not self._fire_pod_fail(cs, sim, recorder, plan):
+                    # Target pod not Running yet (deep backlog at fleet
+                    # scale): push the kill back a beat, for a long while.
+                    attempts = fail_attempts.get(plan.key, 0) + 1
+                    fail_attempts[plan.key] = attempts
+                    if attempts * 0.25 >= self.converge_timeout:
+                        self.violations.append(
+                            f"{plan.key}: pod_fail target never became "
+                            f"Running; kill not delivered")
+                    else:
+                        if not self.pace:
+                            time.sleep(0.02)
+                        retry_at = max(at, time.monotonic() - started) + 0.25
+                        heapq.heappush(
+                            events, (retry_at, seq, FATE_POD_FAIL, plan))
+                        seq += 1
+                    continue
+            fired += 1
+            if fired % 500 == 0:
+                self._progress(f"fired {fired} churn events")
+
+    def _fire_preempt(self, cs: Clientset, recorder: _LatencyRecorder,
+                      plan: JobPlan) -> None:
+        """Operator-level preemption: the PREEMPTED annotation asks the
+        controller to drain the job into the Preempted phase."""
+        for _ in range(100):
+            try:
+                job = cs.trainingjobs.get(plan.namespace, plan.name)
+            except NotFoundError:
+                self.violations.append(
+                    f"{plan.key}: vanished before scheduled preemption")
+                return
+            job.metadata.annotations[TrainingJobPhase.PREEMPTED] = (
+                "fleet churn: simulated capacity reclaim")
+            recorder.mark_preempt(plan.key)
+            try:
+                cs.trainingjobs.update(job)
+                return
+            except ConflictError:
+                continue  # controller won the write; re-read and retry
+        self.violations.append(f"{plan.key}: preempt annotation never landed")
+
+    def _fire_pod_fail(self, cs: Clientset, sim: SimRuntime,
+                       recorder: _LatencyRecorder, plan: JobPlan) -> bool:
+        """Kill one replica with exit 137 once it is actually Running (a
+        kill before the kubelet starts the container is a no-op)."""
+        pod_name = f"{plan.name}-{RTYPE}-{plan.fail_index}"
+        try:
+            pod = cs.pods.get(plan.namespace, pod_name)
+        except NotFoundError:
+            return False
+        if pod.status.phase != PodPhase.RUNNING:
+            return False
+        recorder.mark_pod_fail(f"{plan.namespace}/{pod_name}")
+        sim.preempt_pod(plan.namespace, pod_name, exit_code=137)
+        return True
+
+    # -- judgement -----------------------------------------------------------
+
+    def _plan_state(self, cs: Clientset, plan: JobPlan
+                    ) -> Tuple[bool, str]:
+        """(settled?, describe-actual) for one plan."""
+        try:
+            job = cs.trainingjobs.get(plan.namespace, plan.name)
+        except NotFoundError:
+            if plan.fate == FATE_DELETE:
+                return True, "deleted"
+            return False, "missing"
+        if plan.fate == FATE_DELETE:
+            return False, f"still present in phase {job.status.phase!r}"
+        phase = job.status.phase
+        want = _SETTLED_PHASES[plan.fate]
+        if phase not in want:
+            return False, f"phase {phase!r}, want one of {want}"
+        if plan.fate == FATE_POD_FAIL:
+            restarts = job.status.restart_counts.get(RTYPE, 0)
+            if restarts < 1:
+                return False, f"Running but restart_counts={restarts}, want >=1"
+        return True, phase
+
+    def _await_convergence(self, cs: Clientset, tc: TrainingJobController,
+                           plans: List[JobPlan]) -> bool:
+        """Poll until every plan settles; on timeout, file one violation per
+        unsettled plan (with the wedged-expectations detail when that is
+        the reason it cannot make progress)."""
+        deadline = time.monotonic() + self.converge_timeout
+        unsettled = list(plans)
+        while True:
+            unsettled = [p for p in unsettled
+                         if not self._plan_state(cs, p)[0]]
+            if not unsettled:
+                return True
+            if time.monotonic() >= deadline:
+                break
+            self._progress(f"{len(unsettled)} jobs not settled yet")
+            time.sleep(min(0.25, max(0.02, len(unsettled) / 2000.0)))
+        for plan in unsettled[:50]:
+            settled, actual = self._plan_state(cs, plan)
+            if settled:
+                continue
+            detail = f"{plan.key} ({plan.fate}): {actual}"
+            try:
+                job = cs.trainingjobs.get(plan.namespace, plan.name)
+                if not tc.satisfied_expectations(job):
+                    detail += " [expectations wedged]"
+            except NotFoundError:
+                pass
+            self.violations.append(detail)
+        if len(unsettled) > 50:
+            self.violations.append(
+                f"... and {len(unsettled) - 50} more unsettled jobs")
+        return False
+
+    def _gc_sweep(self, cs: Clientset, tc: TrainingJobController) -> None:
+        """Force a GC pass, let the sim finalize the deletions, then assert
+        no pod outlives its owning job."""
+        if tc._gc is not None:
+            tc._gc.clean_garbage_pods()
+        deadline = time.monotonic() + 15.0
+        orphans: List[str] = []
+        while time.monotonic() < deadline:
+            orphans = self._orphan_pods(cs)
+            if not orphans:
+                return
+            time.sleep(0.1)
+        for key in orphans[:20]:
+            self.violations.append(f"orphan pod after GC: {key}")
+        if len(orphans) > 20:
+            self.violations.append(f"... and {len(orphans) - 20} more orphans")
+
+    @staticmethod
+    def _orphan_pods(cs: Clientset) -> List[str]:
+        live_jobs = {f"{j.metadata.namespace}/{j.metadata.name}"
+                     for j in cs.trainingjobs.list(None)}
+        orphans = []
+        for pod in cs.pods.list(None):
+            owner = pod.metadata.labels.get(constants.JOB_NAME_LABEL)
+            if owner and f"{pod.metadata.namespace}/{owner}" not in live_jobs:
+                orphans.append(f"{pod.metadata.namespace}/{pod.metadata.name}")
+        return orphans
+
+    @staticmethod
+    def _phase_counts(cs: Clientset) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for job in cs.trainingjobs.list(None):
+            phase = job.status.phase or "<none>"
+            counts[phase] = counts.get(phase, 0) + 1
+        return counts
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m trainingjob_operator_tpu.fleet.harness",
+        description="Seeded churn run against the sim cluster; exits 0 only "
+                    "if the fleet converged with zero invariant violations.")
+    ap.add_argument("--jobs", type=int,
+                    default=int(os.environ.get(constants.FLEET_JOBS_ENV, "200")))
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get(constants.FLEET_SEED_ENV, "0")))
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="Arrival window, seconds.")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--replicas-min", type=int, default=2)
+    ap.add_argument("--replicas-max", type=int, default=12)
+    ap.add_argument("--api-latency", type=float, default=0.0,
+                    help="Injected per-write API latency for the controller, "
+                         "seconds.")
+    ap.add_argument("--no-pace", action="store_true",
+                    help="Fire the schedule as fast as possible (backlog "
+                         "saturation mode) instead of at its timestamps.")
+    ap.add_argument("--converge-timeout", type=float, default=60.0)
+    ap.add_argument("--resync-period", type=float, default=10.0)
+    ap.add_argument("--gc-interval", type=float, default=10.0)
+    ap.add_argument("--pods-per-node", type=int, default=64)
+    ap.add_argument("--with-ports", action="store_true",
+                    help="Give containers a port so per-index headless "
+                         "Services are reconciled too.")
+    ap.add_argument("--quiet", action="store_true",
+                    help="Suppress progress lines; print only the report.")
+    args = ap.parse_args(argv)
+
+    profile = ChurnProfile(
+        jobs=args.jobs, duration=args.duration, seed=args.seed,
+        replicas=(args.replicas_min, args.replicas_max))
+    progress = None if args.quiet else (
+        lambda msg: print(f"[fleet] {msg}", file=sys.stderr, flush=True))
+    harness = FleetHarness(
+        profile, workers=args.workers, pace=not args.no_pace,
+        api_latency=args.api_latency, converge_timeout=args.converge_timeout,
+        resync_period=args.resync_period, gc_interval=args.gc_interval,
+        pods_per_node=args.pods_per_node, with_ports=args.with_ports,
+        progress=progress)
+    report = harness.run()
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.converged else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ class PodStateRuntime:
         self._cs = clientset
         self._tick = tick
         self._state: Dict[str, Any] = {}
+        self._missing: set = set()  # keys absent from exactly one walk
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -88,8 +89,22 @@ class PodStateRuntime:
         reset entries whose pod was replaced by a new incarnation."""
         existing = {f"{p.namespace}/{p.name}" for p in pods}
         with self._lock:
+            # Reap only keys missing from TWO consecutive walks.  The
+            # caller's pod snapshot predates this walk, and the graceful-
+            # delete finalizer can create a state entry (terminating_since
+            # stamped) for a pod created-then-deleted inside that window;
+            # reaping it on the first miss loses the stamp, and the fresh
+            # entry the next walk creates never finalizes -- the pod then
+            # sits until the GC's deletion-timestamp expiry sweep.
             stale = [k for k in self._state if k not in existing]
-            discarded = [self._state.pop(k) for k in stale]
+            discarded = []
+            missed_once = set()
+            for k in stale:
+                if k in self._missing:
+                    discarded.append(self._state.pop(k))
+                else:
+                    missed_once.add(k)
+            self._missing = missed_once
         for state in discarded:
             self._on_state_discarded(state)
 

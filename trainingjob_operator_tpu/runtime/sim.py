@@ -17,6 +17,7 @@ that substrate for the in-memory backend: a background loop that
 
 from __future__ import annotations
 
+import copy
 import logging
 import time
 from dataclasses import dataclass
@@ -24,12 +25,13 @@ from typing import Dict, Optional
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.client.clientset import Clientset
-from trainingjob_operator_tpu.client.tracker import NotFoundError
+from trainingjob_operator_tpu.client.tracker import DELETED, WatchEvent
 from trainingjob_operator_tpu.core.objects import (
     Condition,
     ConditionStatus,
     ContainerState,
     ContainerStatus,
+    Node,
     Pod,
     PodConditionType,
     PodPhase,
@@ -94,6 +96,47 @@ class SimRuntime(PodStateRuntime):
         self._start_delay = start_delay
         self._termination_grace = termination_grace
         self._pods_per_node = pods_per_node
+        # Watch-fed pod/node caches: at fleet scale a per-tick
+        # ``pods.list()`` deepcopies the whole store (100k pods x 200 Hz is
+        # the difference between a working sim and one that never catches
+        # up).  The tracker hands each watch handler its own deepcopy, so
+        # cached objects are privately owned; anything the tick loop is
+        # about to MUTATE is copied first (a conflicted write must not
+        # poison the cache for the retry).
+        self._pods_cache: Dict[str, Pod] = {}
+        self._nodes_cache: Dict[str, Node] = {}
+        self._unsubs = [
+            clientset.tracker.watch(Pod.KIND, self._on_pod_event),
+            clientset.tracker.watch(Node.KIND, self._on_node_event),
+        ]
+        with self._lock:
+            for pod in clientset.tracker.list(Pod.KIND):
+                self._pods_cache[f"{pod.namespace}/{pod.name}"] = pod
+            for node in clientset.tracker.list(Node.KIND):
+                self._nodes_cache[node.name] = node
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        pod = event.obj
+        key = f"{pod.namespace}/{pod.name}"
+        with self._lock:
+            if event.type == DELETED:
+                self._pods_cache.pop(key, None)
+            else:
+                self._pods_cache[key] = pod
+
+    def _on_node_event(self, event: WatchEvent) -> None:
+        node = event.obj
+        with self._lock:
+            if event.type == DELETED:
+                self._nodes_cache.pop(node.name, None)
+            else:
+                self._nodes_cache[node.name] = node
+
+    def stop(self) -> None:
+        super().stop()
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs = []
 
     def _new_state(self, uid: str) -> _PodRuntime:
         return _PodRuntime(uid=uid)
@@ -116,12 +159,8 @@ class SimRuntime(PodStateRuntime):
         if kill_pods:
             with self._lock:
                 for key, rt in self._state.items():
-                    ns, pod_name = key.split("/", 1)
-                    try:
-                        pod = self._cs.pods.get(ns, pod_name)
-                    except NotFoundError:
-                        continue
-                    if pod.spec.node_name == name:
+                    pod = self._pods_cache.get(key)
+                    if pod is not None and pod.spec.node_name == name:
                         rt.will_exit_at = None  # frozen: no further reports
                         rt.frozen_on = name
 
@@ -149,48 +188,54 @@ class SimRuntime(PodStateRuntime):
 
     def _reconcile_once(self) -> None:
         now = time.time()
-        nodes = {n.name: n for n in self._cs.nodes.list()}
-        pods = self._cs.pods.list()
-
-        # node -> usage
-        pod_count: Dict[str, int] = {}
-        tpu_used: Dict[str, int] = {}
-        for pod in pods:
-            if pod.spec.node_name:
-                pod_count[pod.spec.node_name] = pod_count.get(pod.spec.node_name, 0) + 1
-                tpu_used[pod.spec.node_name] = (tpu_used.get(pod.spec.node_name, 0)
-                                                + self._pod_tpu_request(pod))
+        with self._lock:
+            # Watch-fed snapshots: dict/list copies of privately-owned cached
+            # objects, no per-tick store deepcopy.
+            nodes = dict(self._nodes_cache)
+            pods = list(self._pods_cache.values())
 
         # Gang-aware scheduling: group pending pods by (namespace, gang); a
-        # gang is placed only if every member fits simultaneously.
+        # gang is placed only if every member fits simultaneously.  The
+        # usage/gang maps cost one pass over all pods, so they are built only
+        # while something is actually pending (during churn bursts), not on
+        # every steady-state tick.
         pending = [p for p in pods
                    if p.status.phase == PodPhase.PENDING and not p.spec.node_name
                    and p.metadata.deletion_timestamp is None]
-        gangs: Dict[tuple, list] = {}
-        for pod in pending:
-            gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
-            gangs.setdefault((pod.namespace, gang), []).append(pod)
-        # Gang membership counts ALL live pods carrying the label, not just
-        # pending ones: a gap-filled single member of an otherwise-running
-        # gang must still be placeable (its siblings already hold nodes).
-        gang_totals: Dict[tuple, int] = {}
-        for pod in pods:
-            if pod.metadata.deletion_timestamp is not None:
-                continue
-            label = pod.metadata.labels.get(constants.GANG_LABEL)
-            if label:
-                key = (pod.namespace, label)
-                gang_totals[key] = gang_totals.get(key, 0) + 1
-        for key, gang_pods in gangs.items():
-            # Never place a partially OBSERVED gang: the controller creates
-            # a slice's pods over several API calls, and placing the
-            # visible subset would steal capacity the full gang needs.
-            declared = gang_pods[0].metadata.labels.get(
-                constants.GANG_SIZE_LABEL)
-            if (declared and declared.isdigit()
-                    and gang_totals.get(key, len(gang_pods)) < int(declared)):
-                continue
-            self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
+        if pending:
+            # node -> usage
+            pod_count: Dict[str, int] = {}
+            tpu_used: Dict[str, int] = {}
+            for pod in pods:
+                if pod.spec.node_name:
+                    pod_count[pod.spec.node_name] = pod_count.get(pod.spec.node_name, 0) + 1
+                    tpu_used[pod.spec.node_name] = (tpu_used.get(pod.spec.node_name, 0)
+                                                    + self._pod_tpu_request(pod))
+            gangs: Dict[tuple, list] = {}
+            for pod in pending:
+                gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
+                gangs.setdefault((pod.namespace, gang), []).append(pod)
+            # Gang membership counts ALL live pods carrying the label, not just
+            # pending ones: a gap-filled single member of an otherwise-running
+            # gang must still be placeable (its siblings already hold nodes).
+            gang_totals: Dict[tuple, int] = {}
+            for pod in pods:
+                if pod.metadata.deletion_timestamp is not None:
+                    continue
+                label = pod.metadata.labels.get(constants.GANG_LABEL)
+                if label:
+                    key = (pod.namespace, label)
+                    gang_totals[key] = gang_totals.get(key, 0) + 1
+            for key, gang_pods in gangs.items():
+                # Never place a partially OBSERVED gang: the controller creates
+                # a slice's pods over several API calls, and placing the
+                # visible subset would steal capacity the full gang needs.
+                declared = gang_pods[0].metadata.labels.get(
+                    constants.GANG_SIZE_LABEL)
+                if (declared and declared.isdigit()
+                        and gang_totals.get(key, len(gang_pods)) < int(declared)):
+                    continue
+                self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
 
         # Walk running/scheduled pods through their lifecycle.
         for pod, rt in self._pod_states(pods):
@@ -200,6 +245,9 @@ class SimRuntime(PodStateRuntime):
                     self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
                     self._drop_state(pod.namespace, pod.name)
                 continue
+
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue  # settled: nothing left for the kubelet to report
 
             node = nodes.get(pod.spec.node_name) if pod.spec.node_name else None
             if node is None or not node.is_ready():
@@ -214,6 +262,7 @@ class SimRuntime(PodStateRuntime):
                     with TRACER.span("sim.start",
                                      pod=f"{pod.namespace}/{pod.name}",
                                      node=pod.spec.node_name):
+                        pod = copy.deepcopy(pod)  # never mutate the cache
                         pod.status.phase = PodPhase.RUNNING
                         pod.status.start_time = now
                         pod.status.container_statuses = [
@@ -239,6 +288,7 @@ class SimRuntime(PodStateRuntime):
                                  exit_code=code) as sp:
                     if code != 0:
                         sp.set_status("error")
+                    pod = copy.deepcopy(pod)  # never mutate the cache
                     pod.status.phase = (PodPhase.SUCCEEDED if code == 0
                                         else PodPhase.FAILED)
                     pod.status.container_statuses = [
@@ -335,6 +385,7 @@ class SimRuntime(PodStateRuntime):
         # that stays pending retries every tick and must not flood the ring).
         with TRACER.span("sim.schedule", pods=len(placements)):
             for pod, node_name, _ in placements:
+                pod = copy.deepcopy(pod)  # never mutate the cache
                 pod.spec.node_name = node_name
                 pod.status.conditions = [Condition(
                     type=PodConditionType.SCHEDULED, status=ConditionStatus.TRUE,
@@ -347,6 +398,7 @@ class SimRuntime(PodStateRuntime):
             if cond.type == PodConditionType.SCHEDULED:
                 if cond.status == ConditionStatus.FALSE and cond.message == msg:
                     return
+        pod = copy.deepcopy(pod)  # never mutate the cache
         pod.status.conditions = [Condition(
             type=PodConditionType.SCHEDULED, status=ConditionStatus.FALSE,
             reason="Unschedulable", message=msg,
