@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo fleet-smoke
+.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo fleet-smoke recovery-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -50,7 +50,14 @@ fleet-smoke:
 		--seed $${TRAININGJOB_FLEET_SEED:-0} \
 		--duration 3 --replicas-min 1 --replicas-max 4 --workers 4 --quiet
 
+# Cold run -> serial warm resume -> overlapped warm resume at tiny shapes
+# (docs/RECOVERY.md); exits non-zero unless both resume paths work and
+# report their phase breakdowns.  The measured 124M version is bench.py's
+# time_to_resume_training leg.
+recovery-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.recovery_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint test dryrun fleet-smoke
+ci: lint test dryrun fleet-smoke recovery-smoke

@@ -1,0 +1,519 @@
+"""Recovery fast-path tests: snapshot-donate checkpointing, overlapped
+restore+recompile, the shutdown watchdog vs in-flight writes, checkpoint-stall
+telemetry, the int8 decode batch gate, and the sim's settled-pod skip.
+
+The writer-protocol tests run CheckpointState against a FAKE orbax manager
+(records write order, can block or fail on demand) so ordering, coalescing
+and error surfacing are deterministic; the crash-mid-write test uses the real
+orbax layout to prove recovery falls back to the last COMMITTED step.
+"""
+
+import os
+import signal
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+from conftest import apply_jax_platform_override, wait_for
+
+apply_jax_platform_override()
+
+import jax.numpy as jnp
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.workloads import train
+
+
+class FakeManager:
+    """Stands in for ``orbax.CheckpointManager``: records the steps written,
+    in order; ``gate`` blocks every save until set (an in-flight write);
+    ``fail`` raises instead of writing (a dead filesystem)."""
+
+    def __init__(self, gate=None, fail=None):
+        self.saved = []
+        self.gate = gate
+        self.fail = fail
+
+    def save(self, step, args=None):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        if self.fail is not None:
+            raise self.fail
+        self.saved.append(step)
+
+    def wait_until_finished(self):
+        pass
+
+    def latest_step(self):
+        return None
+
+
+def _value(step):
+    return {"step": step, "x": np.arange(4, dtype=np.int32) + step}
+
+
+class TestSnapshotWriter:
+    def test_background_write_commits(self):
+        mngr = FakeManager()
+        st = train.CheckpointState("", {}, mngr)
+        assert st.snapshot_mode()  # single process, knob defaulted on
+        stall_ms = st.save(_value(1))
+        st.finalize()
+        assert mngr.saved == [1]
+        assert st.committed_step == 1
+        assert stall_ms >= 0.0
+
+    def test_latest_wins_coalescing_order_stays_monotonic(self):
+        gate = threading.Event()
+        mngr = FakeManager(gate=gate)
+        st = train.CheckpointState("", {}, mngr)
+        st.save(_value(1))
+        # Wait for the writer to PICK UP step 1 (busy, queue empty) so the
+        # next two saves land while a write is in flight.
+        assert wait_for(lambda: st._busy and st._pending is None)
+        st.save(_value(2))
+        st.save(_value(3))  # replaces the unstarted 2: latest wins
+        gate.set()
+        st.finalize()
+        assert mngr.saved == [1, 3]
+        assert st.committed_step == 3
+
+    def test_writer_failure_surfaces_then_recovers(self):
+        mngr = FakeManager(fail=OSError("disk gone"))
+        st = train.CheckpointState("", {}, mngr)
+        st.save(_value(1))
+        with pytest.raises(RuntimeError, match="last committed step"):
+            st.finalize()
+        # The stash is one-shot: after surfacing, the pipeline keeps working.
+        mngr.fail = None
+        st.save(_value(2))
+        st.finalize()
+        assert mngr.saved == [2]
+        assert st.committed_step == 2
+
+    def test_sync_knob_forces_direct_handoff(self, monkeypatch):
+        monkeypatch.setenv(constants.CKPT_SNAPSHOT_ENV, "0")
+        mngr = FakeManager()
+        st = train.CheckpointState("", {}, mngr)
+        assert not st.snapshot_mode()
+        st.save(_value(1))
+        # Written on the calling thread, before save() returned.
+        assert mngr.saved == [1]
+        assert st._writer is None
+
+    def test_wait_true_commits_before_returning(self):
+        mngr = FakeManager()
+        st = train.CheckpointState("", {}, mngr)
+        st.save(_value(5), wait=True)
+        assert mngr.saved == [5]
+        assert st.committed_step == 5
+
+    def test_snapshot_to_host_materializes_device_arrays(self):
+        val = {"a": jnp.arange(8), "b": 3, "c": np.ones(2)}
+        host = train._snapshot_to_host(val)
+        assert isinstance(host["a"], np.ndarray)
+        np.testing.assert_array_equal(host["a"], np.arange(8))
+        assert host["b"] == 3
+
+
+class TestCrashMidWriteFallback:
+    def test_uncommitted_write_falls_back_to_committed_step(self, tmp_path):
+        """A crash mid-write leaves orbax's atomic-commit tmp dir behind;
+        restore must resume from the last COMMITTED step, not the torn one."""
+        rdv = types.SimpleNamespace(checkpoint_dir=str(tmp_path),
+                                    replica_name="worker", replica_index=0)
+        init = {"step": 0, "x": jnp.arange(8)}
+        st = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                   subdir="t")
+        st.save({"step": 2, "x": jnp.arange(8) + 2}, wait=True)
+        st.finalize()
+        # Fabricate the torn step-4 write with orbax's own tmp naming (the
+        # commit rename to "4" never happened).
+        torn = tmp_path / "t" / "4.orbax-checkpoint-tmp-99"
+        torn.mkdir()
+        (torn / "partial").write_bytes(b"garbage")
+        st2 = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                    subdir="t")
+        assert int(st2.value["step"]) == 2
+        np.testing.assert_array_equal(np.asarray(st2.value["x"]),
+                                      np.arange(8) + 2)
+
+
+class TestResumeImage:
+    """The flat resume image: the writer mirrors each committed checkpoint
+    as one pickle, restore prefers it (single sequential read + device_put)
+    and falls back to the orbax restore on ANY image problem."""
+
+    def _setup(self, tmp_path):
+        rdv = types.SimpleNamespace(checkpoint_dir=str(tmp_path),
+                                    replica_name="worker", replica_index=0)
+        init = {"step": 0, "x": jnp.arange(8)}
+        return rdv, init, tmp_path / "t" / train._RESUME_IMAGE
+
+    def test_background_writer_mirrors_commit_into_image(self, tmp_path):
+        st = train.CheckpointState(str(tmp_path), {}, FakeManager())
+        st.save(_value(1))
+        st.finalize()
+        import pickle
+
+        with open(tmp_path / train._RESUME_IMAGE, "rb") as f:
+            step, host = pickle.load(f)
+        assert step == 1
+        np.testing.assert_array_equal(host["x"], np.arange(4) + 1)
+
+    def test_restore_prefers_image_over_orbax(self, tmp_path):
+        rdv, init, image = self._setup(tmp_path)
+        st = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                   subdir="t")
+        st.save({"step": 2, "x": jnp.arange(8) + 2}, wait=True)
+        st.finalize()
+        assert image.exists()
+        # Plant distinguishable values at the SAME step: a restore that
+        # reads the image sees them; one that read orbax would not.
+        train._write_resume_image(str(tmp_path / "t"), 2,
+                                  {"step": 2, "x": np.arange(8) + 100})
+        st2 = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                    subdir="t")
+        np.testing.assert_array_equal(np.asarray(st2.value["x"]),
+                                      np.arange(8) + 100)
+
+    def test_stale_image_falls_back_to_orbax(self, tmp_path):
+        rdv, init, _ = self._setup(tmp_path)
+        st = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                   subdir="t")
+        st.save({"step": 2, "x": jnp.arange(8) + 2}, wait=True)
+        st.finalize()
+        # Image claims step 1 while orbax's latest is 2 (a newer sync-mode
+        # save superseded it): must be ignored.
+        train._write_resume_image(str(tmp_path / "t"), 1,
+                                  {"step": 1, "x": np.arange(8) + 100})
+        st2 = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                    subdir="t")
+        np.testing.assert_array_equal(np.asarray(st2.value["x"]),
+                                      np.arange(8) + 2)
+
+    def test_corrupt_image_falls_back_to_orbax(self, tmp_path, capsys):
+        rdv, init, image = self._setup(tmp_path)
+        st = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                   subdir="t")
+        st.save({"step": 2, "x": jnp.arange(8) + 2}, wait=True)
+        st.finalize()
+        image.write_bytes(b"definitely not a pickle")
+        st2 = train.CheckpointState.restore_or_init(rdv, dict(init),
+                                                    subdir="t")
+        np.testing.assert_array_equal(np.asarray(st2.value["x"]),
+                                      np.arange(8) + 2)
+        assert "image unusable" in capsys.readouterr().out
+
+    def test_knob_disables_image_restore(self, tmp_path, monkeypatch):
+        template = {"step": 0, "x": jnp.arange(8)}
+        train._write_resume_image(str(tmp_path), 2,
+                                  {"step": 2, "x": np.arange(8)})
+        monkeypatch.setenv(constants.RESUME_OVERLAP_ENV, "0")
+        assert train._load_resume_image(str(tmp_path), 2, template) is None
+        monkeypatch.delenv(constants.RESUME_OVERLAP_ENV)
+        assert train._load_resume_image(str(tmp_path), 2, template) is not None
+
+    def test_sync_mode_writes_no_image(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(constants.CKPT_SNAPSHOT_ENV, "0")
+        st = train.CheckpointState(str(tmp_path), {}, FakeManager())
+        st.save(_value(1), wait=True)
+        assert not (tmp_path / train._RESUME_IMAGE).exists()
+
+
+class TestOverlappedRestore:
+    def test_phases_actually_overlap(self):
+        order = []
+
+        def restore_fn():
+            order.append("restore-start")
+            time.sleep(0.25)
+            order.append("restore-end")
+            return "state"
+
+        def compile_fn():
+            order.append("compile-start")
+            time.sleep(0.25)
+            return "exe"
+
+        restored, compiled, t = train.overlapped_restore(
+            restore_fn, compile_fn, overlap=True)
+        assert restored == "state" and compiled == "exe"
+        assert t["overlap"]
+        # The overlap PROOF: compile began before restore finished, and the
+        # wall is max-like, not sum-like.
+        assert order.index("compile-start") < order.index("restore-end")
+        assert t["wall_s"] < 0.9 * (t["restore_s"] + t["compile_s"])
+
+    def test_serial_mode_runs_compile_after_restore(self):
+        order = []
+        restored, compiled, t = train.overlapped_restore(
+            lambda: order.append("restore") or "s",
+            lambda: order.append("compile") or "c",
+            overlap=False)
+        assert (restored, compiled) == ("s", "c")
+        assert not t["overlap"]
+        assert order == ["restore", "compile"]
+
+    def test_env_knob_disables_overlap(self, monkeypatch):
+        monkeypatch.setenv(constants.RESUME_OVERLAP_ENV, "0")
+        _, _, t = train.overlapped_restore(lambda: 1, lambda: 2)
+        assert not t["overlap"]
+
+    def test_compile_failure_never_fails_the_resume(self, capsys):
+        def bad_compile():
+            raise ValueError("no cache for you")
+
+        restored, compiled, t = train.overlapped_restore(
+            lambda: "s", bad_compile, overlap=True)
+        assert restored == "s"
+        assert compiled is None
+        assert "warm compile failed" in capsys.readouterr().out
+
+    def test_no_compile_fn_restore_only(self):
+        restored, compiled, t = train.overlapped_restore(lambda: "s")
+        assert (restored, compiled) == ("s", None)
+        assert t["compile_s"] == 0.0
+
+
+class TestAotOrJit:
+    def test_none_compiled_is_identity(self):
+        def step(p, o, t):
+            return "jit"
+
+        assert train.aot_or_jit(None, step) is step
+
+    def test_aot_used_when_it_works(self):
+        run = train.aot_or_jit(lambda p, o, t: "aot", lambda p, o, t: "jit")
+        assert run(1, 2, 3) == "aot"
+
+    def test_fallback_is_permanent(self, capsys):
+        calls = {"aot": 0, "jit": 0}
+
+        def aot(p, o, t):
+            calls["aot"] += 1
+            raise RuntimeError("donated buffer shape mismatch")
+
+        def jit(p, o, t):
+            calls["jit"] += 1
+            return "ok"
+
+        run = train.aot_or_jit(aot, jit)
+        assert run(1, 2, 3) == "ok"
+        assert run(1, 2, 3) == "ok"
+        # One failed AOT attempt, then the jitted step permanently.
+        assert calls == {"aot": 1, "jit": 2}
+        assert "aot step fallback" in capsys.readouterr().out
+
+
+class TestExecutableSnapshot:
+    """The executable-snapshot level of compile persistence: a warm resume
+    loads the serialized compiled step (no trace, no lower, no compile);
+    every failure mode degrades to the trace+compile path."""
+
+    def _compiled(self):
+        return jax.jit(lambda x: x * 2 + 1).lower(
+            jax.ShapeDtypeStruct((4,), jnp.float32)).compile()
+
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "exec.jexec")
+        compiled = self._compiled()
+        train.store_executable_snapshot(path, compiled)
+        assert os.path.exists(path)
+        loaded = train.load_executable_snapshot(path)
+        assert loaded is not None
+        x = jnp.arange(4, dtype=jnp.float32)
+        assert jnp.allclose(loaded(x), compiled(x))
+
+    def test_missing_or_disabled_path_is_none(self, tmp_path):
+        assert train.load_executable_snapshot("") is None
+        assert train.load_executable_snapshot(str(tmp_path / "nope")) is None
+
+    def test_corrupt_snapshot_falls_back(self, tmp_path, capsys):
+        p = tmp_path / "bad.jexec"
+        p.write_bytes(b"definitely not a pickle")
+        assert train.load_executable_snapshot(str(p)) is None
+        assert "snapshot unusable" in capsys.readouterr().out
+
+    def test_store_is_best_effort(self, tmp_path, capsys):
+        # Not a Compiled: serialize() raises, store prints and returns --
+        # and leaves no tmp debris behind.
+        train.store_executable_snapshot(str(tmp_path / "x.jexec"), object())
+        assert "snapshot store failed" in capsys.readouterr().out
+        assert list(tmp_path.iterdir()) == []
+
+    def test_store_noop_without_path(self):
+        train.store_executable_snapshot("", object())  # must not raise
+
+    def test_fastpath_env_knob(self, monkeypatch):
+        monkeypatch.setenv(constants.RESUME_OVERLAP_ENV, "0")
+        assert not train.resume_fastpath_enabled()
+        monkeypatch.setenv(constants.RESUME_OVERLAP_ENV, "1")
+        assert train.resume_fastpath_enabled()
+        monkeypatch.delenv(constants.RESUME_OVERLAP_ENV)
+        assert train.resume_fastpath_enabled()
+
+
+class TestShutdownWatchdogVsBackgroundWrite:
+    @pytest.fixture
+    def fake_exit(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+        return exits
+
+    @pytest.fixture
+    def sigterm_restored(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        yield
+        signal.signal(signal.SIGTERM, prev)
+
+    def test_inflight_write_gets_its_bounded_window(self, fake_exit,
+                                                    sigterm_restored):
+        """SIGTERM lands while a background write is in flight: the
+        preemption checkpoint drains it and commits; the watchdog must NOT
+        force-exit inside the bounded post-surface window."""
+        gate = threading.Event()
+        mngr = FakeManager(gate=gate)
+        st = train.CheckpointState("", {}, mngr)
+        st.save(_value(1))  # background write now in flight, blocked
+        sd = train.GracefulShutdown(stuck_grace=0.1).install()
+        signal.getsignal(signal.SIGTERM)(signal.SIGTERM, None)
+        assert sd.requested
+        threading.Timer(0.2, gate.set).start()  # write "finishes" at 0.2s
+        sd.checkpoint_and_exit(lambda: st.save(_value(2), wait=True))
+        assert fake_exit == [train.GracefulShutdown.EXIT_CODE]
+        assert st.committed_step == 2
+        assert mngr.saved == [1, 2]
+        # Past the watchdog's whole window: it saw _save_done and stood down.
+        time.sleep(0.5)
+        assert fake_exit == [train.GracefulShutdown.EXIT_CODE]
+
+    def test_wedged_write_is_force_exited(self, fake_exit, sigterm_restored):
+        """The write never finishes (dead filesystem): the watchdog
+        force-exits 143 instead of burning the kubelet grace period."""
+        gate = threading.Event()  # never set while the watchdog decides
+        mngr = FakeManager(gate=gate)
+        st = train.CheckpointState("", {}, mngr)
+        st.save(_value(1))
+        sd = train.GracefulShutdown(stuck_grace=0.05).install()
+        signal.getsignal(signal.SIGTERM)(signal.SIGTERM, None)
+        sd._surfaced = True  # loop surfaced, save about to wedge in _drain
+        assert wait_for(lambda: fake_exit ==
+                        [train.GracefulShutdown.EXIT_CODE], timeout=5)
+        gate.set()  # unblock the writer thread for teardown
+
+    def test_stuck_loop_is_force_exited(self, fake_exit, sigterm_restored,
+                                        capsys):
+        """No step boundary ever observes the flag (blocked collective):
+        force-exit after stuck_grace."""
+        sd = train.GracefulShutdown(stuck_grace=0.05).install()
+        signal.getsignal(signal.SIGTERM)(signal.SIGTERM, None)
+        assert sd.requested
+        assert wait_for(lambda: fake_exit ==
+                        [train.GracefulShutdown.EXIT_CODE], timeout=5)
+
+
+class TestCheckpointStallTelemetry:
+    def test_ckpt_ms_reaches_metric_and_goodput(self):
+        from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+        from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+        from trainingjob_operator_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        g = GoodputTracker(metrics=m)
+        agg = TelemetryAggregator(metrics=m, goodput=g)
+        job = "default/tjob"
+        for step in range(3):
+            assert agg.ingest(
+                {"v": 1, "job": job, "rtype": "worker", "rank": 0,
+                 "step": step, "ms": 100.0, "ckpt_ms": 50.0},
+                now=1000.0 + step * 0.1)
+        # 3 pacer steps x 50 ms -> 0.15 s of step-visible checkpoint stall.
+        assert g.checkpoint_stall_seconds(job) == pytest.approx(0.15)
+        text = m.render_prometheus()
+        assert "trainingjob_checkpoint_stall_ms" in text
+
+    def test_records_without_ckpt_ms_unaffected(self):
+        from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+        from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+        from trainingjob_operator_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        g = GoodputTracker(metrics=m)
+        agg = TelemetryAggregator(metrics=m, goodput=g)
+        assert agg.ingest({"v": 1, "job": "default/j", "rtype": "worker",
+                           "rank": 0, "step": 0, "ms": 100.0}, now=1000.0)
+        assert g.checkpoint_stall_seconds("default/j") == 0.0
+        assert "trainingjob_checkpoint_stall_ms" not in m.render_prometheus()
+
+
+class TestInt8DecodeGate:
+    def test_gate_thresholds(self):
+        from trainingjob_operator_tpu.models import quant
+
+        assert quant.int8_effective(1)
+        assert quant.int8_effective(quant.INT8_DECODE_MAX_BATCH)
+        assert not quant.int8_effective(quant.INT8_DECODE_MAX_BATCH + 1)
+        assert not quant.int8_effective(8)  # BENCH_r05's 0.88x regression
+
+    def test_generate_skips_quantization_past_gate(self, monkeypatch):
+        from trainingjob_operator_tpu.models import decode, llama, quant
+
+        calls = []
+        real = quant.quantize_weights
+        monkeypatch.setattr(
+            quant, "quantize_weights",
+            lambda p: (calls.append(1), real(p))[1])
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        small = jnp.ones((2, 4), jnp.int32)
+        out = decode.generate(params, small, cfg, steps=2, quantize=True)
+        assert out.shape == (2, 2)
+        assert calls, "batch 2 is under the gate: int8 should engage"
+        calls.clear()
+        big = jnp.ones((8, 4), jnp.int32)
+        out = decode.generate(params, big, cfg, steps=2, quantize=True)
+        assert out.shape == (8, 2)
+        assert not calls, "batch 8 is past the gate: fp fallback"
+
+
+class TestSimSettledSkip:
+    def test_settled_pods_leave_the_tick_walk(self):
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ObjectMeta,
+            Pod,
+            PodPhase,
+            PodSpec,
+        )
+        from trainingjob_operator_tpu.runtime.sim import (
+            RUN_SECONDS_ANNOTATION,
+            SimRuntime,
+        )
+
+        cs = Clientset()
+        sim = SimRuntime(cs)
+        sim.add_node("n0")
+        cs.pods.create(Pod(
+            metadata=ObjectMeta(name="p0", namespace="default",
+                                annotations={RUN_SECONDS_ANNOTATION: "0.05"}),
+            spec=PodSpec(containers=[Container(name="c")])))
+        sim.start()
+        try:
+            assert wait_for(lambda: cs.pods.get("default", "p0")
+                            .status.phase == PodPhase.SUCCEEDED)
+            # Settled: dropped from the active walk, kept in the full cache
+            # (capacity accounting still sees its placement).
+            assert wait_for(lambda: "default/p0" not in sim._active_cache)
+            assert "default/p0" in sim._pods_cache
+            # Deletion re-activates it (the finalize walk owes it a
+            # finalize_delete) and it is eventually reaped for real.
+            cs.pods.delete("default", "p0")
+            assert wait_for(lambda: "default/p0" not in sim._pods_cache)
+        finally:
+            sim.stop()

@@ -119,6 +119,15 @@ def check(ctx: FileContext) -> List[Finding]:
     if "\\" not in ctx.source \
             or not any(isinstance(n, ast.JoinedStr) for n in ctx.nodes):
         return findings   # no f-string + backslash combo: skip the tokenize
+    # Second gate: only tokenize when a backslash falls within some
+    # f-string's own line span.  Most files that pass the first gate have
+    # their backslashes in ordinary strings/continuations, nowhere near an
+    # f-string -- a line-span scan is ~free, a full tokenize is not.
+    lines = ctx.source.split("\n")
+    if not any("\\" in line
+               for n in ctx.nodes if isinstance(n, ast.JoinedStr)
+               for line in lines[n.lineno - 1:(n.end_lineno or n.lineno)]):
+        return findings
     for line, col in _fstring_backslash_positions(ctx.source):
         findings.append(Finding(
             "TJA001", "py-compat", ctx.path, line, col, ERROR,

@@ -74,6 +74,21 @@ RESERVATION_TTL_ENV = "TRAININGJOB_RESERVATION_TTL"
 # subdir of the checkpoint dir so a restarted worker skips recompilation --
 # the dominant term in elastic-recovery latency.
 COMPILE_CACHE_ENV = "TRAININGJOB_COMPILE_CACHE"
+# Job-survivable compile-cache location (takes precedence over
+# TRAININGJOB_COMPILE_CACHE): point it at storage that outlives the
+# checkpoint dir (e.g. a per-cluster NFS path) so a RESCHEDULED job -- new
+# checkpoint dir and all -- still warm-starts its XLA compile.  "off"
+# disables, like the legacy knob.
+COMPILE_CACHE_DIR_ENV = "TRAININGJOB_COMPILE_CACHE_DIR"
+# "0" disables the overlapped resume path (workloads/train.py
+# overlapped_restore): restore and the warm XLA compile then run serially,
+# each still timed -- the A/B leg bench.py's time_to_resume_training keys on.
+RESUME_OVERLAP_ENV = "TRAININGJOB_RESUME_OVERLAP"
+# "0" disables snapshot-donate checkpointing (workloads/train.py
+# CheckpointState.save): the step loop then hands live jax.Arrays straight
+# to orbax (the legacy synchronous handoff), paying device-sync +
+# serialization setup in the step instead of one device->host copy.
+CKPT_SNAPSHOT_ENV = "TRAININGJOB_CKPT_SNAPSHOT"
 # Workload-side profiler (SURVEY.md §5.1): directory to write a
 # jax.profiler trace into, and the "start:stop" step range to trace.
 PROFILE_DIR_ENV = "TRAININGJOB_PROFILE_DIR"
@@ -136,6 +151,9 @@ PREFETCH_STALL_ENV = "TRAININGJOB_PREFETCH_STALL_S"
 #: dead surface.
 USER_ENV_KNOBS = frozenset((
     COMPILE_CACHE_ENV,
+    COMPILE_CACHE_DIR_ENV,
+    RESUME_OVERLAP_ENV,
+    CKPT_SNAPSHOT_ENV,
     PROFILE_DIR_ENV,
     PROFILE_STEPS_ENV,
     STEP_TIMES_ENV,

@@ -227,11 +227,14 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
 
     ``quantize`` runs the decode loop on weight-only int8 (models/quant.py)
     -- decode streams every weight per token, so int8 halves the HBM bytes
-    that bound its throughput.  Prefill stays full-precision (one
-    compute-bound pass over the prompt; also the KV cache source).  For a
-    serving deployment that must also drop the fp weights from HBM, call
-    ``quantize_weights`` once at load and pass the quantized pytree to
-    ``decode_step`` directly.
+    that bound its throughput.  The gate is batch-sized: past
+    ``quant.INT8_DECODE_MAX_BATCH`` rows per step the dot is no longer
+    bandwidth-bound and the dequant epilogue REGRESSES throughput (BENCH_r05
+    measured 0.88x at batch 8), so large batches silently keep fp weights.
+    Prefill stays full-precision (one compute-bound pass over the prompt;
+    also the KV cache source).  For a serving deployment that must also
+    drop the fp weights from HBM, call ``quantize_weights`` once at load
+    and pass the quantized pytree to ``decode_step`` directly.
     """
     import jax
     import jax.numpy as jnp
@@ -253,9 +256,13 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
     logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
     step_params = params
     if quantize:
-        from trainingjob_operator_tpu.models.quant import quantize_weights
+        from trainingjob_operator_tpu.models.quant import (
+            int8_effective,
+            quantize_weights,
+        )
 
-        step_params = quantize_weights(params)
+        if int8_effective(B):
+            step_params = quantize_weights(params)
 
     def pick(logits, k):
         if temperature <= 0.0:

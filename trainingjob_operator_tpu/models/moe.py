@@ -48,6 +48,13 @@ class MoEConfig:
     #: (GShard's group dimension) bounds C by the group, making dispatch
     #: linear in T; capacity (and hence token dropping) is then enforced
     #: per group, which also matches how real batches arrive.
+    #:
+    #: OFF by default: BENCH_r05 measured grouped routing at 0.994x the
+    #: whole-sequence step time at bench shapes (T<=2048) -- XLA fuses the
+    #: dense-dispatch einsums well enough that the asymptotic win has not
+    #: kicked in yet, while per-group capacity drops tokens a whole-seq
+    #: capacity would have kept.  Opt in for long sequences; bench.py's
+    #: MoE leg keeps a grouped A/B so the crossover is tracked.
     router_group: int = 0
     max_seq_len: int = 4096
     rope_theta: float = 10000.0

@@ -24,6 +24,22 @@ from typing import Any, Dict
 #: Matmul-weight leaf names (quantize per output channel = axis -2 kept).
 _MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
+#: Largest decode batch where weight-only int8 still wins.  The win comes
+#: from halving the weight bytes each decode step streams; the cost is the
+#: per-element ``int8 -> compute`` convert + scale multiply, which grows
+#: with batch while the weight read is batch-invariant.  BENCH_r05 measured
+#: the crossover between batch 1 (1.28x) and batch 8 (0.88x -- a
+#: REGRESSION: at that arithmetic intensity the dot leaves the
+#: bandwidth-bound regime and the dequant epilogue is pure overhead).
+INT8_DECODE_MAX_BATCH = 4
+
+
+def int8_effective(batch: int) -> bool:
+    """True when weight-only int8 is expected to pay for itself at this
+    decode batch size; callers fall back to fp weights otherwise
+    (models/decode.py ``generate(quantize=...)``)."""
+    return batch <= INT8_DECODE_MAX_BATCH
+
 
 def _quantize_leaf(w, axis: int):
     """Symmetric int8 over ``axis`` (the reduction axis): q = round(w/s)."""

@@ -37,7 +37,8 @@ DOWNTIME_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
 class _JobState:
     __slots__ = ("first_seen", "running_since", "productive",
                  "downtime_since", "downtime_scope", "first_running",
-                 "completed", "step_productive", "steps_seen")
+                 "completed", "step_productive", "steps_seen",
+                 "ckpt_stall", "ckpt_stalls_seen")
 
     def __init__(self) -> None:
         self.first_seen: Optional[float] = None
@@ -53,6 +54,12 @@ class _JobState:
         # actually completed, not time spent in phase Running.
         self.step_productive = 0.0
         self.steps_seen = 0
+        # Step-visible checkpoint-stall ledger (pacer rank): wall time the
+        # step loop spent handing checkpoints off -- inside step time, so
+        # NOT subtracted from productive; tracked so save-pipeline overhead
+        # is attributable per job.
+        self.ckpt_stall = 0.0
+        self.ckpt_stalls_seen = 0
 
 
 class GoodputTracker:
@@ -134,6 +141,32 @@ class GoodputTracker:
                 st.first_seen = now
             st.step_productive += seconds
             st.steps_seen += 1
+
+    def record_checkpoint_stall(self, key: str, seconds: float,
+                                now: Optional[float] = None) -> None:
+        """One checkpoint save stalled the step loop for ``seconds`` (pushed
+        from replica telemetry, pacer rank only; obs/telemetry.py also
+        observes it as ``trainingjob_checkpoint_stall_ms``).  Accumulated so
+        the save pipeline's step-loop tax is attributable per job -- the
+        number the snapshot-donate path (workloads/train.py) drives toward
+        the device->host copy floor."""
+        if seconds < 0.0:
+            return
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._state_locked(key)
+            if st.completed:
+                return
+            if st.first_seen is None:
+                st.first_seen = now
+            st.ckpt_stall += seconds
+            st.ckpt_stalls_seen += 1
+
+    def checkpoint_stall_seconds(self, key: str) -> float:
+        """Accumulated step-visible checkpoint stall (0.0 when none seen)."""
+        with self._lock:
+            st = self._jobs.get(key)
+            return st.ckpt_stall if st is not None else 0.0
 
     @staticmethod
     def _productive_locked(st: _JobState) -> float:

@@ -56,6 +56,13 @@ from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
 STEP_TIME_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                         1000.0, 2500.0, 5000.0, 15000.0, 60000.0)
 
+#: Checkpoint-stall histogram bucket upper bounds (milliseconds): the
+#: snapshot-donate path stalls the step O(device->host copy), sub-ms to
+#: tens of ms; the legacy synchronous handoff pays device sync +
+#: serialization setup, hundreds of ms to tens of seconds at 100B scale.
+CKPT_STALL_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                        1000.0, 5000.0, 30000.0)
+
 #: Peak dense bf16 FLOP/s per chip by accelerator-type substring, first
 #: match wins ("v5-lite" before "v5" would matter if a bare "v5" entry
 #: existed; it does not -- v5p and v5e are distinct products).  Sources:
@@ -228,6 +235,7 @@ class TelemetryAggregator:
         flops = _as_float(record.get("flops"))
         peak = _as_float(record.get("peak_flops"))
         loss = _as_float(record.get("loss"))
+        ckpt_ms = _as_float(record.get("ckpt_ms"))
 
         resumed: List[Tuple[str, str, str]] = []
         with self._lock:
@@ -268,11 +276,21 @@ class TelemetryAggregator:
             is_pacer = (rtype, rank) == self._pacer_locked(jt)
         self._metrics.observe("trainingjob_step_time_ms", ms,
                               buckets=STEP_TIME_BUCKETS_MS, job=job)
+        if ckpt_ms is not None and ckpt_ms >= 0.0:
+            # Step-visible checkpoint stall (workloads/train.py rides it on
+            # the record following each save): near-zero under
+            # snapshot-donate, device-sync + serialization setup under the
+            # legacy direct handoff.
+            self._metrics.observe("trainingjob_checkpoint_stall_ms", ckpt_ms,
+                                  buckets=CKPT_STALL_BUCKETS_MS, job=job)
         if is_pacer:
             # One replica feeds goodput: in a JAX SPMD job every process
             # takes the same global step, so summing all ranks would count
             # each productive second N times.
             self._goodput.record_step(job, ms / 1000.0, now=now)
+            if ckpt_ms is not None and ckpt_ms >= 0.0:
+                self._goodput.record_checkpoint_stall(job, ckpt_ms / 1000.0,
+                                                      now=now)
         self._emit(resumed)
         return True
 
@@ -680,8 +698,8 @@ class TelemetryEmitter:
     def enabled(self) -> bool:
         return bool(self.addr and self.job)
 
-    def emit(self, step: int, ms: float,
-             loss: Optional[float] = None) -> None:
+    def emit(self, step: int, ms: float, loss: Optional[float] = None,
+             ckpt_ms: Optional[float] = None) -> None:
         if not self.enabled or time.monotonic() < self._down_until:
             return
         record: Dict[str, Any] = {
@@ -696,6 +714,8 @@ class TelemetryEmitter:
             record["peak_flops"] = self.peak_flops
         if loss is not None:
             record["loss"] = loss
+        if ckpt_ms is not None:
+            record["ckpt_ms"] = round(ckpt_ms, 3)
         data = (json.dumps(record, sort_keys=True) + "\n").encode()
         try:
             if self._sock is None:

@@ -104,6 +104,15 @@ class SimRuntime(PodStateRuntime):
         # about to MUTATE is copied first (a conflicted write must not
         # poison the cache for the retry).
         self._pods_cache: Dict[str, Pod] = {}
+        # Settled pods (Succeeded/Failed, not being deleted) are inert to the
+        # kubelet: nothing left to start, report, or exit.  A long-lived
+        # fleet accumulates them (completed jobs linger until GC/TTL), so the
+        # steady-state tick walks this ACTIVE subset only -- the full cache
+        # is consulted just while something is pending (usage/gang maps must
+        # see every placed pod).  Maintained event-driven alongside
+        # ``_pods_cache``; a settled pod re-enters when deletion stamps it
+        # (the finalize walk still owes it a ``finalize_delete``).
+        self._active_cache: Dict[str, Pod] = {}
         self._nodes_cache: Dict[str, Node] = {}
         self._unsubs = [
             clientset.tracker.watch(Pod.KIND, self._on_pod_event),
@@ -111,9 +120,22 @@ class SimRuntime(PodStateRuntime):
         ]
         with self._lock:
             for pod in clientset.tracker.list(Pod.KIND):
-                self._pods_cache[f"{pod.namespace}/{pod.name}"] = pod
+                self._on_pod_cached(f"{pod.namespace}/{pod.name}", pod)
             for node in clientset.tracker.list(Node.KIND):
                 self._nodes_cache[node.name] = node
+
+    @staticmethod
+    def _settled(pod: Pod) -> bool:
+        return (pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+                and pod.metadata.deletion_timestamp is None)
+
+    def _on_pod_cached(self, key: str, pod: Pod) -> None:
+        """Caller holds the lock."""
+        self._pods_cache[key] = pod
+        if self._settled(pod):
+            self._active_cache.pop(key, None)
+        else:
+            self._active_cache[key] = pod
 
     def _on_pod_event(self, event: WatchEvent) -> None:
         pod = event.obj
@@ -121,8 +143,9 @@ class SimRuntime(PodStateRuntime):
         with self._lock:
             if event.type == DELETED:
                 self._pods_cache.pop(key, None)
+                self._active_cache.pop(key, None)
             else:
-                self._pods_cache[key] = pod
+                self._on_pod_cached(key, pod)
 
     def _on_node_event(self, event: WatchEvent) -> None:
         node = event.obj
@@ -190,19 +213,23 @@ class SimRuntime(PodStateRuntime):
         now = time.time()
         with self._lock:
             # Watch-fed snapshots: dict/list copies of privately-owned cached
-            # objects, no per-tick store deepcopy.
+            # objects, no per-tick store deepcopy.  Steady state walks only
+            # the active subset; settled pods cost nothing per tick.
             nodes = dict(self._nodes_cache)
-            pods = list(self._pods_cache.values())
+            active = list(self._active_cache.values())
 
         # Gang-aware scheduling: group pending pods by (namespace, gang); a
         # gang is placed only if every member fits simultaneously.  The
-        # usage/gang maps cost one pass over all pods, so they are built only
-        # while something is actually pending (during churn bursts), not on
-        # every steady-state tick.
-        pending = [p for p in pods
+        # usage/gang maps cost one pass over ALL pods (settled ones included
+        # -- their placements still occupy sim capacity), so the full cache
+        # is snapshotted only while something is actually pending (during
+        # churn bursts), not on every steady-state tick.
+        pending = [p for p in active
                    if p.status.phase == PodPhase.PENDING and not p.spec.node_name
                    and p.metadata.deletion_timestamp is None]
         if pending:
+            with self._lock:
+                pods = list(self._pods_cache.values())
             # node -> usage
             pod_count: Dict[str, int] = {}
             tpu_used: Dict[str, int] = {}
@@ -237,8 +264,11 @@ class SimRuntime(PodStateRuntime):
                     continue
                 self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
 
-        # Walk running/scheduled pods through their lifecycle.
-        for pod, rt in self._pod_states(pods):
+        # Walk ACTIVE pods through their lifecycle.  Settled pods are absent
+        # by construction (and their _state entries age out via the two-walk
+        # reap; the graceful-delete finalizer re-creates an entry, stamped,
+        # if one is deleted later).
+        for pod, rt in self._pod_states(active):
             if pod.metadata.deletion_timestamp is not None:
                 if (rt.terminating_since is not None
                         and now - rt.terminating_since >= self._termination_grace):
@@ -247,7 +277,7 @@ class SimRuntime(PodStateRuntime):
                 continue
 
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
-                continue  # settled: nothing left for the kubelet to report
+                continue  # settled mid-snapshot: nothing left to report
 
             node = nodes.get(pod.spec.node_name) if pod.spec.node_name else None
             if node is None or not node.is_ready():

@@ -69,14 +69,12 @@ def main() -> int:
     prompt_ids = [int(x) for x in
                   os.environ.get("GEN_PROMPT", "1").split(",")]
 
-    import orbax.checkpoint as ocp
-
-    # PLACEHOLDER skips the AdamW moments entirely: a 7B checkpoint holds
+    # The placeholder skips the AdamW moments entirely: a 7B checkpoint holds
     # ~2x the params in optimizer state the sampler never uses -- restoring
     # it would triple restore IO and can OOM a host that fits params alone.
     state = train.CheckpointState.restore_or_init(
         rdv, {"params": init_params(cfg, jax.random.PRNGKey(0)),
-              "opt_state": ocp.PLACEHOLDER, "step": 0},
+              "opt_state": train.ckpt_placeholder(), "step": 0},
         subdir=subdir)
     step = int(state.value["step"])
     params = state.value["params"]

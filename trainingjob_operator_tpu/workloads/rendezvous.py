@@ -124,19 +124,38 @@ def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
     return rdv
 
 
+def compile_cache_dir(rdv: Rendezvous) -> str:
+    """Resolve the persistent compile-cache directory ("" when disabled).
+
+    Shared by ``enable_compile_cache`` (points XLA's HLO-level cache here)
+    and the workloads' executable snapshots
+    (``train.store_executable_snapshot``), which live beside the HLO cache
+    so both survive exactly as long as each other.
+    """
+    path = (os.environ.get(constants.COMPILE_CACHE_DIR_ENV, "")
+            or os.environ.get(constants.COMPILE_CACHE_ENV, ""))
+    if not path and rdv.checkpoint_dir:
+        path = os.path.join(rdv.checkpoint_dir, ".jax_compile_cache")
+    return "" if (not path or path == "off") else path
+
+
 def enable_compile_cache(rdv: Rendezvous) -> None:
     """Point XLA's persistent compilation cache at a job-stable directory.
 
     A restarted elastic worker re-traces the same step function; with the
     cache warm, compilation -- the dominant term in the <90 s recovery budget
-    (BASELINE.md) -- is a disk read instead of a rebuild.  Defaults to
+    (BASELINE.md) -- is a disk read instead of a rebuild.
+    ``TRAININGJOB_COMPILE_CACHE_DIR`` names a JOB-SURVIVABLE location
+    (cluster NFS, a persistent volume): a rescheduled job with a brand-new
+    checkpoint dir still warm-starts its compile, and
+    workloads/train.py's ``overlapped_restore`` runs the warm compile
+    concurrently with the orbax restore.  Falls back to the legacy
+    ``TRAININGJOB_COMPILE_CACHE``, then to
     ``<checkpoint_dir>/.jax_compile_cache`` (survives restarts exactly as
-    long as the checkpoint does); ``TRAININGJOB_COMPILE_CACHE=off`` disables.
+    long as the checkpoint does); ``off`` in either var disables.
     """
-    path = os.environ.get(constants.COMPILE_CACHE_ENV, "")
-    if not path and rdv.checkpoint_dir:
-        path = os.path.join(rdv.checkpoint_dir, ".jax_compile_cache")
-    if not path or path == "off":
+    path = compile_cache_dir(rdv)
+    if not path:
         return
     import jax
 
