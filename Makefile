@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo fleet-smoke recovery-smoke
+.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -41,6 +41,14 @@ trace-demo:
 telemetry-demo:
 	$(PY) -m tools.telemetry_demo
 
+# One simulated job through a scripted preemption (pod killed with exit 137,
+# restart scope ALL); prints the incident flight recorder's phase-attributed
+# downtime table and cross-checks it against the goodput ledger
+# (docs/OBSERVABILITY.md incident section).  Exits non-zero if any downtime
+# stays unattributed.
+incident-demo:
+	$(PY) -m tools.incident_demo
+
 # Seeded ~200-job churn run against the sim cluster (docs/FLEET.md); exits
 # non-zero unless the fleet converges with zero invariant violations.
 # TRAININGJOB_FLEET_SEED / TRAININGJOB_FLEET_JOBS override the defaults.
@@ -60,4 +68,4 @@ recovery-smoke:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint test dryrun fleet-smoke recovery-smoke
+ci: lint test dryrun incident-demo fleet-smoke recovery-smoke

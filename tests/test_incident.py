@@ -1,0 +1,559 @@
+"""Incident flight recorder: attribution math, bundle lifecycle/retention,
+the /debug/incidents endpoint, the widened /debug/steps columns, and the
+sim e2e preemption acceptance.
+
+Unit layer first (a private IncidentRecorder + registry driven with explicit
+timestamps -- assembly is a pure function of the ring, so the tests pin the
+phase arithmetic exactly), then retention/eviction and the metric surface,
+then HTTP, then e2e: a sim job killed with exit 137 (restart scope ALL) must
+leave an amended bundle whose phases sum to its downtime with no meaningful
+``unknown`` residue, whose control window matches the goodput ledger, and
+whose serialization is byte-stable across re-assembly.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+from trainingjob_operator_tpu.obs.incident import (
+    INCIDENTS,
+    PHASES,
+    IncidentRecorder,
+    bundle_to_chrome,
+)
+from trainingjob_operator_tpu.obs.telemetry import (
+    TELEMETRY,
+    TelemetryAggregator,
+)
+from trainingjob_operator_tpu.utils.metrics import (
+    METRICS,
+    MetricsRegistry,
+    serve_metrics,
+)
+
+from conftest import wait_for  # noqa: E402
+
+JOB = "default/incjob"
+
+
+def _rec(ring=64, keep=4):
+    return IncidentRecorder(metrics=MetricsRegistry(), ring=ring, keep=keep)
+
+
+def _phases_sum(bundle):
+    return sum(bundle["phases"].values())
+
+
+def _restart_window(rec, t0=100.0, job=JOB, scope="ALL"):
+    """Drive one canonical control window: interruption at ``t0``, corrective
+    event at +0.2, delete at +0.5, create at +1.0, Running at +2.0."""
+    rec.on_interruption(job, scope, constants.RESTARTING_REASON, now=t0)
+    rec.record_event(job, constants.RESTARTING_REASON, "restarting",
+                     ts=t0 + 0.2)
+    rec.record_event(job, constants.SUCCESSFUL_DELETE_POD_REASON, "del p0",
+                     ts=t0 + 0.5)
+    rec.record_event(job, constants.SUCCESSFUL_CREATE_POD_REASON, "create p0",
+                     ts=t0 + 1.0)
+    rec.on_running(job, now=t0 + 2.0)
+
+
+# -- attribution unit layer ---------------------------------------------------
+
+class TestAttribution:
+    def test_provisional_bundle_partitions_control_window(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["kind"] == "restart"
+        assert bundle["reason"] == constants.RESTARTING_REASON
+        assert bundle["scope"] == "ALL"
+        assert bundle["running_at"] == 102.0
+        assert bundle["downtime_ms"] == 2000.0
+        assert bundle["control_downtime_ms"] == 2000.0
+        assert bundle["phases"]["detect"] == pytest.approx(200.0)
+        assert bundle["phases"]["teardown"] == pytest.approx(300.0)
+        assert bundle["phases"]["reschedule"] == pytest.approx(500.0)
+        # No workload evidence yet: the tail up to Running is rendezvous.
+        assert bundle["phases"]["rendezvous"] == pytest.approx(1000.0)
+        assert bundle["phases"]["unknown"] == 0.0
+        assert _phases_sum(bundle) == pytest.approx(bundle["downtime_ms"])
+
+    def test_first_step_amends_with_overlapped_resume_tail(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        # Overlapped restore+compile: only the non-hidden compile tail
+        # (500 - 300 = 200 ms) is charged to ``compile``.
+        rec.record_resume(JOB, restore_ms=300.0, compile_ms=500.0,
+                          overlapped=True, now=102.9)
+        rec.record_step(JOB, step=5, ms=100.0, now=103.0)
+        bundles = rec.bundles(JOB)
+        assert len(bundles) == 1  # amended in place, same incident
+        bundle = bundles[0]
+        assert bundle["id"] == 1
+        assert bundle["downtime_ms"] == 3000.0
+        assert bundle["control_downtime_ms"] == 2000.0
+        assert bundle["phases"]["rendezvous"] == pytest.approx(1400.0)
+        assert bundle["phases"]["restore"] == pytest.approx(300.0)
+        assert bundle["phases"]["compile"] == pytest.approx(200.0)
+        assert bundle["phases"]["first_step"] == pytest.approx(100.0)
+        assert _phases_sum(bundle) == pytest.approx(bundle["downtime_ms"])
+        assert rec.open_incident(JOB) is None  # amend closed the incident
+
+    def test_serial_resume_charges_full_compile(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        rec.record_resume(JOB, restore_ms=300.0, compile_ms=500.0,
+                          overlapped=False, now=102.9)
+        rec.record_step(JOB, step=5, ms=100.0, now=103.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["phases"]["restore"] == pytest.approx(300.0)
+        assert bundle["phases"]["compile"] == pytest.approx(500.0)
+        assert _phases_sum(bundle) == pytest.approx(bundle["downtime_ms"])
+
+    def test_first_step_without_resume_evidence(self):
+        rec = _rec()
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=200.0)
+        rec.record_event(JOB, constants.RESTARTING_REASON, "restarting",
+                         ts=200.1)
+        rec.record_event(JOB, constants.SUCCESSFUL_CREATE_POD_REASON,
+                         "create", ts=200.5)
+        rec.on_running(JOB, now=201.0)
+        rec.record_step(JOB, step=7, ms=500.0, now=201.8)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["phases"]["detect"] == pytest.approx(100.0)
+        assert bundle["phases"]["teardown"] == 0.0
+        assert bundle["phases"]["reschedule"] == pytest.approx(400.0)
+        # The step's own duration is first_step; the rest is rendezvous.
+        assert bundle["phases"]["first_step"] == pytest.approx(500.0)
+        assert bundle["phases"]["rendezvous"] == pytest.approx(800.0)
+        assert _phases_sum(bundle) == pytest.approx(1800.0)
+
+    def test_empty_window_is_unknown_not_invented(self):
+        rec = _rec()
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=300.0)
+        rec.on_running(JOB, now=301.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["phases"]["unknown"] == pytest.approx(1000.0)
+        assert _phases_sum(bundle) == pytest.approx(1000.0)
+
+    def test_stall_incident_is_all_detect(self):
+        rec = _rec()
+        rec.record_event(JOB, constants.STEP_STALLED_REASON, "rank 2 stuck",
+                         ts=400.0)
+        assert rec.open_incident(JOB)["kind"] == "stall"
+        rec.record_event(JOB, constants.STEP_RESUMED_REASON, "resumed",
+                         ts=405.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["kind"] == "stall"
+        assert bundle["downtime_ms"] == 5000.0
+        assert bundle["phases"]["detect"] == pytest.approx(5000.0)
+
+    def test_restart_adopts_open_stall(self):
+        rec = _rec()
+        rec.record_event(JOB, constants.STEP_STALLED_REASON, "stuck", ts=500.0)
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=502.0)
+        inc = rec.open_incident(JOB)
+        assert inc["kind"] == "restart"
+        assert inc["scope"] == "ALL"
+        assert inc["started"] == 500.0  # the stall detected it first
+        rec.on_running(JOB, now=503.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["downtime_ms"] == 3000.0
+        assert _phases_sum(bundle) == pytest.approx(3000.0)
+
+    def test_reentry_mid_window_is_idempotent(self):
+        rec = _rec()
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=600.0)
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=600.5)
+        inc = rec.open_incident(JOB)
+        assert inc["id"] == 1 and inc["started"] == 600.0
+
+    def test_abnormal_completion_synthesizes_terminal_incident(self):
+        rec = _rec()
+        rec.record_event(JOB, constants.EXITED_WITH_CODE_REASON, "exit 137",
+                         ts=600.0)
+        rec.record_event(JOB, constants.TERMINATING_REASON, "tearing down",
+                         ts=600.4)
+        rec.on_complete(JOB, "Preempted", now=601.0)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["kind"] == "terminal"
+        assert bundle["reason"] == "TrainingJobPreempted"
+        assert bundle["started"] == 600.0  # anchored at earliest evidence
+        assert bundle["running_at"] is None
+        assert bundle["control_downtime_ms"] is None
+        assert bundle["phases"]["detect"] == pytest.approx(400.0)
+        assert bundle["phases"]["teardown"] == pytest.approx(600.0)
+        # Completed jobs accept no further incidents.
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=700.0)
+        assert rec.open_incident(JOB) is None
+        rec.record_event(JOB, constants.STEP_STALLED_REASON, "x", ts=701.0)
+        assert rec.open_incident(JOB) is None
+
+    def test_normal_completion_without_incident_is_silent(self):
+        rec = _rec()
+        rec.on_complete(JOB, "Succeeded", now=100.0)
+        assert rec.bundles(JOB) is None  # no state was ever created
+
+
+# -- determinism + retention + metric surface ---------------------------------
+
+class TestBundleLifecycle:
+    def test_serialization_is_byte_stable(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        rec.record_resume(JOB, 300.0, 500.0, True, now=102.9)
+        rec.record_step(JOB, 5, 100.0, ckpt_ms=2.5, hbm_bytes=1e9, now=103.0)
+        first = rec.bundle_json(JOB)
+        assert first is not None
+        # reassemble re-runs _assemble from the frozen ring snapshot; the
+        # determinism contract is byte equality, twice over.
+        assert rec.reassemble(JOB) == first
+        assert rec.reassemble(JOB) == first
+        assert rec.bundle_json(JOB) == first
+        assert json.loads(first)["timeline"]  # and it still parses
+
+    def test_chrome_export_is_perfetto_shaped(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        doc = json.loads(rec.export_chrome(JOB))
+        assert doc["displayTimeUnit"] == "ms"
+        names = {ev["name"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+        assert {"detect", "teardown", "reschedule"} <= names
+        instants = [ev for ev in doc["traceEvents"] if ev["ph"] == "i"]
+        assert any(ev["name"] == constants.SUCCESSFUL_CREATE_POD_REASON
+                   for ev in instants)
+        # Pure function of the bundle: same bundle, same bytes.
+        (bundle,) = rec.bundles(JOB)
+        assert bundle_to_chrome(bundle) == rec.export_chrome(JOB)
+
+    def test_retention_ring_evicts_oldest_bundles(self):
+        rec = _rec(keep=2)
+        for i in range(5):
+            _restart_window(rec, t0=1000.0 + 10.0 * i)
+            rec.record_step(JOB, i, 50.0, now=1000.0 + 10.0 * i + 3.0)
+        bundles = rec.bundles(JOB)
+        assert [b["id"] for b in bundles] == [4, 5]
+        assert rec.retained_bytes(JOB) == sum(
+            len(rec.bundle_json(JOB, b["id"])) for b in bundles)
+        assert rec.retained_bytes(JOB) > 0
+
+    def test_metrics_counter_gauges_and_forget(self):
+        reg = MetricsRegistry()
+        rec = IncidentRecorder(metrics=reg, ring=64, keep=4)
+        _restart_window(rec, t0=100.0)
+        _restart_window(rec, t0=200.0)
+        snap = reg.snapshot()
+        counter = next(v for k, v in snap.items()
+                       if k.startswith("trainingjob_incidents_total"))
+        assert counter == 2.0
+        downtime = {k: v for k, v in snap.items()
+                    if k.startswith("trainingjob_downtime_ms")}
+        assert len(downtime) == len(PHASES)  # one gauge per phase
+        assert sum(downtime.values()) == pytest.approx(4000.0)
+        assert any(k.startswith("trainingjob_incident_bundle_bytes") and v > 0
+                   for k, v in snap.items())
+        rec.forget(JOB)
+        snap = reg.snapshot()
+        assert not any(k.startswith("trainingjob_downtime_ms")
+                       or k.startswith("trainingjob_incident_bundle_bytes")
+                       for k in snap)
+        assert rec.bundles(JOB) is None
+
+    def test_incident_recorded_event_fires_once_via_sink(self):
+        rec = _rec()
+        seen = []
+        rec.set_event_sink(lambda job, reason, msg: seen.append(
+            (job, reason, msg)))
+        _restart_window(rec, t0=100.0)
+        rec.record_step(JOB, 5, 100.0, now=103.0)  # amend, must NOT re-emit
+        assert len(seen) == 1
+        job, reason, msg = seen[0]
+        assert job == JOB
+        assert reason == constants.INCIDENT_RECORDED_REASON
+        assert "incident #1" in msg and "/debug/incidents?job=" in msg
+
+
+# -- /debug/incidents endpoint ------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestDebugIncidentsEndpoint:
+    @pytest.fixture
+    def server(self):
+        rec = _rec()
+        _restart_window(rec, t0=100.0)
+        rec.record_step(JOB, 5, 100.0, now=103.0)
+        srv = serve_metrics(0, MetricsRegistry(), incidents=rec)
+        yield srv.server_address[1], rec
+        srv.shutdown()
+
+    def test_job_summary_list(self, server):
+        port, _rec_ = server
+        status, body = _get(port, "/debug/incidents")
+        doc = json.loads(body)
+        assert status == 200 and doc["count"] == 1
+        assert doc["jobs"][0]["job"] == JOB
+        assert doc["jobs"][0]["incidents"] == 1
+        assert doc["jobs"][0]["bytes"] > 0
+
+    def test_fetch_job_bundles(self, server):
+        port, rec = server
+        status, body = _get(port, f"/debug/incidents?job={JOB}")
+        doc = json.loads(body)
+        assert status == 200 and doc["job"] == JOB and doc["count"] == 1
+        assert doc["open"] is None
+        assert doc["incidents"][0]["phases"].keys() == set(PHASES)
+
+    def test_fetch_by_id_is_canonical_json(self, server):
+        port, rec = server
+        status, body = _get(port, f"/debug/incidents?job={JOB}&id=1")
+        assert status == 200
+        assert body == rec.bundle_json(JOB, 1)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, f"/debug/incidents?job={JOB}&id=99")
+        assert exc.value.code == 404
+
+    def test_chrome_format(self, server):
+        port, _rec_ = server
+        status, body = _get(port, f"/debug/incidents?job={JOB}&format=chrome")
+        assert status == 200
+        assert json.loads(body)["traceEvents"]
+
+    def test_unknown_job_404(self, server):
+        port, _rec_ = server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, "/debug/incidents?job=no/such")
+        assert exc.value.code == 404
+
+    def test_bad_format_is_400_not_default(self, server):
+        port, _rec_ = server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, f"/debug/incidents?job={JOB}&format=starlight")
+        assert exc.value.code == 400
+
+    def test_404_without_incidents_provider(self):
+        srv = serve_metrics(0, MetricsRegistry())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(srv.server_address[1], "/debug/incidents")
+            assert exc.value.code == 404
+        finally:
+            srv.shutdown()
+
+
+# -- /debug/steps gains ckpt_ms + hbm_bytes -----------------------------------
+
+class TestStepsTableColumns:
+    @pytest.fixture
+    def agg(self):
+        reg = MetricsRegistry()
+        agg = TelemetryAggregator(metrics=reg,
+                                  goodput=GoodputTracker(metrics=reg))
+        for step in range(5):
+            # rank 0 reports checkpoint stall + HBM samples; rank 1 never.
+            assert agg.ingest({"v": 1, "job": JOB, "rtype": "worker",
+                               "rank": 0, "step": step, "ms": 50.0,
+                               "ckpt_ms": 12.345, "hbm_bytes": 2.5e9},
+                              now=1000.0 + step * 0.1)
+            assert agg.ingest({"v": 1, "job": JOB, "rtype": "worker",
+                               "rank": 1, "step": step, "ms": 50.0},
+                              now=1000.0 + step * 0.1)
+        return agg
+
+    def test_json_rows_carry_new_columns(self, agg):
+        rows = {r["replica"]: r
+                for r in agg.job_table(JOB, now=1001.0)["replicas"]}
+        assert rows["worker-0"]["ckpt_ms"] == pytest.approx(12.35)
+        assert rows["worker-0"]["hbm_bytes"] == pytest.approx(2.5e9)
+        # Never-reporting replicas stay None, not 0 -- absence is not zero.
+        assert rows["worker-1"]["ckpt_ms"] is None
+        assert rows["worker-1"]["hbm_bytes"] is None
+
+    def test_text_table_renders_dash_for_missing(self, agg):
+        text = agg.render_table(JOB, now=1001.0)
+        header = text.splitlines()[0]
+        assert "ckpt_ms" in header and "hbm_bytes" in header
+        row1 = next(ln for ln in text.splitlines() if "worker-1" in ln)
+        assert "-" in row1.split()
+
+    def test_resume_record_routes_to_incidents_not_steps(self):
+        reg = MetricsRegistry()
+        rec = IncidentRecorder(metrics=reg, ring=64, keep=4)
+        agg = TelemetryAggregator(metrics=reg,
+                                  goodput=GoodputTracker(metrics=reg),
+                                  incidents=rec)
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=99.0)
+        rec.record_event(JOB, constants.SUCCESSFUL_CREATE_POD_REASON,
+                         "create", ts=99.5)
+        assert agg.ingest({"v": 1, "job": JOB, "rtype": "worker", "rank": 0,
+                           "resume_restore_ms": 120.0,
+                           "resume_compile_ms": 200.0,
+                           "resume_overlapped": True, "ts": 100.0}, now=100.0)
+        # Not a step: the job table has no replica rows from it.
+        assert agg.job_table(JOB, now=100.5) is None
+        rec.on_running(JOB, now=100.6)
+        agg.ingest({"v": 1, "job": JOB, "rtype": "worker", "rank": 0,
+                    "step": 3, "ms": 20.0}, now=100.7)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["phases"]["restore"] > 0.0
+
+    def test_malformed_resume_record_counted_not_raised(self):
+        reg = MetricsRegistry()
+        agg = TelemetryAggregator(metrics=reg,
+                                  goodput=GoodputTracker(metrics=reg))
+        assert not agg.ingest({"v": 1, "job": "noslash",
+                               "resume_restore_ms": 5.0}, now=100.0)
+        assert not agg.ingest({"v": 1, "job": JOB,
+                               "resume_restore_ms": -1.0}, now=100.0)
+
+
+# -- e2e: sim preemption -> amended incident bundle ---------------------------
+
+class TestPreemptionE2E:
+    @pytest.fixture
+    def cluster(self):
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.cmd.options import OperatorOptions
+        from trainingjob_operator_tpu.controller.controller import (
+            TrainingJobController,
+        )
+        from trainingjob_operator_tpu.runtime.sim import SimRuntime
+
+        cs = Clientset()
+        tc = TrainingJobController(
+            cs, options=OperatorOptions(resync_period=0.05))
+        sim = SimRuntime(cs)
+        sim.add_node("n0")
+        sim.add_node("n1")
+        sim.start()
+        tc.run(workers=2)
+        yield cs, tc, sim
+        tc.stop()
+        sim.stop()
+
+    def test_preempted_pod_yields_attributed_bundle(self, cluster):
+        from trainingjob_operator_tpu.api.types import (
+            ReplicaSpec,
+            RestartPolicy,
+            RestartScope,
+            TPUTrainingJob,
+            TrainingJobPhase,
+        )
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ContainerPort,
+            ObjectMeta,
+            PodPhase,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from trainingjob_operator_tpu.obs.goodput import GOODPUT
+        from trainingjob_operator_tpu.runtime.sim import (
+            CKPT_MS_ANNOTATION,
+            COMPILE_MS_ANNOTATION,
+            HBM_BYTES_ANNOTATION,
+            RESTORE_MS_ANNOTATION,
+            RUN_SECONDS_ANNOTATION,
+            STEP_MS_ANNOTATION,
+            TOKENS_PER_STEP_ANNOTATION,
+        )
+
+        cs, tc, sim = cluster
+        key = "default/preemptjob"
+        TELEMETRY.forget(key)
+        INCIDENTS.forget(key)
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name="preemptjob", namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=2,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            restart_scope=RestartScope.ALL,
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(annotations={
+                    RUN_SECONDS_ANNOTATION: "60",
+                    STEP_MS_ANNOTATION: "20",
+                    TOKENS_PER_STEP_ANNOTATION: "512",
+                    CKPT_MS_ANNOTATION: "1.5",
+                    HBM_BYTES_ANNOTATION: "2.5e9",
+                    RESTORE_MS_ANNOTATION: "120",
+                    COMPILE_MS_ANNOTATION: "200",
+                }),
+                spec=PodSpec(containers=[
+                    Container(name="aitj-main",
+                              ports=[ContainerPort(name="aitj-7745",
+                                                   container_port=7745)])])))
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+        victim = "preemptjob-trainer-0"
+
+        def stepping():
+            try:
+                pod = cs.pods.get("default", victim)
+            except KeyError:
+                return False
+            if pod.status.phase != PodPhase.RUNNING:
+                return False
+            table = TELEMETRY.job_table(key)
+            return bool(table and any(r["step"] > 0
+                                      for r in table["replicas"]))
+
+        try:
+            assert wait_for(
+                lambda: cs.trainingjobs.get("default", "preemptjob")
+                .status.phase == TrainingJobPhase.RUNNING, 10)
+            assert wait_for(stepping, 15)
+            sim.preempt_pod("default", victim, exit_code=137)
+
+            def amended():
+                for b in reversed(INCIDENTS.bundles(key) or []):
+                    if (b["running_at"] is not None
+                            and b["ended"] > b["running_at"]):
+                        return b
+                return None
+
+            assert wait_for(lambda: amended() is not None, 20)
+            bundle = amended()
+
+            # Acceptance 1: every ms is attributed; phases partition the
+            # downtime exactly (assembly sums segment lengths), and the
+            # evicted-ring residue stays under the 5% budget.
+            assert _phases_sum(bundle) == pytest.approx(
+                bundle["downtime_ms"], abs=0.01)
+            assert bundle["phases"]["unknown"] <= 0.05 * bundle["downtime_ms"]
+            assert bundle["downtime_ms"] > 0
+
+            # Acceptance 2: the control window IS the goodput ledger's
+            # downtime window -- both hooks received the same clock reads.
+            assert bundle["control_downtime_ms"] == pytest.approx(
+                GOODPUT.downtime_seconds(key) * 1000.0, abs=1.0)
+
+            # Acceptance 3: byte-stable across two assemblies of the ring.
+            assert INCIDENTS.reassemble(key, bundle["id"]) == \
+                INCIDENTS.bundle_json(key, bundle["id"])
+
+            # Acceptance 4: the bundle announced itself as a job event, and
+            # the metric surface carries the incident.
+            assert wait_for(
+                lambda: any(
+                    ev.reason == constants.INCIDENT_RECORDED_REASON
+                    for ev in cs.events.list("default")), 10)
+            prom = METRICS.render_prometheus()
+            assert any(ln.startswith("trainingjob_incidents_total")
+                       for ln in prom.splitlines())
+            assert any(ln.startswith('trainingjob_downtime_ms{'
+                                     f'job="{key}"')
+                       or ln.startswith("trainingjob_downtime_ms{")
+                       and f'job="{key}"' in ln
+                       for ln in prom.splitlines())
+        finally:
+            cs.trainingjobs.delete("default", "preemptjob")
+            TELEMETRY.forget(key)
+            INCIDENTS.forget(key)

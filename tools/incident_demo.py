@@ -1,0 +1,171 @@
+"""Run one simulated TrainingJob through a scripted preemption and print
+the incident flight recorder's phase-attributed downtime table.
+
+The ``make incident-demo`` driver: in-process sim cluster, one 2-replica
+job with restart-on-exit-code semantics (scope ALL).  Once it is Running
+and reporting steps, the demo kills a pod with exit 137 -- the controller
+drains and restarts the whole gang, the flight recorder (obs/incident.py)
+captures the window, and the first post-recovery step record amends the
+bundle with the workload tail (the sim synthesizes the resume record a
+real workload's ``overlapped_restore`` would push).  The demo prints the
+per-phase downtime table -- the same bundle ``/debug/incidents?job=...``
+serves -- and cross-checks the control window against the goodput ledger.
+
+Usage::
+
+    python -m tools.incident_demo [--timeout 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("incident-demo")
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="Give up if no amended bundle by then.")
+    args = parser.parse_args(argv)
+
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.api.types import (
+        ReplicaSpec,
+        RestartPolicy,
+        RestartScope,
+        TPUTrainingJob,
+    )
+    from trainingjob_operator_tpu.client.clientset import Clientset
+    from trainingjob_operator_tpu.cmd.options import OperatorOptions
+    from trainingjob_operator_tpu.controller.controller import (
+        TrainingJobController,
+    )
+    from trainingjob_operator_tpu.core.objects import (
+        Container,
+        ContainerPort,
+        ObjectMeta,
+        PodPhase,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from trainingjob_operator_tpu.obs.goodput import GOODPUT
+    from trainingjob_operator_tpu.obs.incident import INCIDENTS, PHASES
+    from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
+    from trainingjob_operator_tpu.runtime.sim import (
+        CKPT_MS_ANNOTATION,
+        COMPILE_MS_ANNOTATION,
+        HBM_BYTES_ANNOTATION,
+        RESTORE_MS_ANNOTATION,
+        RUN_SECONDS_ANNOTATION,
+        STEP_MS_ANNOTATION,
+        TOKENS_PER_STEP_ANNOTATION,
+        SimRuntime,
+    )
+
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.add_node("sim-0")
+    sim.add_node("sim-1")
+    sim.start()
+    tc.run(workers=2)
+    job_key = "default/incident-demo"
+    try:
+        job = TPUTrainingJob(metadata=ObjectMeta(name="incident-demo",
+                                                 namespace="default"))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=2,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            restart_scope=RestartScope.ALL,
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(annotations={
+                    RUN_SECONDS_ANNOTATION: str(args.timeout * 2),
+                    STEP_MS_ANNOTATION: "20",
+                    TOKENS_PER_STEP_ANNOTATION: "8192",
+                    CKPT_MS_ANNOTATION: "1.5",
+                    HBM_BYTES_ANNOTATION: "2.5e9",
+                    RESTORE_MS_ANNOTATION: "120",
+                    COMPILE_MS_ANNOTATION: "200",
+                }),
+                spec=PodSpec(containers=[
+                    Container(name="aitj-main",
+                              ports=[ContainerPort(name="aitj-7777",
+                                                   container_port=7777)])])))
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+
+        deadline = time.time() + args.timeout
+        victim = "incident-demo-trainer-0"
+
+        def pod_running_and_stepping() -> bool:
+            try:
+                pod = cs.pods.get("default", victim)
+            except KeyError:
+                return False
+            if pod.status.phase != PodPhase.RUNNING:
+                return False
+            table = TELEMETRY.job_table(job_key)
+            return bool(table and any(r["step"] > 0
+                                      for r in table["replicas"]))
+
+        while time.time() < deadline and not pod_running_and_stepping():
+            time.sleep(0.05)
+        if not pod_running_and_stepping():
+            print("job never started stepping", file=sys.stderr)
+            return 1
+
+        print(f"preempting pod {victim} (exit 137) ...")
+        sim.preempt_pod("default", victim, exit_code=137)
+
+        def amended_bundle():
+            # Amended = the first post-recovery step record extended the
+            # bundle past the Running transition (workload tail attributed).
+            bundles = INCIDENTS.bundles(job_key) or []
+            for b in reversed(bundles):
+                if (b["running_at"] is not None
+                        and b["ended"] > b["running_at"]):
+                    return b
+            return None
+
+        while time.time() < deadline and amended_bundle() is None:
+            time.sleep(0.05)
+        bundle = amended_bundle()
+        if bundle is None:
+            print(f"no amended incident bundle within {args.timeout}s; "
+                  f"have: {INCIDENTS.bundles(job_key)}", file=sys.stderr)
+            return 1
+
+        total = bundle["downtime_ms"]
+        print(f"\nincident #{bundle['id']} ({bundle['reason']}, "
+              f"scope={bundle['scope']}) on {job_key}:")
+        print(f"{'phase':<12}{'ms':>10}{'share':>9}")
+        for phase in PHASES:
+            ms = bundle["phases"][phase]
+            share = (ms / total * 100.0) if total else 0.0
+            print(f"{phase:<12}{ms:>10.1f}{share:>8.1f}%")
+        print(f"{'total':<12}{total:>10.1f}")
+        goodput_ms = GOODPUT.downtime_seconds(job_key) * 1000.0
+        print(f"control window: {bundle['control_downtime_ms']:.1f} ms "
+              f"(goodput ledger: {goodput_ms:.1f} ms)")
+        recorded = [ev for ev in cs.events.list(None)
+                    if ev.reason == constants.INCIDENT_RECORDED_REASON]
+        for ev in recorded:
+            print(f"event {ev.reason}: {ev.message}")
+
+        unknown = bundle["phases"]["unknown"]
+        if total > 0 and unknown > 0.05 * total:
+            print(f"unattributed residue {unknown:.1f} ms exceeds 5% of "
+                  f"{total:.1f} ms", file=sys.stderr)
+            return 1
+        if not recorded:
+            print("IncidentRecorded event never fired", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        tc.stop()
+        sim.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

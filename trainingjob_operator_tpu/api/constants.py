@@ -143,6 +143,16 @@ FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
 # Seconds without a produced batch before the prefetching loader declares the
 # producer dead (data/loader.py watchdog).
 PREFETCH_STALL_ENV = "TRAININGJOB_PREFETCH_STALL_S"
+# Incident flight recorder (obs/incident.py): per-job timeline ring length
+# (events and step records each) and how many assembled incident bundles are
+# retained per job.  Both bound memory -- a crash-looping job keeps its last
+# K incidents, never an unbounded history.
+INCIDENT_RING_ENV = "TRAININGJOB_INCIDENT_RING"
+INCIDENT_BUNDLES_ENV = "TRAININGJOB_INCIDENT_BUNDLES"
+# Workload-side HBM sampler (workloads/train.py StepProfiler): sample device
+# memory every N steps and ride it on the telemetry record as ``hbm_bytes``
+# (OOM-shaped incidents then carry a memory timeline).  "0" disables.
+HBM_SAMPLE_STEPS_ENV = "TRAININGJOB_HBM_SAMPLE_STEPS"
 
 #: Env vars that are part of the contract but *user-set* (pod template or
 #: operator environment), never injected by the controller: workload tuning
@@ -170,6 +180,9 @@ USER_ENV_KNOBS = frozenset((
     PREFETCH_STALL_ENV,
     FLEET_SEED_ENV,
     FLEET_JOBS_ENV,
+    INCIDENT_RING_ENV,
+    INCIDENT_BUNDLES_ENV,
+    HBM_SAMPLE_STEPS_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --
@@ -225,6 +238,12 @@ SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
 STEP_STALLED_REASON = "StepStalled"
 STEP_RESUMED_REASON = "StepResumed"
 
+# Incident flight recorder (obs/incident.py): an incident bundle was
+# assembled for the job -- the event message names the bundle id and its
+# phase-attributed downtime so `kubectl get events` points straight at
+# /debug/incidents.
+INCIDENT_RECORDED_REASON = "IncidentRecorded"
+
 # Action-trail reasons (previously inline literals at call sites).
 VALIDATION_FAILED_REASON = "ValidationFailed"
 SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
@@ -249,6 +268,7 @@ EVENT_REASONS = frozenset((
     SCALING_REASON,
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
+    INCIDENT_RECORDED_REASON,
     VALIDATION_FAILED_REASON,
     SUCCESSFUL_CREATE_POD_REASON,
     SUCCESSFUL_DELETE_POD_REASON,

@@ -115,6 +115,7 @@ def main(argv: Optional[list] = None) -> int:
 
     metrics_server = None
     if args.metrics_port:
+        from trainingjob_operator_tpu.obs.incident import INCIDENTS
         from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
         from trainingjob_operator_tpu.obs.trace import TRACER
         from trainingjob_operator_tpu.utils.metrics import serve_metrics
@@ -122,7 +123,8 @@ def main(argv: Optional[list] = None) -> int:
         metrics_server = serve_metrics(
             args.metrics_port, tracer=TRACER,
             events_fn=lambda: clientset.events.list(None),
-            ready_fn=controller.ready, telemetry=TELEMETRY)
+            ready_fn=controller.ready, telemetry=TELEMETRY,
+            incidents=INCIDENTS)
         print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():

@@ -46,8 +46,9 @@ from trainingjob_operator_tpu.controller.service import ServiceReconciler
 from trainingjob_operator_tpu.controller.status import StatusManager, update_job_conditions
 from trainingjob_operator_tpu.core.objects import Node, OwnerReference, Pod, Service
 from trainingjob_operator_tpu.obs.goodput import GOODPUT
+from trainingjob_operator_tpu.obs.incident import INCIDENTS
 from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, peak_flops_for_accelerator
-from trainingjob_operator_tpu.obs.trace import TRACER
+from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.controller")
@@ -231,6 +232,12 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # Telemetry watchdog findings (StepStalled/StepResumed) become job
         # events and a reconcile kick so the Running message refreshes.
         TELEMETRY.set_event_sink(self._telemetry_event)
+        # Incident flight recorder: every recorded job event feeds its
+        # timeline ring (the create/delete/restart markers attribution
+        # needs), and assembled bundles announce themselves back through the
+        # same event plumbing as IncidentRecorded.
+        self.recorder.set_sink(self._incident_event_tap)
+        INCIDENTS.set_event_sink(self._telemetry_event)
         for i in range(n):
             th = threading.Thread(target=self._worker, daemon=True,
                                   name=f"trainingjob-worker-{i}")
@@ -259,6 +266,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.metrics.remove_gauge("trainingjob_workqueue_depth_high_water")
         self.metrics.remove_gauge("trainingjob_jobs")
         TELEMETRY.set_event_sink(None)
+        INCIDENTS.set_event_sink(None)
+        self.recorder.set_sink(None)
         self._ready.clear()
         self._stop.set()
         if self._gc is not None:
@@ -266,6 +275,18 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.work_queue.shut_down()
         for th in self._workers:
             th.join(timeout=2)
+
+    def _incident_event_tap(self, obj: Any, reason: str,
+                            message: str) -> None:
+        """EventRecorder sink: mirror every job-scoped event into the
+        incident flight recorder's timeline ring.  Pod create/delete events
+        are recorded against the owning job (controller/control.py), so one
+        KIND filter captures every marker attribution needs.  Reasons the
+        recorder itself raised (IncidentRecorded) land in the ring too but
+        trigger nothing -- no feedback loop."""
+        if getattr(obj, "KIND", None) != constants.KIND:
+            return
+        INCIDENTS.record_event(meta_namespace_key(obj), reason, message)
 
     def _telemetry_event(self, key: str, reason: str, message: str) -> None:
         """Telemetry watchdog callback (runs on sink/runtime threads): record
@@ -355,6 +376,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                     self.expectations.delete_expectations(key)
                     GOODPUT.forget(key)
                     TELEMETRY.forget(key)
+                    INCIDENTS.forget(key)
                     root.set_attribute("outcome", "gone")
                     return True
 
@@ -458,8 +480,15 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         job, TrainingJobPhase.TERMINATING,
                         constants.TERMINATING_REASON, msg)
                     job.status.restart_replica_name = rtype
-                    GOODPUT.on_interruption(
-                        job_key, job.spec.replica_specs[rtype].restart_scope)
+                    # One shared clock for both ledgers: the incident
+                    # bundle's control window must reconcile byte-for-byte
+                    # against the goodput downtime window.
+                    now = time.time()
+                    scope = job.spec.replica_specs[rtype].restart_scope
+                    GOODPUT.on_interruption(job_key, scope, now=now)
+                    INCIDENTS.on_interruption(
+                        job_key, scope, constants.RESTARTING_REASON,
+                        now=now, trace=current_context())
                     TELEMETRY.on_interruption(job_key)
                     break
                 if ending_phase == TrainingJobPhase.SCALING:
@@ -468,7 +497,11 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                         job, TrainingJobPhase.SCALING,
                         constants.SCALING_REASON, msg)
                     job.status.scaling_replica_name = rtype
-                    GOODPUT.on_interruption(job_key, "scale")
+                    now = time.time()
+                    GOODPUT.on_interruption(job_key, "scale", now=now)
+                    INCIDENTS.on_interruption(
+                        job_key, "scale", constants.SCALING_REASON,
+                        now=now, trace=current_context())
                     TELEMETRY.on_interruption(job_key)
                     break
                 if ending_phase:

@@ -41,6 +41,7 @@ from trainingjob_operator_tpu.core.objects import (
     Service,
 )
 from trainingjob_operator_tpu.obs.goodput import GOODPUT
+from trainingjob_operator_tpu.obs.incident import INCIDENTS
 from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
@@ -280,6 +281,8 @@ class StatusManager:
                                           f"{msg}; deleted pods")
                     GOODPUT.on_complete(meta_namespace_key(job), now)
                     TELEMETRY.on_complete(meta_namespace_key(job))
+                    INCIDENTS.on_complete(meta_namespace_key(job), phase,
+                                          now=now)
                 else:
                     # Drain progress arrives as pod DELETED events that
                     # re-enqueue this job; the delayed poll is only a safety
@@ -322,6 +325,10 @@ class StatusManager:
                                   self._running_message(job, now))
             GOODPUT.on_running(meta_namespace_key(job), now,
                                start_time=job.status.start_time)
+            # Same ``now`` closes both ledgers' windows: the incident
+            # bundle's control_downtime_ms matches the goodput window
+            # exactly (tests/test_incident.py reconciles them).
+            INCIDENTS.on_running(meta_namespace_key(job), now=now)
         elif is_running and job.status.phase == TrainingJobPhase.RUNNING:
             # Live throughput snapshot in the Running condition: same
             # type/status/reason means set_condition refreshes the message in
@@ -387,6 +394,8 @@ class StatusManager:
                 job.status.end_time = time.time()
             GOODPUT.on_complete(meta_namespace_key(job), job.status.end_time)
             TELEMETRY.on_complete(meta_namespace_key(job))
+            INCIDENTS.on_complete(meta_namespace_key(job), ending_phase,
+                                  now=job.status.end_time)
             return
         job.metadata.annotations[ending_phase] = message
         # The stash is METADATA: on a real apiserver the status-subresource

@@ -38,7 +38,7 @@ class _JobState:
     __slots__ = ("first_seen", "running_since", "productive",
                  "downtime_since", "downtime_scope", "first_running",
                  "completed", "step_productive", "steps_seen",
-                 "ckpt_stall", "ckpt_stalls_seen")
+                 "ckpt_stall", "ckpt_stalls_seen", "downtime_total")
 
     def __init__(self) -> None:
         self.first_seen: Optional[float] = None
@@ -60,6 +60,10 @@ class _JobState:
         # is attributable per job.
         self.ckpt_stall = 0.0
         self.ckpt_stalls_seen = 0
+        # Closed downtime-window sum: the ledger the incident recorder's
+        # per-phase attribution must reconcile against (tested in
+        # tests/test_incident.py).
+        self.downtime_total = 0.0
 
 
 class GoodputTracker:
@@ -89,11 +93,13 @@ class GoodputTracker:
             if st.first_seen is None:
                 st.first_seen = start_time if start_time is not None else now
             if st.downtime_since is not None:
+                window = max(now - st.downtime_since, 0.0)
                 self._metrics.observe(
                     "trainingjob_restart_downtime_seconds",
-                    max(now - st.downtime_since, 0.0),
+                    window,
                     buckets=DOWNTIME_BUCKETS,
                     scope=st.downtime_scope or "unknown")
+                st.downtime_total += window
                 st.downtime_since = None
                 st.downtime_scope = ""
             if not st.first_running:
@@ -167,6 +173,15 @@ class GoodputTracker:
         with self._lock:
             st = self._jobs.get(key)
             return st.ckpt_stall if st is not None else 0.0
+
+    def downtime_seconds(self, key: str) -> float:
+        """Sum of CLOSED downtime windows (0.0 when none).  The incident
+        recorder's control windows share the same open/close timestamps
+        (controller passes one ``now`` to both), so a bundle's
+        ``control_downtime_ms`` reconciles against this exactly."""
+        with self._lock:
+            st = self._jobs.get(key)
+            return st.downtime_total if st is not None else 0.0
 
     @staticmethod
     def _productive_locked(st: _JobState) -> float:

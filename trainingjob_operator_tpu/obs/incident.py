@@ -1,0 +1,647 @@
+"""Incident flight recorder: phase-attributed downtime timelines per job.
+
+A preempted or stalled production job leaves its evidence scattered across
+planes that never meet: controller events (``EVENT_REASONS``), restart-scope
+transitions, telemetry step records, and the workload's
+``resume.restore``/``resume.compile`` spans.  The recovery bench
+(docs/RECOVERY.md) itemizes downtime offline; nothing reassembles it for a
+LIVE job.  This module is that reassembly: a bounded per-job **flight
+recorder** that taps every plane into one normalized timeline ring, and on
+abnormal transitions assembles an **incident bundle** attributing every ms
+of downtime to a named phase::
+
+    detect -> teardown -> reschedule -> rendezvous -> restore -> compile
+           -> first_step       (+ ``unknown`` for evicted-ring residue)
+
+Lifecycle mirrors the GOODPUT/TELEMETRY singletons: the controller calls
+``on_interruption``/``on_running``/``on_complete``/``forget`` from the same
+chokepoints, the ``EventRecorder`` sink feeds ``record_event``, telemetry
+ingest feeds ``record_step``/``record_resume``.  An incident opens at the
+interruption (or at abnormal evidence: StepStalled, a terminal Failed /
+Preempted / NodeFail / Timeout), closes provisionally when the job is
+Running again (the control window -- byte-for-byte the goodput ledger's
+downtime window, both hooks receive the same ``now``), and is amended once
+by the first post-recovery step record so the workload tail (restore /
+compile / first step) is attributed too.
+
+Determinism: bundle assembly is a pure function of the frozen ring snapshot
+(``reassemble`` re-runs it; two assemblies of the same ring are
+byte-identical), serialized with sorted keys and no wall-clock reads.
+
+Exported via ``/debug/incidents`` (utils/metrics.py), the metrics
+``trainingjob_downtime_ms{job,phase}`` / ``trainingjob_incidents_total{reason}``
+/ ``trainingjob_incident_bundle_bytes{job}``, and aggregated per churn fate
+into the fleet report (fleet/harness.py).  Everything is bounded: the
+timeline rings by ``TRAININGJOB_INCIDENT_RING`` entries per plane, retained
+bundles by ``TRAININGJOB_INCIDENT_BUNDLES`` per job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+#: Attribution order.  Control-plane phases (detect/teardown/reschedule/
+#: rendezvous) partition the interruption -> Running window exactly; the
+#: workload phases (restore/compile/first_step) cover the tail up to the
+#: first post-recovery step; ``unknown`` absorbs windows whose markers were
+#: evicted from the ring.
+PHASES = ("detect", "teardown", "reschedule", "rendezvous", "restore",
+          "compile", "first_step", "unknown")
+
+#: Terminal phases that are incidents in their own right (spellings match
+#: api/types.py TrainingJobPhase; this module stays import-light like
+#: obs/goodput.py and cannot pull types.py in).
+ABNORMAL_ENDINGS = frozenset(("Failed", "Preempted", "NodeFail", "Timeout"))
+
+#: Event reasons that mark the controller ACTING on an abnormality -- the
+#: first one inside a window ends the ``detect`` phase.
+_CORRECTIVE_REASONS = frozenset((
+    constants.RESTARTING_REASON,
+    constants.SCALING_REASON,
+    constants.TERMINATING_REASON,
+    constants.SUCCESSFUL_DELETE_POD_REASON,
+))
+
+#: Event reasons that are abnormal evidence on their own -- the earliest one
+#: anchors a terminal incident that never went through on_interruption.
+_EVIDENCE_REASONS = frozenset((
+    constants.EXITED_WITH_CODE_REASON,
+    constants.PREEMPTED_REASON,
+    constants.FAILED_REASON,
+    constants.NODE_FAIL_REASON,
+    constants.TIMEOUT_REASON,
+    constants.TERMINATING_REASON,
+    constants.STEP_STALLED_REASON,
+))
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        value = int(os.environ.get(name, "") or default)
+    except ValueError:
+        value = default
+    return max(value, floor)
+
+
+def _clamp(x: float, lo: float, hi: float) -> float:
+    return min(max(x, lo), hi)
+
+
+class _OpenIncident:
+    __slots__ = ("id", "kind", "reason", "scope", "started", "running_at",
+                 "trace", "counted")
+
+    def __init__(self, inc_id: int, kind: str, reason: str, scope: str,
+                 started: float, trace: str) -> None:
+        self.id = inc_id
+        self.kind = kind              # "restart" | "stall" | "terminal"
+        self.reason = reason          # the triggering EVENT_REASONS member
+        self.scope = scope            # RestartScope value, "scale", or ""
+        self.started = started
+        self.running_at: Optional[float] = None
+        self.trace = trace            # sync_job "trace_id:span_id" context
+        self.counted = False          # trainingjob_incidents_total inc'd
+
+
+class _JobIncidents:
+    __slots__ = ("events", "steps", "resumes", "open", "bundles", "seq",
+                 "completed", "last_end", "gauges")
+
+    def __init__(self, ring: int, keep: int) -> None:
+        #: (ts, reason, message), newest last -- the control-plane ring.
+        self.events: Deque[Tuple[float, str, str]] = deque(maxlen=ring)
+        #: (ts, step, ms, ckpt_ms, hbm_bytes) -- the workload step ring.
+        #: Separate from ``events`` so a busy job's step flood cannot evict
+        #: the create/delete markers attribution depends on.
+        self.steps: Deque[Tuple[float, int, float, Optional[float],
+                                Optional[float]]] = deque(maxlen=ring)
+        #: (ts, restore_ms, compile_ms, overlapped) resume-span records.
+        self.resumes: Deque[Tuple[float, float, float, bool]] = deque(maxlen=8)
+        self.open: Optional[_OpenIncident] = None
+        #: Retained bundles, oldest first: {"bundle", "json", "inputs"}.
+        self.bundles: Deque[Dict[str, Any]] = deque(maxlen=keep)
+        self.seq = 0
+        self.completed = False
+        self.last_end = 0.0           # newest finalized incident's end ts
+        self.gauges: List[Tuple[str, Dict[str, str]]] = []
+
+
+def _attribute(kind: str, t0: float, t1c: float, t_end: float,
+               events: Tuple[Tuple[float, str, str], ...],
+               steps: Tuple[Tuple[float, int, float, Optional[float],
+                                  Optional[float]], ...],
+               resumes: Tuple[Tuple[float, float, float, bool], ...],
+               ) -> List[Tuple[str, float, float]]:
+    """Partition [t0, t_end] into phase segments from the ring markers.
+
+    Pure: no clocks, no state -- the determinism contract.  ``t1c`` is the
+    control-window end (the Running transition; == t_end while no workload
+    evidence has arrived).  Returns ordered (phase, start, end) segments
+    whose union is exactly [t0, t_end].
+    """
+    if kind == "stall":
+        # Stall that resolved without controller action: the whole window is
+        # detection latency -- nothing ever acted.
+        return [("detect", t0, t_end)]
+    if kind == "terminal":
+        corrective = [ts for ts, reason, _ in events
+                      if reason in _CORRECTIVE_REASONS and t0 <= ts <= t_end]
+        b = _clamp(min(corrective), t0, t_end) if corrective else t_end
+        return [("detect", t0, b), ("teardown", b, t_end)]
+
+    window = [(ts, reason) for ts, reason, _ in events if t0 <= ts <= t_end]
+    if not window:
+        # Ring evicted (or events never tapped): refuse to invent phases.
+        return [("unknown", t0, t_end)]
+    corrective = [ts for ts, reason in window
+                  if reason in _CORRECTIVE_REASONS]
+    b_detect = _clamp(min(corrective), t0, t1c) if corrective else t0
+    deletes = [ts for ts, reason in window
+               if reason == constants.SUCCESSFUL_DELETE_POD_REASON]
+    b_teardown = _clamp(max(deletes), b_detect, t1c) if deletes else b_detect
+    creates = [ts for ts, reason in window
+               if reason == constants.SUCCESSFUL_CREATE_POD_REASON]
+    b_resched = _clamp(max(creates), b_teardown, t1c) if creates else b_teardown
+
+    resume = [r for r in resumes if b_resched <= r[0] <= t_end]
+    first_steps = [s for s in steps if t1c < s[0] <= t_end]
+    if resume:
+        # The workload reported its resume spans: anchor rendezvous end at
+        # (resume completion - resume duration).  Overlapped restore+compile
+        # charges ``compile`` only the non-hidden tail, matching the
+        # ~max(restore, compile) wall cost docs/RECOVERY.md measures.
+        ts_r, restore_ms, compile_ms, overlapped = resume[-1]
+        extra_ms = (max(compile_ms - restore_ms, 0.0) if overlapped
+                    else compile_ms)
+        b_rdv = _clamp(ts_r - (restore_ms + extra_ms) / 1e3, b_resched, t_end)
+        b_restore = _clamp(b_rdv + restore_ms / 1e3, b_rdv, t_end)
+        b_compile = _clamp(b_restore + extra_ms / 1e3, b_restore, t_end)
+    elif first_steps:
+        # No resume evidence, but a first step: its own duration is the
+        # first_step phase; everything between Running and it is rendezvous.
+        ts_s, _step, ms_s = first_steps[0][:3]
+        b_rdv = b_restore = b_compile = _clamp(t_end - ms_s / 1e3,
+                                               b_resched, t_end)
+    else:
+        # Control-only window (provisional bundle, or a job that never
+        # reports telemetry): rendezvous runs to the Running transition.
+        b_rdv = b_restore = b_compile = _clamp(t1c, b_resched, t_end)
+    return [("detect", t0, b_detect),
+            ("teardown", b_detect, b_teardown),
+            ("reschedule", b_teardown, b_resched),
+            ("rendezvous", b_resched, b_rdv),
+            ("restore", b_rdv, b_restore),
+            ("compile", b_restore, b_compile),
+            ("first_step", b_compile, t_end)]
+
+
+def _assemble(inc: Dict[str, Any],
+              events: Tuple[Tuple[float, str, str], ...],
+              steps: Tuple[Tuple[float, int, float, Optional[float],
+                                 Optional[float]], ...],
+              resumes: Tuple[Tuple[float, float, float, bool], ...],
+              ) -> Dict[str, Any]:
+    """Ring snapshot -> incident bundle.  Pure and deterministic: the same
+    inputs serialize to the same bytes (``reassemble`` asserts this in
+    tests); no wall-clock reads, sorted keys at serialization."""
+    t0 = inc["started"]
+    t_end = inc["ended"]
+    t1c = inc["running_at"] if inc["running_at"] is not None else t_end
+    segments = _attribute(inc["kind"], t0, t1c, t_end, events, steps, resumes)
+    phases = {p: 0.0 for p in PHASES}
+    for phase, a, b in segments:
+        phases[phase] += max(b - a, 0.0) * 1e3
+    timeline: List[Dict[str, Any]] = []
+    for ts, reason, message in events:
+        timeline.append({"ts": round(ts, 6), "kind": "event",
+                         "reason": reason, "message": message})
+    for ts, step, ms, ckpt_ms, hbm_bytes in steps:
+        entry: Dict[str, Any] = {"ts": round(ts, 6), "kind": "step",
+                                 "step": step, "ms": round(ms, 3)}
+        if ckpt_ms is not None:
+            entry["ckpt_ms"] = round(ckpt_ms, 3)
+        if hbm_bytes is not None:
+            entry["hbm_bytes"] = hbm_bytes
+        timeline.append(entry)
+    for ts, restore_ms, compile_ms, overlapped in resumes:
+        timeline.append({"ts": round(ts, 6), "kind": "resume",
+                         "restore_ms": round(restore_ms, 3),
+                         "compile_ms": round(compile_ms, 3),
+                         "overlapped": overlapped})
+    timeline.sort(key=lambda e: (e["ts"], e["kind"],
+                                 json.dumps(e, sort_keys=True)))
+    return {
+        "id": inc["id"],
+        "job": inc["job"],
+        "kind": inc["kind"],
+        "reason": inc["reason"],
+        "scope": inc["scope"],
+        "trace": inc["trace"],
+        "started": round(t0, 6),
+        "running_at": (round(inc["running_at"], 6)
+                       if inc["running_at"] is not None else None),
+        "ended": round(t_end, 6),
+        "downtime_ms": round(max(t_end - t0, 0.0) * 1e3, 3),
+        "control_downtime_ms": (round(max(t1c - t0, 0.0) * 1e3, 3)
+                                if inc["running_at"] is not None else None),
+        "phases": {p: round(v, 3) for p, v in phases.items()},
+        "segments": [{"phase": p, "start": round(a, 6), "end": round(b, 6)}
+                     for p, a, b in segments if b > a],
+        "timeline": timeline,
+    }
+
+
+def _canonical(bundle: Dict[str, Any]) -> str:
+    return json.dumps(bundle, sort_keys=True, separators=(",", ":"))
+
+
+def bundle_to_chrome(bundle: Dict[str, Any]) -> str:
+    """Chrome ``trace_event`` rendering of one bundle (same format as
+    obs/trace.py export_chrome, Perfetto-loadable): one complete event per
+    phase segment on a ``phases`` track, one instant event per timeline
+    entry on a ``timeline`` track.  Pure function of the bundle."""
+    events: List[Dict[str, Any]] = []
+    for seg in bundle["segments"]:
+        events.append({
+            "ph": "X",
+            "name": seg["phase"],
+            "cat": "incident",
+            "ts": seg["start"] * 1e6,
+            "dur": max(seg["end"] - seg["start"], 0.0) * 1e6,
+            "pid": bundle["job"],
+            "tid": "phases",
+            "args": {"incident": bundle["id"], "reason": bundle["reason"],
+                     "scope": bundle["scope"]},
+        })
+    for entry in bundle["timeline"]:
+        name = (entry.get("reason") if entry["kind"] == "event"
+                else f"{entry['kind']} {entry.get('step', '')}".strip())
+        events.append({
+            "ph": "i",
+            "s": "t",
+            "name": name,
+            "cat": f"incident.{entry['kind']}",
+            "ts": entry["ts"] * 1e6,
+            "pid": bundle["job"],
+            "tid": "timeline",
+            "args": {k: v for k, v in entry.items() if k not in ("ts",)},
+        })
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"},
+                      sort_keys=True, indent=2)
+
+
+class IncidentRecorder:
+    """Thread-safe per-job flight recorder + incident bundle assembly.
+
+    All hooks are cheap and bounded; the controller calls them from the
+    reconcile path (the same chokepoints that feed GOODPUT/TELEMETRY), so
+    nothing here may block or grow without bound.
+    """
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 ring: Optional[int] = None, keep: Optional[int] = None):
+        self._metrics = metrics or METRICS
+        self.ring = ring if ring is not None else _env_int(
+            constants.INCIDENT_RING_ENV, 256)
+        self.keep = keep if keep is not None else _env_int(
+            constants.INCIDENT_BUNDLES_ENV, 8)
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, _JobIncidents] = {}
+        self._event_sink: Optional[Callable[[str, str, str], None]] = None
+
+    def set_event_sink(self,
+                       sink: Optional[Callable[[str, str, str], None]]) -> None:
+        """``sink(job_key, reason, message)`` -- the controller points this
+        at its event plumbing so assembled bundles announce themselves as
+        ``IncidentRecorded`` job events."""
+        with self._lock:
+            self._event_sink = sink
+
+    def _state_locked(self, job: str) -> _JobIncidents:
+        st = self._jobs.get(job)
+        if st is None:
+            st = self._jobs[job] = _JobIncidents(self.ring, self.keep)
+        return st
+
+    # -- ring taps ------------------------------------------------------------
+
+    def record_event(self, job: str, reason: str, message: str,
+                     ts: Optional[float] = None) -> None:
+        """Every controller event lands here (EventRecorder sink).  Besides
+        feeding the ring, StepStalled opens a stall incident and StepResumed
+        closes one that no restart adopted."""
+        ts = time.time() if ts is None else ts
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._state_locked(job)
+            st.events.append((ts, reason, message))
+            if st.completed:
+                return
+            if (reason == constants.STEP_STALLED_REASON and st.open is None):
+                st.seq += 1
+                st.open = _OpenIncident(st.seq, "stall", reason, "", ts, "")
+            elif (reason == constants.STEP_RESUMED_REASON
+                  and st.open is not None and st.open.kind == "stall"):
+                st.open.running_at = ts
+                emit = self._finalize_locked(job, st, ended=ts, close=True)
+        self._emit(emit)
+
+    def record_step(self, job: str, step: int, ms: float,
+                    ckpt_ms: Optional[float] = None,
+                    hbm_bytes: Optional[float] = None,
+                    now: Optional[float] = None) -> None:
+        """One telemetry step record (fed by TelemetryAggregator.ingest).
+        The first step after a recovery amends the provisional bundle with
+        the workload tail (rendezvous/restore/compile/first_step split)."""
+        now = time.time() if now is None else now
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.completed:
+                return
+            st.steps.append((now, int(step), float(ms), ckpt_ms, hbm_bytes))
+            inc = st.open
+            if (inc is not None and inc.running_at is not None
+                    and now > inc.running_at):
+                emit = self._finalize_locked(job, st, ended=now, close=True)
+        self._emit(emit)
+
+    def record_resume(self, job: str, restore_ms: float, compile_ms: float,
+                      overlapped: bool, now: Optional[float] = None) -> None:
+        """The workload finished ``overlapped_restore`` (resume.restore /
+        resume.compile spans, pushed as a telemetry resume record)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.completed:
+                return
+            st.resumes.append((now, float(restore_ms), float(compile_ms),
+                               bool(overlapped)))
+
+    # -- lifecycle hooks (controller/status machine) --------------------------
+
+    def on_interruption(self, job: str, scope: str, reason: str,
+                        now: Optional[float] = None,
+                        trace: str = "") -> None:
+        """A restart/resize drain started (same call site and ``now`` as
+        ``GOODPUT.on_interruption``, so the control window matches the
+        goodput ledger exactly).  Adopts an open stall incident -- the stall
+        detected what the restart is now correcting -- and rolls over an
+        incident still waiting on its first post-recovery step."""
+        now = time.time() if now is None else now
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._state_locked(job)
+            if st.completed:
+                return
+            inc = st.open
+            if inc is not None and inc.kind == "stall":
+                inc.kind = "restart"
+                inc.scope = scope
+                inc.trace = inc.trace or trace
+                return
+            if inc is not None and inc.running_at is None:
+                return  # already inside a window; idempotent re-entry
+            if inc is not None:
+                # Recovering but the first step never came: close as-is.
+                emit = self._finalize_locked(job, st, ended=inc.running_at,
+                                             close=True)
+            st.seq += 1
+            st.open = _OpenIncident(st.seq, "restart", reason, scope, now,
+                                    trace)
+        self._emit(emit)
+
+    def on_running(self, job: str, now: Optional[float] = None) -> None:
+        """Back to Running: the control window closes (== the goodput
+        downtime window) and a provisional bundle is assembled immediately;
+        the next step record amends it with the workload tail."""
+        now = time.time() if now is None else now
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.completed:
+                return
+            inc = st.open
+            if inc is None or inc.running_at is not None:
+                return
+            if inc.kind == "stall":
+                # A Running refresh is not the stall's resolution signal;
+                # StepResumed or a restart adoption will close it.
+                return
+            inc.running_at = now
+            emit = self._finalize_locked(job, st, ended=now, close=False)
+        self._emit(emit)
+
+    def on_complete(self, job: str, phase: str,
+                    now: Optional[float] = None) -> None:
+        """Terminal phase.  An abnormal ending (Failed/Preempted/NodeFail/
+        Timeout) without an open window synthesizes a terminal incident
+        anchored at the earliest abnormal evidence in the ring."""
+        now = time.time() if now is None else now
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.completed:
+                return
+            st.completed = True
+            if st.open is not None:
+                inc = st.open
+                ended = inc.running_at if inc.running_at is not None else now
+                if str(phase) in ABNORMAL_ENDINGS and inc.running_at is None:
+                    inc.kind = "terminal"
+                    inc.reason = f"TrainingJob{phase}"
+                emit = self._finalize_locked(job, st, ended=ended, close=True)
+            elif str(phase) in ABNORMAL_ENDINGS:
+                evidence = [ts for ts, reason, _ in st.events
+                            if reason in _EVIDENCE_REASONS
+                            and st.last_end < ts <= now]
+                started = min(evidence) if evidence else now
+                st.seq += 1
+                st.open = _OpenIncident(st.seq, "terminal",
+                                        f"TrainingJob{phase}", "", started, "")
+                emit = self._finalize_locked(job, st, ended=now, close=True)
+        self._emit(emit)
+
+    def forget(self, job: str) -> None:
+        """Job object gone: drop state and every gauge registered for it."""
+        with self._lock:
+            st = self._jobs.pop(job, None)
+            if st is None:
+                return
+            for name, labels in st.gauges:
+                self._metrics.remove_gauge(name, **labels)
+
+    # -- assembly -------------------------------------------------------------
+
+    def _finalize_locked(self, job: str, st: _JobIncidents,
+                         ended: float, close: bool,
+                         ) -> List[Tuple[str, str, str]]:
+        """Assemble (or amend) the open incident's bundle from a frozen ring
+        snapshot.  Returns the events to emit AFTER the lock is released."""
+        inc = st.open
+        if inc is None:
+            return []
+        t0 = inc.started
+        inc_dict = {
+            "id": inc.id, "job": job, "kind": inc.kind, "reason": inc.reason,
+            "scope": inc.scope, "trace": inc.trace, "started": t0,
+            "running_at": inc.running_at, "ended": ended,
+        }
+        # Freeze only the window-relevant slice: bundles stay O(window), and
+        # reassembly from stored inputs is exact.
+        events = tuple(e for e in st.events if t0 <= e[0] <= ended)
+        steps = tuple(s for s in st.steps if t0 <= s[0] <= ended)
+        resumes = tuple(r for r in st.resumes if t0 <= r[0] <= ended)
+        inputs = (inc_dict, events, steps, resumes)
+        bundle = _assemble(*inputs)
+        encoded = _canonical(bundle)
+        if st.bundles and st.bundles[-1]["bundle"]["id"] == inc.id:
+            st.bundles[-1] = {"bundle": bundle, "json": encoded,
+                              "inputs": inputs}
+        else:
+            st.bundles.append({"bundle": bundle, "json": encoded,
+                               "inputs": inputs})
+        emit: List[Tuple[str, str, str]] = []
+        if not inc.counted:
+            inc.counted = True
+            self._metrics.inc("trainingjob_incidents_total",
+                              reason=inc.reason)
+            if not st.gauges:
+                self._register_gauges_locked(job, st)
+            top = max(bundle["phases"].items(), key=lambda kv: kv[1])
+            emit.append((job, constants.INCIDENT_RECORDED_REASON,
+                         f"incident #{inc.id} ({inc.reason}): "
+                         f"{bundle['downtime_ms']:.0f} ms downtime, "
+                         f"largest phase {top[0]}={top[1]:.0f} ms -- "
+                         f"/debug/incidents?job={job}"))
+        if close:
+            st.last_end = ended
+            st.open = None
+        return emit
+
+    def _register_gauges_locked(self, job: str, st: _JobIncidents) -> None:
+        for phase in PHASES:
+            self._metrics.gauge(
+                "trainingjob_downtime_ms",
+                lambda j=job, p=phase: self._phase_total(j, p),
+                job=job, phase=phase)
+            st.gauges.append(("trainingjob_downtime_ms",
+                              {"job": job, "phase": phase}))
+        self._metrics.gauge("trainingjob_incident_bundle_bytes",
+                            lambda j=job: float(self.retained_bytes(j)),
+                            job=job)
+        st.gauges.append(("trainingjob_incident_bundle_bytes", {"job": job}))
+
+    def _phase_total(self, job: str, phase: str) -> float:
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return 0.0
+            return sum(b["bundle"]["phases"].get(phase, 0.0)
+                       for b in st.bundles)
+
+    def _emit(self, events: List[Tuple[str, str, str]]) -> None:
+        if not events:
+            return
+        with self._lock:
+            sink = self._event_sink
+        if sink is None:
+            return
+        for job, reason, message in events:
+            try:
+                sink(job, reason, message)
+            # analyzer: allow[broad-except]: the sink is controller code
+            # (event recorder + enqueue); the recorder must survive it.
+            except Exception:
+                pass
+
+    # -- queries --------------------------------------------------------------
+
+    def jobs(self) -> List[Dict[str, Any]]:
+        """Per-job summary behind ``/debug/incidents`` without ``?job=``."""
+        with self._lock:
+            return [{"job": job,
+                     "incidents": len(st.bundles),
+                     "open": st.open is not None,
+                     "bytes": sum(len(b["json"]) for b in st.bundles)}
+                    for job, st in sorted(self._jobs.items())]
+
+    def bundles(self, job: str) -> Optional[List[Dict[str, Any]]]:
+        """Retained bundles, oldest first; None when the job is unknown
+        (the endpoint 404s)."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return None
+            return [b["bundle"] for b in st.bundles]
+
+    def bundle_json(self, job: str,
+                    incident_id: Optional[int] = None) -> Optional[str]:
+        """Canonical serialized bundle (newest, or by id)."""
+        with self._lock:
+            entry = self._entry_locked(job, incident_id)
+            return entry["json"] if entry is not None else None
+
+    def reassemble(self, job: str,
+                   incident_id: Optional[int] = None) -> Optional[str]:
+        """Re-run assembly from the stored ring snapshot.  Byte-identical to
+        ``bundle_json`` -- the determinism contract the tests pin."""
+        with self._lock:
+            entry = self._entry_locked(job, incident_id)
+            if entry is None:
+                return None
+            inputs = entry["inputs"]
+        return _canonical(_assemble(*inputs))
+
+    def export_chrome(self, job: str,
+                      incident_id: Optional[int] = None) -> Optional[str]:
+        with self._lock:
+            entry = self._entry_locked(job, incident_id)
+            if entry is None:
+                return None
+            bundle = entry["bundle"]
+        return bundle_to_chrome(bundle)
+
+    def _entry_locked(self, job: str,
+                      incident_id: Optional[int]) -> Optional[Dict[str, Any]]:
+        st = self._jobs.get(job)
+        if st is None or not st.bundles:
+            return None
+        if incident_id is None:
+            return st.bundles[-1]
+        for entry in st.bundles:
+            if entry["bundle"]["id"] == incident_id:
+                return entry
+        return None
+
+    def retained_bytes(self, job: str) -> int:
+        """Total serialized bytes of the job's retained bundles (the
+        ``trainingjob_incident_bundle_bytes`` gauge)."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return 0
+            return sum(len(b["json"]) for b in st.bundles)
+
+    def open_incident(self, job: str) -> Optional[Dict[str, Any]]:
+        """The in-flight incident, for tests/debugging."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.open is None:
+                return None
+            inc = st.open
+            return {"id": inc.id, "kind": inc.kind, "reason": inc.reason,
+                    "scope": inc.scope, "started": inc.started,
+                    "running_at": inc.running_at}
+
+
+#: Process-global recorder, mirroring METRICS/TRACER/GOODPUT/TELEMETRY.
+INCIDENTS = IncidentRecorder()

@@ -49,6 +49,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.obs.goodput import GOODPUT, GoodputTracker
+from trainingjob_operator_tpu.obs.incident import INCIDENTS, IncidentRecorder
 from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
 
 #: Step-time histogram bucket upper bounds (milliseconds): sim steps run
@@ -121,7 +122,8 @@ def sink_address() -> str:
 
 class _ReplicaState:
     __slots__ = ("rtype", "rank", "last_step", "last_advance", "steps_seen",
-                 "samples", "tokens_rate", "flops_rate", "loss", "stalled")
+                 "samples", "tokens_rate", "flops_rate", "loss", "stalled",
+                 "ckpt_ms", "hbm_bytes")
 
     def __init__(self, rtype: str, rank: int) -> None:
         self.rtype = rtype
@@ -135,6 +137,11 @@ class _ReplicaState:
         self.flops_rate = 0.0
         self.loss: Optional[float] = None
         self.stalled = False
+        #: Latest reported values; None until the replica ever reports one
+        #: (a job without checkpointing / the HBM sampler shows "-" in the
+        #: /debug/steps table, not a fake zero).
+        self.ckpt_ms: Optional[float] = None
+        self.hbm_bytes: Optional[float] = None
 
     def median_ms(self) -> float:
         return self.quantile_ms(0.5)
@@ -188,9 +195,14 @@ class TelemetryAggregator:
     def __init__(self, metrics: Optional[MetricsRegistry] = None,
                  goodput: Optional[GoodputTracker] = None,
                  stall_factor: float = 8.0, stall_floor: float = 2.0,
-                 window: int = 128):
+                 window: int = 128,
+                 incidents: Optional[IncidentRecorder] = None):
         self._metrics = metrics or METRICS
         self._goodput = goodput or GOODPUT
+        # Deliberately NOT defaulted to the INCIDENTS singleton: private
+        # test aggregators must not pollute the process-global flight
+        # recorder.  The TELEMETRY singleton below passes it explicitly.
+        self._incidents = incidents
         self.stall_factor = stall_factor
         self.stall_floor = stall_floor
         self.window = window
@@ -218,6 +230,25 @@ class TelemetryAggregator:
         counts ``trainingjob_telemetry_malformed_total``) on garbage -- the
         sink must survive any bytes a confused client writes at it."""
         now = time.time() if now is None else now
+        if isinstance(record, dict) and "resume_restore_ms" in record:
+            # Resume-span record (workloads/train.py overlapped_restore):
+            # no step/ms fields -- detect it BEFORE step validation.  Feeds
+            # the incident recorder's restore/compile attribution.
+            try:
+                job = str(record["job"])
+                restore_ms = float(record["resume_restore_ms"])
+                compile_ms = float(record.get("resume_compile_ms", 0.0))
+                overlapped = bool(record.get("resume_overlapped", False))
+            except (TypeError, KeyError, ValueError):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            if "/" not in job or restore_ms < 0.0 or compile_ms < 0.0:
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            if self._incidents is not None:
+                self._incidents.record_resume(job, restore_ms, compile_ms,
+                                              overlapped, now=now)
+            return True
         try:
             job = str(record["job"])
             rtype = str(record.get("rtype") or "worker").lower()
@@ -236,6 +267,7 @@ class TelemetryAggregator:
         peak = _as_float(record.get("peak_flops"))
         loss = _as_float(record.get("loss"))
         ckpt_ms = _as_float(record.get("ckpt_ms"))
+        hbm_bytes = _as_float(record.get("hbm_bytes"))
 
         resumed: List[Tuple[str, str, str]] = []
         with self._lock:
@@ -266,6 +298,10 @@ class TelemetryAggregator:
             rs.tokens_rate, rs.flops_rate = rs.window_rates()
             if loss is not None:
                 rs.loss = loss
+            if ckpt_ms is not None and ckpt_ms >= 0.0:
+                rs.ckpt_ms = ckpt_ms
+            if hbm_bytes is not None and hbm_bytes >= 0.0:
+                rs.hbm_bytes = hbm_bytes
             if peak and not jt.peak_flops:
                 jt.peak_flops = peak  # controller's spec.tpu value wins
             if (flops or jt.peak_flops) and not _has_gauge(
@@ -291,6 +327,11 @@ class TelemetryAggregator:
             if ckpt_ms is not None and ckpt_ms >= 0.0:
                 self._goodput.record_checkpoint_stall(job, ckpt_ms / 1000.0,
                                                       now=now)
+            if self._incidents is not None:
+                # Same pacer feeds the flight recorder's step ring; the
+                # first post-recovery step amends the provisional bundle.
+                self._incidents.record_step(job, step, ms, ckpt_ms=ckpt_ms,
+                                            hbm_bytes=hbm_bytes, now=now)
         self._emit(resumed)
         return True
 
@@ -484,6 +525,12 @@ class TelemetryAggregator:
                     "p90_ms": round(rs.quantile_ms(0.9), 2),
                     "tokens_per_sec": round(rs.tokens_rate, 1),
                     "loss": rs.loss,
+                    # None (not 0) when the replica never reported the
+                    # field -- jobs without checkpointing or the HBM
+                    # sampler must be distinguishable from ones at zero.
+                    "ckpt_ms": (round(rs.ckpt_ms, 2)
+                                if rs.ckpt_ms is not None else None),
+                    "hbm_bytes": rs.hbm_bytes,
                     "last_advance_age_s": round(max(now - rs.last_advance,
                                                     0.0), 2),
                     "stalled": rs.stalled,
@@ -510,8 +557,9 @@ class TelemetryAggregator:
         if table is None:
             return f"no telemetry for job {job}\n"
         cols = ("replica", "step", "median_ms", "p90_ms", "tokens_per_sec",
-                "last_advance_age_s", "stalled")
-        rows = [[str(r[c]) for c in cols] for r in table["replicas"]]
+                "ckpt_ms", "hbm_bytes", "last_advance_age_s", "stalled")
+        rows = [["-" if r[c] is None else str(r[c]) for c in cols]
+                for r in table["replicas"]]
         widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
                   for i, c in enumerate(cols)]
         lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
@@ -574,8 +622,9 @@ def _has_gauge(jt: _JobTelemetry, name: str, **labels: str) -> bool:
     return False
 
 
-#: Process-global aggregator, mirroring METRICS/TRACER/GOODPUT.
-TELEMETRY = TelemetryAggregator()
+#: Process-global aggregator, mirroring METRICS/TRACER/GOODPUT.  Only the
+#: singleton feeds the global incident flight recorder.
+TELEMETRY = TelemetryAggregator(incidents=INCIDENTS)
 
 
 # -- sink (controller side) ---------------------------------------------------
@@ -699,7 +748,8 @@ class TelemetryEmitter:
         return bool(self.addr and self.job)
 
     def emit(self, step: int, ms: float, loss: Optional[float] = None,
-             ckpt_ms: Optional[float] = None) -> None:
+             ckpt_ms: Optional[float] = None,
+             hbm_bytes: Optional[float] = None) -> None:
         if not self.enabled or time.monotonic() < self._down_until:
             return
         record: Dict[str, Any] = {
@@ -716,6 +766,25 @@ class TelemetryEmitter:
             record["loss"] = loss
         if ckpt_ms is not None:
             record["ckpt_ms"] = round(ckpt_ms, 3)
+        if hbm_bytes is not None:
+            record["hbm_bytes"] = hbm_bytes
+        self._send(record)
+
+    def emit_resume(self, restore_ms: float, compile_ms: float,
+                    overlapped: bool) -> None:
+        """One resume completed (train.overlapped_restore): push the span
+        durations so the controller's incident bundle can attribute the
+        restore/compile tail of the downtime it already measured."""
+        if not self.enabled or time.monotonic() < self._down_until:
+            return
+        self._send({
+            "v": 1, "job": self.job, "rtype": self.rtype, "rank": self.rank,
+            "resume_restore_ms": round(restore_ms, 3),
+            "resume_compile_ms": round(compile_ms, 3),
+            "resume_overlapped": overlapped, "ts": time.time(),
+        })
+
+    def _send(self, record: Dict[str, Any]) -> None:
         data = (json.dumps(record, sort_keys=True) + "\n").encode()
         try:
             if self._sock is None:

@@ -64,6 +64,15 @@ STRAGGLER_RANK_ANNOTATION = "sim.tpu.trainingjob.dev/straggler-rank"
 STRAGGLER_FACTOR_ANNOTATION = "sim.tpu.trainingjob.dev/straggler-factor"
 STALL_RANK_ANNOTATION = "sim.tpu.trainingjob.dev/stall-rank"
 STALL_AT_STEP_ANNOTATION = "sim.tpu.trainingjob.dev/stall-at-step"
+#: Incident-plane synthesis: ckpt-ms/hbm-bytes ride every step record (the
+#: fields a real workload's checkpoint pipeline and HBM sampler report);
+#: restore-ms/compile-ms make a freshly (re)started pod first push one
+#: resume record -- the workload tail the incident bundle attributes into
+#: rendezvous/restore/compile phases.
+CKPT_MS_ANNOTATION = "sim.tpu.trainingjob.dev/ckpt-ms"
+HBM_BYTES_ANNOTATION = "sim.tpu.trainingjob.dev/hbm-bytes"
+RESTORE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/restore-ms"
+COMPILE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/compile-ms"
 
 #: Step records synthesized per pod per tick, at most (a pod "catching up"
 #: after a long scheduler pause must not flood the aggregator's window).
@@ -358,6 +367,10 @@ class SimRuntime(PodStateRuntime):
             tokens = float(ann.get(TOKENS_PER_STEP_ANNOTATION, "0"))
             flops = float(ann.get(FLOPS_PER_STEP_ANNOTATION, "0"))
             peak = float(ann.get(PEAK_FLOPS_ANNOTATION, "0"))
+            ckpt_ms = float(ann.get(CKPT_MS_ANNOTATION, "0"))
+            hbm_bytes = float(ann.get(HBM_BYTES_ANNOTATION, "0"))
+            restore_ms = float(ann.get(RESTORE_MS_ANNOTATION, "0"))
+            compile_ms = float(ann.get(COMPILE_MS_ANNOTATION, "0"))
         except ValueError:
             return  # malformed script annotations: no telemetry
         if step_ms <= 0.0:
@@ -367,6 +380,16 @@ class SimRuntime(PodStateRuntime):
             return
         job_key = f"{pod.namespace}/{job_name}"
         rtype = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL, "worker")
+        if (rt.steps_reported == 0 and target > 0
+                and (restore_ms or compile_ms)):
+            # Fresh (re)start: a real workload's overlapped_restore pushes
+            # its span durations before the first step record does.
+            TELEMETRY.ingest({
+                "v": 1, "job": job_key, "rtype": rtype, "rank": rank,
+                "resume_restore_ms": restore_ms,
+                "resume_compile_ms": compile_ms,
+                "resume_overlapped": True, "ts": now,
+            }, now=now)
         budget = _MAX_STEPS_PER_TICK
         while rt.steps_reported < target and budget > 0:
             record = {
@@ -379,6 +402,10 @@ class SimRuntime(PodStateRuntime):
                 record["flops"] = flops
             if peak:
                 record["peak_flops"] = peak
+            if ckpt_ms:
+                record["ckpt_ms"] = ckpt_ms
+            if hbm_bytes:
+                record["hbm_bytes"] = hbm_bytes
             TELEMETRY.ingest(record, now=now)
             rt.steps_reported += 1
             budget -= 1

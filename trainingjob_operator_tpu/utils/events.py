@@ -33,7 +33,22 @@ class EventRecorder:
         self._component = component
         self._created: "deque[tuple[str, str]]" = deque()
 
+    def set_sink(self, sink: Any) -> None:
+        """``sink(obj, reason, message)`` observes every recorded event
+        (the incident flight recorder taps the stream here).  Attribute-
+        based so a ``NullRecorder`` -- whose ``__init__`` is empty and whose
+        ``event`` never fires -- stays safe."""
+        self._sink = sink
+
     def event(self, obj: Any, etype: str, reason: str, message: str) -> None:
+        sink = getattr(self, "_sink", None)
+        if sink is not None:
+            try:
+                sink(obj, reason, message)
+            # analyzer: allow[broad-except]: the tap is observability; the
+            # event itself must still be recorded.
+            except Exception:
+                log.exception("event sink failed")
         meta = obj.metadata
         ev = Event(
             metadata=ObjectMeta(

@@ -235,13 +235,51 @@ def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     return 200, "application/json", json.dumps(table, indent=2)
 
 
+def _render_incidents(incidents,
+                      params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/incidents: the per-job list
+    of retained incident bundles.  No ?job= -> job summary list; with one,
+    the bundles (?format=chrome -> the newest bundle -- or ?id=N -- as
+    Chrome trace_event JSON).  Unknown job -> 404; a ?format other than
+    json/chrome -> explicit 400, the caller typo'd the one knob the
+    endpoint has."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "chrome"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or chrome\n"
+    job = params.get("job", [""])[0]
+    if not job:
+        jobs = incidents.jobs()
+        return 200, "application/json", json.dumps(
+            {"count": len(jobs), "jobs": jobs}, indent=2)
+    bundles = incidents.bundles(job)
+    if bundles is None:
+        return 404, "text/plain", ""
+    id_raw = params.get("id", [""])[0]
+    incident_id = int(id_raw) if id_raw.isdigit() else None
+    if fmt == "chrome":
+        body = incidents.export_chrome(job, incident_id)
+        if body is None:
+            return 404, "text/plain", ""
+        return 200, "application/json", body
+    if incident_id is not None:
+        body = incidents.bundle_json(job, incident_id)
+        if body is None:
+            return 404, "text/plain", ""
+        return 200, "application/json", body
+    return 200, "application/json", json.dumps(
+        {"job": job, "count": len(bundles),
+         "open": incidents.open_incident(job),
+         "incidents": bundles}, indent=2)
+
+
 def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                   host: str = "127.0.0.1", tracer=None, events_fn=None,
                   ready_fn: Optional[Callable[[], bool]] = None,
-                  telemetry=None):
+                  telemetry=None, incidents=None):
     """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
-    /debug/threads, /debug/traces, /debug/events and /debug/steps on a
-    daemon thread; ``.shutdown()`` stops it and closes the socket.
+    /debug/threads, /debug/traces, /debug/events, /debug/steps and
+    /debug/incidents on a daemon thread; ``.shutdown()`` stops it and closes
+    the socket.
 
     - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
     - ``events_fn``: zero-arg callable returning Event objects (e.g.
@@ -250,6 +288,8 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
       truthy.  Omitted -> always ready (no controller to wait for).
     - ``telemetry``: an obs.telemetry.TelemetryAggregator; enables
       /debug/steps (404 without).
+    - ``incidents``: an obs.incident.IncidentRecorder; enables
+      /debug/incidents (404 without).
 
     Binds loopback by default -- /debug/threads exposes live stacks, the
     pprof convention (expose beyond localhost only deliberately via
@@ -289,6 +329,10 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                                                                 params)
             elif path == "/debug/steps" and telemetry is not None:
                 status, ctype, body = _render_steps(telemetry, params)
+                if status == 404:
+                    body = None
+            elif path == "/debug/incidents" and incidents is not None:
+                status, ctype, body = _render_incidents(incidents, params)
                 if status == 404:
                     body = None
             if body is None:

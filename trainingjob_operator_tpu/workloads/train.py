@@ -506,7 +506,25 @@ def overlapped_restore(restore_fn: Callable[[], Any],
         "wall_s": time.perf_counter() - t_wall,
         "overlap": thread is not None,
     }
+    _push_resume_record(timings)
     return restored, result.get("compiled"), timings
+
+
+def _push_resume_record(timings: Dict[str, Any]) -> None:
+    """Best-effort push of the resume span durations to the controller's
+    telemetry sink (short-lived emitter; no-op when the operator did not
+    inject the address/identity env).  The incident flight recorder uses
+    them to split the post-recovery downtime tail into
+    rendezvous/restore/compile phases."""
+    emitter = TelemetryEmitter()
+    if not emitter.enabled:
+        return
+    try:
+        emitter.emit_resume(timings["restore_s"] * 1e3,
+                            timings["compile_s"] * 1e3,
+                            bool(timings["overlap"]))
+    finally:
+        emitter.close()
 
 
 def resume_fastpath_enabled() -> bool:
@@ -718,6 +736,15 @@ class StepProfiler:
         #: All step-visible checkpoint stalls this run (end-of-run summary).
         self.ckpt_stalls: List[float] = []
         self._ckpt_stall_ms: Optional[float] = None
+        #: HBM sampler: every N steps, read device memory-in-use and ride it
+        #: on the telemetry record as ``hbm_bytes`` -- an OOM-shaped incident
+        #: then carries a memory timeline.  0 disables; sampling only when
+        #: telemetry is on (the value has nowhere else to go).
+        try:
+            self.hbm_sample_steps = int(os.environ.get(
+                constants.HBM_SAMPLE_STEPS_ENV, "32") or "0")
+        except ValueError:
+            self.hbm_sample_steps = 32
 
     def step_start(self, i: int) -> None:
         if self.trace_dir and not self._tracing and i == self.start_step:
@@ -750,8 +777,12 @@ class StepProfiler:
         if self.step_times:
             self._log.info("step_time step=%d ms=%.2f", i, ms)
         if self.emitter.enabled:
+            hbm = None
+            if (self.hbm_sample_steps > 0
+                    and i % self.hbm_sample_steps == 0):
+                hbm = _hbm_bytes_in_use()
             self.emitter.emit(i, ms, loss=_scalar(loss),
-                              ckpt_ms=self._ckpt_stall_ms)
+                              ckpt_ms=self._ckpt_stall_ms, hbm_bytes=hbm)
             self._ckpt_stall_ms = None
 
     def record_checkpoint_stall(self, ms: float) -> None:
@@ -779,6 +810,27 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._tracing = False
         self.emitter.close()
+
+
+def _hbm_bytes_in_use() -> Optional[float]:
+    """Device memory in use (bytes): ``memory_stats()`` where the backend
+    exposes it (TPU, GPU), else the sum of live array nbytes -- the CPU
+    backend has no allocator stats, but live_arrays() still tracks what the
+    program holds.  None when neither works; sampling must never fail a
+    step."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return float(stats["bytes_in_use"])
+        return float(sum(getattr(a, "nbytes", 0)
+                         for a in jax.live_arrays()))
+    # analyzer: allow[broad-except]: backend-specific -- memory_stats is
+    # unimplemented on some runtimes and live_arrays can race a deletion;
+    # the HBM sample is observability, never worth a step.
+    except Exception:
+        return None
 
 
 def _scalar(value: Any) -> Optional[float]:
