@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke
+.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -65,7 +65,15 @@ fleet-smoke:
 recovery-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.recovery_smoke
 
+# One scope=Resize job through a shrink on the sim cluster
+# (docs/ELASTIC.md): survivors must keep their uids, the bumped rendezvous
+# generation must be republished, and the incident bundle must attribute
+# the window to detect/reshard/first_step with zero teardown and zero
+# unattributed residue.
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.elastic_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint test dryrun incident-demo fleet-smoke recovery-smoke
+ci: lint test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke

@@ -1,0 +1,377 @@
+"""Elastic-resize fast path: reshard plan arithmetic, the rendezvous
+generation channel, the live redistribute executor, and the controller's
+survivor-keepalive drain end to end against the sim cluster
+(docs/ELASTIC.md).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.parallel import reshard
+from trainingjob_operator_tpu.workloads import rendezvous
+
+from conftest import wait_for  # noqa: E402
+
+
+# -- plan arithmetic (pure) ---------------------------------------------------
+
+
+class TestShardRanges:
+    def test_even_split(self):
+        assert reshard.shard_ranges(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+    def test_uneven_remainder_is_jax_style(self):
+        # ceil chunking: every shard but the last holds ceil(10/4)=3.
+        assert reshard.shard_ranges(10, 4) == [(0, 3), (3, 6), (6, 9), (9, 10)]
+
+    def test_more_shards_than_elements(self):
+        ranges = reshard.shard_ranges(2, 4)
+        assert ranges == [(0, 1), (1, 2), (2, 2), (2, 2)]
+
+    def test_rejects_bad_inputs(self):
+        with pytest.raises(ValueError):
+            reshard.shard_ranges(-1, 2)
+        with pytest.raises(ValueError):
+            reshard.shard_ranges(4, 0)
+
+
+class TestPlanExchange:
+    def test_wide_to_narrow_partitions_exactly(self):
+        plan = reshard.plan_exchange(8, old_shards=4, new_shards=2)
+        # Segments partition [0, n): every element accounted for once.
+        covered = sorted((s.start, s.stop) for s in plan.segments)
+        flat = []
+        for a, b in covered:
+            flat.extend(range(a, b))
+        assert flat == list(range(8))
+        assert plan.covered
+        # Old shard 0 [0,2) lands inside new shard 0 [0,4): stationary.
+        assert any(s.src == 0 and s.dst == 0 for s in plan.stationary)
+        # Old shard 3 [6,8) must cross to new shard 1: a move.
+        assert any(s.src == 3 and s.dst == 1 for s in plan.moves)
+
+    def test_narrow_to_wide(self):
+        plan = reshard.plan_exchange(8, old_shards=2, new_shards=4)
+        assert plan.covered
+        sizes = sum(s.size for s in plan.segments)
+        assert sizes == 8
+        # Only runs whose old and new shard INDEX coincide stay put: new
+        # shard 0 keeps old shard 0's first half and new shard 1 receives
+        # old shard... 0 again (indices differ) -- 6 of 8 bytes move.
+        assert plan.bytes_moved(itemsize=1) == 6
+        assert sum(s.size for s in plan.stationary) == 2
+
+    def test_uneven_remainders_cover(self):
+        plan = reshard.plan_exchange(10, old_shards=3, new_shards=4)
+        assert plan.covered
+        assert sum(s.size for s in plan.segments) == 10
+
+    def test_lost_shard_yields_missing_segments(self):
+        plan = reshard.plan_exchange(8, old_shards=4, new_shards=2, lost=[3])
+        assert not plan.covered
+        missing = plan.missing
+        assert missing and all(s.src is None for s in missing)
+        # The lost shard held [6,8): exactly those elements are missing.
+        assert sorted((s.start, s.stop) for s in missing) == [(6, 8)]
+        assert plan.stats(itemsize=1)["missing_bytes"] == 2
+
+    def test_stationary_dominates_small_shrink(self):
+        # 7->6 shards of a large axis: most bytes do not move at all --
+        # the reason in-place reshard beats any checkpoint restore.
+        plan = reshard.plan_exchange(4096, old_shards=7, new_shards=6)
+        stats = plan.stats(itemsize=1)
+        assert stats["moved_bytes"] < 4096
+        assert stats["stationary_bytes"] > stats["moved_bytes"]
+
+
+class TestPlanPytree:
+    SHAPES = {"w1": (64, 16), "w2": (64,), "scalar": ()}
+
+    def test_aggregates_and_scales_off_axis(self):
+        agg = reshard.plan_pytree_exchange(self.SHAPES, 4, 2, itemsize=4)
+        assert agg["covered"]
+        assert set(agg["plans"]) == {"w1", "w2"}  # scalars skipped
+        # w1's rows are 16 floats wide: its byte counts are 64x w2's.
+        totals = (agg["moved_bytes"] + agg["stationary_bytes"]
+                  + agg["missing_bytes"])
+        assert totals == 64 * 16 * 4 + 64 * 4
+
+    def test_lost_shard_uncovers_pytree(self):
+        agg = reshard.plan_pytree_exchange(self.SHAPES, 4, 2, lost=[0])
+        assert not agg["covered"]
+        assert agg["missing_bytes"] > 0
+
+
+# -- generation channel (rendezvous.py) --------------------------------------
+
+
+def _write_doc(path, doc, bump_mtime=True):
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    if bump_mtime:
+        # Force a distinct mtime: same-second writes are invisible to the
+        # watcher's stat gate on coarse filesystems.
+        st = os.stat(path)
+        os.utime(path, (st.st_atime, st.st_mtime + 1))
+
+
+class TestGenerationChannel:
+    def test_read_generation_roundtrip(self, tmp_path):
+        path = str(tmp_path / "generation.json")
+        doc = {"generation": 2, "world": [0, 2], "num_processes": 2}
+        _write_doc(path, doc, bump_mtime=False)
+        assert rendezvous.read_generation(path) == doc
+
+    def test_read_generation_rejects_garble(self, tmp_path):
+        path = str(tmp_path / "generation.json")
+        assert rendezvous.read_generation(path) is None  # absent
+        for bad in ("not json", json.dumps([1, 2]),
+                    json.dumps({"generation": "2", "world": [0]}),
+                    json.dumps({"generation": 0, "world": [0]}),
+                    json.dumps({"generation": 2, "world": "0,1"})):
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(bad)
+            assert rendezvous.read_generation(path) is None
+
+    def test_watcher_ignores_birth_generation(self, tmp_path):
+        path = str(tmp_path / "generation.json")
+        _write_doc(path, {"generation": 3, "world": [0, 1]})
+        w = rendezvous.GenerationWatcher(path=path, birth=3, interval=0.0)
+        assert w.poll(now=0.0) is None  # born into generation 3: no react
+        _write_doc(path, {"generation": 4, "world": [0]})
+        doc = w.poll(now=1.0)
+        assert doc is not None and doc["generation"] == 4
+
+    def test_watcher_surfaces_each_generation_once(self, tmp_path):
+        path = str(tmp_path / "generation.json")
+        w = rendezvous.GenerationWatcher(path=path, birth=0, interval=0.0)
+        assert w.poll(now=0.0) is None  # no file yet
+        _write_doc(path, {"generation": 1, "world": [0, 2]})
+        assert w.poll(now=1.0)["generation"] == 1
+        assert w.poll(now=2.0) is None  # same doc: surfaced once
+
+    def test_watcher_rate_limit(self, tmp_path):
+        path = str(tmp_path / "generation.json")
+        _write_doc(path, {"generation": 1, "world": [0]})
+        w = rendezvous.GenerationWatcher(path=path, birth=0, interval=10.0)
+        assert w.poll(now=0.0)["generation"] == 1
+        _write_doc(path, {"generation": 2, "world": [0]})
+        assert w.poll(now=5.0) is None  # inside the poll interval
+        assert w.poll(now=11.0)["generation"] == 2
+
+    def test_from_env_reads_resize_channel(self):
+        rdv = rendezvous.from_env({
+            constants.JOB_NAME_ENV: "j",
+            constants.RESIZE_DIR_ENV: "/rdv/j",
+            constants.RENDEZVOUS_GENERATION_ENV: "5",
+        })
+        assert rdv.resize_dir == "/rdv/j"
+        assert rdv.rendezvous_generation == 5
+        assert rdv.generation_path == os.path.join("/rdv/j",
+                                                   "generation.json")
+
+
+# -- live redistribute (virtual 8-device CPU mesh) ---------------------------
+
+
+class TestRedistribute:
+    def test_values_preserved_across_mesh_widths(self):
+        jax = pytest.importorskip("jax")
+        from conftest import apply_jax_platform_override
+        apply_jax_platform_override()
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from trainingjob_operator_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        old_mesh = make_mesh(MeshSpec.of(fsdp=4),
+                             devices=jax.devices()[:4])
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharded = jax.device_put(
+            x, NamedSharding(old_mesh, P("fsdp", None)))
+        scalar = jax.device_put(
+            np.float32(7.0), NamedSharding(old_mesh, P()))
+
+        new_mesh = make_mesh(MeshSpec.of(fsdp=2),
+                             devices=jax.devices()[:2])
+        out = reshard.redistribute({"w": sharded, "c": scalar}, new_mesh)
+        np.testing.assert_array_equal(np.asarray(out["w"]), x)
+        assert float(out["c"]) == 7.0
+        # The leaf's own spec survived the re-fit onto the narrower mesh.
+        assert out["w"].sharding.mesh.shape["fsdp"] == 2
+        assert tuple(out["w"].sharding.spec)[:1] == ("fsdp",)
+
+
+# -- survivor-keepalive drain e2e (controller + sim) -------------------------
+
+
+from trainingjob_operator_tpu.api.types import (  # noqa: E402
+    ReplicaSpec,
+    RestartPolicy,
+    RestartScope,
+    TPUTrainingJob,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.client.clientset import Clientset  # noqa: E402
+from trainingjob_operator_tpu.cmd.options import OperatorOptions  # noqa: E402
+from trainingjob_operator_tpu.controller.controller import (  # noqa: E402
+    TrainingJobController,
+)
+from trainingjob_operator_tpu.core.objects import (  # noqa: E402
+    Container,
+    ContainerPort,
+    EnvVar,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from trainingjob_operator_tpu.obs.incident import INCIDENTS  # noqa: E402
+from trainingjob_operator_tpu.runtime.sim import (  # noqa: E402
+    RUN_SECONDS_ANNOTATION,
+    SimRuntime,
+)
+
+
+@pytest.fixture
+def cluster():
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.start()
+    tc.run(workers=2)
+    yield cs, tc, sim
+    tc.stop()
+    sim.stop()
+
+
+def resize_job(name, rdv_dir, replicas=3):
+    job = TPUTrainingJob(metadata=ObjectMeta(name=name, namespace="default"))
+    template = PodTemplateSpec(
+        metadata=ObjectMeta(annotations={RUN_SECONDS_ANNOTATION: "30"}),
+        spec=PodSpec(containers=[
+            Container(name="aitj-main",
+                      env=[EnvVar(name=constants.RESIZE_DIR_ENV,
+                                  value=rdv_dir)],
+                      ports=[ContainerPort(name="aitj-7777",
+                                           container_port=7777)])]))
+    job.spec.replica_specs["trainer"] = ReplicaSpec(
+        replicas=replicas, min_replicas=1, template=template,
+        restart_policy=RestartPolicy.EXIT_CODE,
+        restart_scope=RestartScope.RESIZE)
+    job.spec.restarting_exit_code = "137,143"
+    return job
+
+
+class TestResizeE2E:
+    def test_kill_one_replica_keeps_survivors_alive(self, cluster, tmp_path):
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        rdv_dir = str(tmp_path / "rdv")
+        name = "ej"
+        key = f"default/{name}"
+        INCIDENTS.forget(key)
+        cs.trainingjobs.create(resize_job(name, rdv_dir))
+
+        def phase():
+            return cs.trainingjobs.get("default", name).status.phase
+
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10), phase()
+        before = {p.metadata.name: p.metadata.uid
+                  for p in cs.pods.list("default")}
+        assert len(before) == 3
+
+        sim.preempt_pod("default", f"{name}-trainer-1", exit_code=137)
+
+        # The drain deletes only the failed replica; the job comes back
+        # Running at width 2 with the survivors' pods untouched.
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", name)
+            .status.lost_indices.get("trainer") == [1], 10)
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10), phase()
+        assert wait_for(
+            lambda: len(cs.pods.list("default")) == 2, 10)
+        after = {p.metadata.name: p.metadata.uid
+                 for p in cs.pods.list("default")}
+        assert after == {n: u for n, u in before.items()
+                         if n != f"{name}-trainer-1"}  # same uids: kept alive
+
+        job = cs.trainingjobs.get("default", name)
+        assert job.status.rendezvous_generation == 1
+        assert job.status.resize_replica_name == ""
+        assert job.status.restart_counts.get("trainer") == 1
+
+        # The bumped generation was republished for the survivors.
+        doc = rendezvous.read_generation(
+            os.path.join(rdv_dir, "generation.json"))
+        assert doc is not None
+        assert doc["generation"] == 1
+        assert doc["world"] == [0, 2]
+        assert doc["num_processes"] == 2
+        assert len(doc["hosts"]) == 2
+
+    def test_incident_bundle_attributes_reshard_not_teardown(
+            self, cluster, tmp_path):
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        name = "ej2"
+        key = f"default/{name}"
+        INCIDENTS.forget(key)
+        cs.trainingjobs.create(resize_job(name, str(tmp_path / "rdv")))
+
+        def phase():
+            return cs.trainingjobs.get("default", name).status.phase
+
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10)
+        time.sleep(0.1)  # let the incident window open cleanly after Running
+        sim.preempt_pod("default", f"{name}-trainer-2", exit_code=137)
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", name)
+            .status.lost_indices.get("trainer") == [2], 10)
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10)
+
+        def bundle():
+            bundles = INCIDENTS.bundles(key) or []
+            return bundles[-1] if bundles else None
+
+        assert wait_for(lambda: bundle() is not None, 10)
+        b = bundle()
+        assert b["kind"] == "resize"
+        assert b["phases"]["teardown"] == 0.0  # survivors never tore down
+        assert b["phases"].get("reshard", 0.0) >= 0.0
+        assert "reshard" in b["phases"]
+        # All downtime lands in the resize phases, nothing unattributed.
+        assert b["phases"]["unknown"] == 0.0
+        attributed = (b["phases"]["detect"] + b["phases"]["reshard"]
+                      + b["phases"]["first_step"])
+        assert attributed == pytest.approx(b["downtime_ms"], rel=1e-6)
+
+    def test_floor_falls_back_to_restart_all(self, cluster, tmp_path):
+        """A resize that would drop survivors below min_replicas restarts
+        the world instead (ReshardFellBack)."""
+        cs, tc, sim = cluster
+        sim.add_node("n0")
+        name = "ej3"
+        INCIDENTS.forget(f"default/{name}")
+        job = resize_job(name, str(tmp_path / "rdv"), replicas=1)
+        cs.trainingjobs.create(job)
+
+        def phase():
+            return cs.trainingjobs.get("default", name).status.phase
+
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10)
+        uid = cs.pods.list("default")[0].metadata.uid
+        sim.preempt_pod("default", f"{name}-trainer-0", exit_code=137)
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", name)
+            .status.restart_counts.get("trainer", 0) == 1, 10)
+        assert wait_for(lambda: phase() == TrainingJobPhase.RUNNING, 10)
+        pods = cs.pods.list("default")
+        assert len(pods) == 1
+        assert pods[0].metadata.uid != uid  # restarted, not kept
+        job = cs.trainingjobs.get("default", name)
+        assert job.status.lost_indices.get("trainer") in (None, [])
+        assert job.status.rendezvous_generation == 0

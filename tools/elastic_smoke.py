@@ -1,0 +1,199 @@
+"""Run one simulated scope=Resize TrainingJob through a shrink and assert
+the elastic fast path end to end: survivor keepalive + phase attribution.
+
+The ``make elastic-smoke`` driver: in-process sim cluster, one 3-replica
+job with ``restartScope: Resize``.  Once it is Running the smoke preempts
+one replica (exit 137) and checks the whole contract:
+
+- the drain deletes ONLY the failed pod -- the survivors keep their uids
+  (no restart-all);
+- the job converges back to Running at the narrower width, with the
+  bumped rendezvous generation (new world + surviving hosts) atomically
+  republished into the resize dir for the survivors to pick up;
+- the incident flight recorder attributes the window to the resize
+  phases (``detect``/``reshard``/``first_step``) with zero ``teardown``
+  and zero unattributed residue -- printed as the same phase table
+  ``/debug/incidents?job=...`` serves.
+
+Usage::
+
+    python -m tools.elastic_smoke [--timeout 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("elastic-smoke")
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="Give up if the resize has not converged.")
+    args = parser.parse_args(argv)
+
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.api.types import (
+        ReplicaSpec,
+        RestartPolicy,
+        RestartScope,
+        TPUTrainingJob,
+        TrainingJobPhase,
+    )
+    from trainingjob_operator_tpu.client.clientset import Clientset
+    from trainingjob_operator_tpu.cmd.options import OperatorOptions
+    from trainingjob_operator_tpu.controller.controller import (
+        TrainingJobController,
+    )
+    from trainingjob_operator_tpu.core.objects import (
+        Container,
+        ContainerPort,
+        EnvVar,
+        ObjectMeta,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from trainingjob_operator_tpu.obs.incident import INCIDENTS, PHASES
+    from trainingjob_operator_tpu.runtime.sim import (
+        RUN_SECONDS_ANNOTATION,
+        STEP_MS_ANNOTATION,
+        TOKENS_PER_STEP_ANNOTATION,
+        SimRuntime,
+    )
+    from trainingjob_operator_tpu.workloads import rendezvous
+
+    def wait_for(pred, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.add_node("sim-0")
+    sim.start()
+    tc.run(workers=2)
+    name = "elastic-smoke"
+    key = f"default/{name}"
+    rdv_dir = tempfile.mkdtemp(prefix="elastic-smoke-rdv-")
+    try:
+        INCIDENTS.forget(key)
+        job = TPUTrainingJob(metadata=ObjectMeta(name=name,
+                                                 namespace="default"))
+        template = PodTemplateSpec(
+            metadata=ObjectMeta(
+                annotations={
+                    RUN_SECONDS_ANNOTATION: str(args.timeout * 2),
+                    # Survivors report steps: the first step record after
+                    # the resize amends the bundle with the workload tail.
+                    STEP_MS_ANNOTATION: "20",
+                    TOKENS_PER_STEP_ANNOTATION: "8192",
+                }),
+            spec=PodSpec(containers=[
+                Container(name="aitj-main",
+                          env=[EnvVar(name=constants.RESIZE_DIR_ENV,
+                                      value=rdv_dir)],
+                          ports=[ContainerPort(name="aitj-7777",
+                                               container_port=7777)])]))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=3, min_replicas=1, template=template,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            restart_scope=RestartScope.RESIZE)
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+
+        def phase():
+            return cs.trainingjobs.get("default", name).status.phase
+
+        if not wait_for(lambda: phase() == TrainingJobPhase.RUNNING,
+                        args.timeout):
+            print(f"job never reached Running (phase {phase()})",
+                  file=sys.stderr)
+            return 1
+        before = {p.metadata.name: p.metadata.uid
+                  for p in cs.pods.list("default")}
+        victim = f"{name}-trainer-1"
+        print(f"running at width 3; preempting {victim} (exit 137) ...")
+        sim.preempt_pod("default", victim, exit_code=137)
+
+        def resized():
+            job = cs.trainingjobs.get("default", name)
+            return (job.status.rendezvous_generation == 1
+                    and job.status.phase == TrainingJobPhase.RUNNING
+                    and len(cs.pods.list("default")) == 2)
+
+        if not wait_for(resized, args.timeout):
+            job = cs.trainingjobs.get("default", name)
+            print(f"resize never converged: phase={job.status.phase} "
+                  f"generation={job.status.rendezvous_generation} "
+                  f"pods={len(cs.pods.list('default'))}", file=sys.stderr)
+            return 1
+
+        # Survivor keepalive: the two remaining pods are the SAME pods
+        # (uid-identical), not replacements.
+        after = {p.metadata.name: p.metadata.uid
+                 for p in cs.pods.list("default")}
+        expected = {n: u for n, u in before.items() if n != victim}
+        if after != expected:
+            print(f"survivors were restarted: before={expected} "
+                  f"after={after}", file=sys.stderr)
+            return 1
+        print(f"survivors kept alive: {sorted(after)} (uids unchanged)")
+
+        doc = rendezvous.read_generation(
+            os.path.join(rdv_dir, "generation.json"))
+        if doc is None or doc["generation"] != 1 or doc["world"] != [0, 2]:
+            print(f"bad republished generation doc: {doc}", file=sys.stderr)
+            return 1
+        print(f"generation {doc['generation']} republished: "
+              f"world {doc['world']}, {len(doc['hosts'])} hosts")
+
+        def amended_bundle():
+            bundles = INCIDENTS.bundles(key) or []
+            for b in reversed(bundles):
+                if (b["running_at"] is not None
+                        and b["ended"] > b["running_at"]):
+                    return b
+            return None
+
+        if not wait_for(lambda: amended_bundle() is not None, args.timeout):
+            print(f"no amended incident bundle; "
+                  f"have: {INCIDENTS.bundles(key)}", file=sys.stderr)
+            return 1
+        bundle = amended_bundle()
+        total = bundle["downtime_ms"]
+        print(f"\nincident #{bundle['id']} ({bundle['reason']}, "
+              f"kind={bundle['kind']}) on {key}:")
+        print(f"{'phase':<12}{'ms':>10}{'share':>9}")
+        for ph in PHASES:
+            ms = bundle["phases"][ph]
+            share = (ms / total * 100.0) if total else 0.0
+            print(f"{ph:<12}{ms:>10.1f}{share:>8.1f}%")
+        print(f"{'total':<12}{total:>10.1f}")
+
+        if bundle["kind"] != "resize":
+            print(f"bundle kind {bundle['kind']!r} != 'resize'",
+                  file=sys.stderr)
+            return 1
+        if bundle["phases"]["teardown"] != 0.0:
+            print("survivors were torn down: teardown phase "
+                  f"{bundle['phases']['teardown']:.1f} ms", file=sys.stderr)
+            return 1
+        if bundle["phases"]["unknown"] != 0.0:
+            print(f"unattributed residue {bundle['phases']['unknown']:.1f} "
+                  f"ms", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        tc.stop()
+        sim.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,23 @@ INCIDENT_BUNDLES_ENV = "TRAININGJOB_INCIDENT_BUNDLES"
 # memory every N steps and ride it on the telemetry record as ``hbm_bytes``
 # (OOM-shaped incidents then carry a memory timeline).  "0" disables.
 HBM_SAMPLE_STEPS_ENV = "TRAININGJOB_HBM_SAMPLE_STEPS"
+# Elastic-resize fast path (docs/ELASTIC.md).  RESIZE_DIR_ENV is the
+# generation channel: a directory (shared volume / NFS in a real cluster,
+# a host path under the sim/localproc runtimes) into which the controller
+# atomically publishes ``generation.json`` -- the bumped rendezvous
+# generation, new world size, and surviving host list -- when a
+# scope=Resize drain completes.  Surviving workload processes watch the
+# file from the step loop and re-form the mesh in place.
+RESIZE_DIR_ENV = "TRAININGJOB_RESIZE_DIR"
+# The rendezvous generation a pod was created under; the workload reacts
+# only to published generations strictly greater than its birth epoch.
+RENDEZVOUS_GENERATION_ENV = "TRAININGJOB_RENDEZVOUS_GENERATION"
+# Seconds between generation-file polls in the workload step loop.
+RESIZE_POLL_ENV = "TRAININGJOB_RESIZE_POLL_S"
+# "0" disables the in-process reshard fast path: a resize signal then
+# checkpoints and exits 143 (the restart-the-world A/B baseline that
+# bench.py's elastic_resize leg measures against).
+RESIZE_FASTPATH_ENV = "TRAININGJOB_RESIZE_FASTPATH"
 
 #: Env vars that are part of the contract but *user-set* (pod template or
 #: operator environment), never injected by the controller: workload tuning
@@ -183,6 +200,8 @@ USER_ENV_KNOBS = frozenset((
     INCIDENT_RING_ENV,
     INCIDENT_BUNDLES_ENV,
     HBM_SAMPLE_STEPS_ENV,
+    RESIZE_POLL_ENV,
+    RESIZE_FASTPATH_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --
@@ -230,6 +249,15 @@ PREEMPTED_REASON = "TrainingJobPreempted"
 NODE_FAIL_REASON = "TrainingJobNodeFail"
 SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
 
+# Elastic-resize fast path reasons (scope Resize, docs/ELASTIC.md):
+# ResizeStarted marks the survivor-keepalive drain opening (only failed
+# pods deleted), ReshardCompleted the generation republish once the drain
+# converges, ReshardFellBack the downgrade to the restart-the-world path
+# (survivors below the group's min width, so no quorum to reshard from).
+RESIZE_STARTED_REASON = "ResizeStarted"
+RESHARD_COMPLETED_REASON = "ReshardCompleted"
+RESHARD_FELL_BACK_REASON = "ReshardFellBack"
+
 # Telemetry-plane reasons (obs/telemetry.py watchdog): a replica's step
 # counter stopped advancing for N x its median step time / started moving
 # again.  Events, not phase transitions -- a stalled replica is still
@@ -266,6 +294,9 @@ EVENT_REASONS = frozenset((
     PREEMPTED_REASON,
     NODE_FAIL_REASON,
     SCALING_REASON,
+    RESIZE_STARTED_REASON,
+    RESHARD_COMPLETED_REASON,
+    RESHARD_FELL_BACK_REASON,
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
     INCIDENT_RECORDED_REASON,

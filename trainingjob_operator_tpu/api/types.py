@@ -101,13 +101,17 @@ class RestartPolicy:
 
 
 class RestartScope:
-    """Reference: v1/replica.go:31-33."""
+    """Reference: v1/replica.go:31-33.  ``RESIZE`` is a TPU extension
+    (VirtualFlow-style elastic resize, docs/ELASTIC.md): delete only the
+    failed pods, keep survivors alive, and republish a bumped rendezvous
+    generation so the surviving processes re-form the world in place."""
 
     ALL = "All"
     REPLICA = "Replica"
     POD = "Pod"
+    RESIZE = "Resize"
 
-    VALUES = (ALL, REPLICA, POD)
+    VALUES = (ALL, REPLICA, POD, RESIZE)
 
 
 class EndingPolicy:
@@ -379,6 +383,15 @@ class TrainingJobStatus:
     # elastic width; the running group is only re-rendezvoused once they all
     # schedule, so a failed probe never tears down running work.
     scale_probes: Dict[str, int] = field(default_factory=dict)
+    # TPU extension: elastic-resize fast path (scope Resize, docs/ELASTIC.md).
+    # While resize_replica_name is set, reconcile stalls until the group's
+    # *failed* pods drain; survivors stay alive and the bumped rendezvous
+    # generation is republished to them.  lost_indices records the replica
+    # indices vacated by resize (holes the reconciler must not refill);
+    # rendezvous_generation is the monotonically increasing world epoch.
+    resize_replica_name: str = ""
+    lost_indices: Dict[str, List[int]] = field(default_factory=dict)
+    rendezvous_generation: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"phase": self.phase}
@@ -408,6 +421,12 @@ class TrainingJobStatus:
             d["scaleUpAttempts"] = dict(self.scale_up_attempts)
         if self.scale_probes:
             d["scaleProbes"] = dict(self.scale_probes)
+        if self.resize_replica_name:
+            d["resizeReplicaName"] = self.resize_replica_name
+        if self.lost_indices:
+            d["lostIndices"] = {n: list(v) for n, v in self.lost_indices.items()}
+        if self.rendezvous_generation:
+            d["rendezvousGeneration"] = self.rendezvous_generation
         return d
 
     @classmethod
@@ -431,6 +450,10 @@ class TrainingJobStatus:
                                for n, v in (d.get("scaleUpAttempts") or {}).items()},
             scale_probes={n: int(v)
                           for n, v in (d.get("scaleProbes") or {}).items()},
+            resize_replica_name=d.get("resizeReplicaName", ""),
+            lost_indices={n: [int(i) for i in v]
+                          for n, v in (d.get("lostIndices") or {}).items()},
+            rendezvous_generation=int(d.get("rendezvousGeneration", 0)),
         )
 
 

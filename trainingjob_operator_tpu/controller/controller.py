@@ -19,6 +19,7 @@ from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.api.defaults import set_defaults
 from trainingjob_operator_tpu.api.types import (
     RECONCILABLE_PHASES,
+    RestartScope,
     TrainingJobPhase,
     TPUTrainingJob,
 )
@@ -464,7 +465,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         ending_phases: Dict[str, str] = {}
         aggregation_msg: List[str] = []
         if (not job.status.restart_replica_name
-                and not job.status.scaling_replica_name):
+                and not job.status.scaling_replica_name
+                and not job.status.resize_replica_name):
             for rtype in sorted(job.spec.replica_specs):
                 with TRACER.span("reconcile_pods", rtype=rtype) as sp:
                     ending_phase, msg = self.reconcile_pods(job, pods, rtype)
@@ -492,6 +494,24 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                     TELEMETRY.on_interruption(job_key)
                     break
                 if ending_phase == TrainingJobPhase.SCALING:
+                    if job.status.resize_replica_name == rtype:
+                        # In-place resize (scope Resize): survivors stay up,
+                        # so no Terminating flip and no scaling marker --
+                        # the resize drain in update_status waits only for
+                        # the victim pods before republishing the
+                        # rendezvous generation.
+                        update_job_conditions(
+                            job, TrainingJobPhase.SCALING,
+                            constants.SCALING_REASON, msg)
+                        now = time.time()
+                        GOODPUT.on_interruption(
+                            job_key, RestartScope.RESIZE, now=now)
+                        INCIDENTS.on_interruption(
+                            job_key, RestartScope.RESIZE,
+                            constants.RESIZE_STARTED_REASON,
+                            now=now, trace=current_context())
+                        TELEMETRY.on_interruption(job_key)
+                        break
                     # Elastic resize: same two-phase drain, scaling marker.
                     update_job_conditions(
                         job, TrainingJobPhase.SCALING,

@@ -113,6 +113,23 @@ def round_to_gang(width: int, gang: int, up: bool = False) -> int:
     return width // gang * gang
 
 
+def lost_indices(job: Any, rtype: str) -> frozenset:
+    """Replica indices vacated by an in-place resize (scope Resize,
+    docs/ELASTIC.md): holes inside the nominal width that the reconciler
+    must not refill -- recreating a lost middle index would force a full
+    re-rendezvous and defeat the survivor-keepalive fast path.  Holes heal
+    through the re-expand probe -> restart-the-world path."""
+    return frozenset(job.status.lost_indices.get(rtype, ()))
+
+
+def live_replicas(job: Any, rtype: str) -> int:
+    """The group's actual world size: the elastic width minus resize holes.
+    This is what convergence (``rs.active == live``) and the published
+    rendezvous world must count -- ``effective_replicas`` still spans the
+    index *range* including holes."""
+    return max(effective_replicas(job, rtype) - len(lost_indices(job, rtype)), 0)
+
+
 def effective_replicas(job: Any, rtype: str) -> int:
     """Elastic width: the number of replicas currently provisioned.
 

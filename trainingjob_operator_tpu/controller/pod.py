@@ -10,7 +10,10 @@ contract mirrors setEnv (pod.go:548-652) and adds the TPU/JAX bootstrap set
 from __future__ import annotations
 
 import copy
+import json
 import logging
+import os
+import tempfile
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -35,6 +38,8 @@ from trainingjob_operator_tpu.controller.naming import (
     get_slices,
     full_width,
     is_retryable_exit_code,
+    live_replicas,
+    lost_indices,
     pod_index,
     pods_below_width,
     round_to_gang,
@@ -49,10 +54,27 @@ from trainingjob_operator_tpu.core.objects import (
     PodPhase,
 )
 from trainingjob_operator_tpu.obs.telemetry import sink_address
-from trainingjob_operator_tpu.obs.trace import current_context
+from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.pod")
+
+
+def resize_dir(job: TPUTrainingJob) -> str:
+    """The job's rendezvous-generation channel directory (docs/ELASTIC.md):
+    the controller publishes ``generation.json`` here on a scope=Resize
+    drain; surviving workload processes watch it from the step loop.  A
+    template-set TRAININGJOB_RESIZE_DIR wins (mirroring _merge_env's
+    user-override semantics) so the controller writes exactly where the
+    pods were told to read."""
+    for spec in job.spec.replica_specs.values():
+        for container in (spec.template.spec.containers
+                          + spec.template.spec.init_containers):
+            for e in container.env:
+                if e.name == constants.RESIZE_DIR_ENV and e.value:
+                    return e.value
+    return os.path.join(tempfile.gettempdir(), "tpu-trainingjob-rdv",
+                        job.namespace, job.name)
 
 
 class PodReconciler:
@@ -193,8 +215,15 @@ class PodReconciler:
                     job, rtype, pods_below_width(replica_pods, replicas))
                 return ending
 
+        holes = lost_indices(job, rtype)
         for index, pod_slice in enumerate(pod_slices):
             if not pod_slice:
+                if index in holes:
+                    # Resize hole (scope Resize, docs/ELASTIC.md): the index
+                    # was vacated by a survivor-keepalive resize.  Refilling
+                    # it would force a full re-rendezvous; holes heal through
+                    # the re-expand probe -> restart-the-world path.
+                    continue
                 log.info("creating pod %s/%s %s-%d", job.namespace, job.name, rt, index)
                 self.create_new_pod(job, rt, str(index),
                                     str(job.status.restart_counts.get(rtype, 0)),
@@ -233,7 +262,7 @@ class PodReconciler:
                 limit = spec.restart_limit
                 if limit is None or job.status.restart_counts.get(rtype, 0) < limit:
                     ending = self._restart_pods(job, rtype, rt, pod, pods, pod_slices,
-                                                phase, cmsg)
+                                                phase, cmsg, node_ready)
                     if ending:
                         self._recount_replica_status(
                             job, rtype, pods_below_width(replica_pods, replicas))
@@ -265,11 +294,14 @@ class PodReconciler:
         self._recount_replica_status(
             job, rtype, pods_below_width(replica_pods, replicas))
         rs = job.status.replica_statuses[rtype]
+        # World size net of resize holes: whole-group policies and the
+        # stability checks below must count what can actually exist.
+        live = replicas - len(holes)
 
         # Whole-group ending policies (pod.go:298-315).
-        if spec.complete_policy == EndingPolicy.ALL and rs.succeeded == replicas:
+        if spec.complete_policy == EndingPolicy.ALL and rs.succeeded == live:
             return TrainingJobPhase.SUCCEEDED, f"All {rtype} pods have completed"
-        if spec.fail_policy == EndingPolicy.ALL and rs.failed == replicas:
+        if spec.fail_policy == EndingPolicy.ALL and rs.failed == live:
             if failed_reasons:
                 message = ", ".join(failed_reasons)
             return failed_phase, f"All {rtype} pods are failed, {message}"
@@ -317,7 +349,7 @@ class PodReconciler:
                                                  now)
             if ending:
                 return ending
-        elif not stuck_indices and rs.active == replicas:
+        elif not stuck_indices and rs.active == live:
             # Reset the release backoff only once the group actually RUNS at
             # full width -- "no stuck pods this sync" also describes freshly
             # recreated pods that have not aged past the grace window yet,
@@ -446,9 +478,14 @@ class PodReconciler:
         current width are provisioned on the next sync; the running group is
         only re-rendezvoused once they all schedule."""
         full = self._full_width(spec)
-        if (spec.edl_policy != EdlPolicy.AUTO or replicas >= full
-                or rs.active != replicas or replicas == 0
+        live = live_replicas(job, rtype)
+        if (spec.edl_policy != EdlPolicy.AUTO
+                or (replicas >= full and live == replicas)
+                or rs.active != live or live == 0
                 or rtype in job.status.scale_probes):
+            # ``live < replicas`` (resize holes) arms the probe even at
+            # nominal full width: committing it restart-the-worlds the group
+            # at full width, which is how holes heal (docs/ELASTIC.md).
             return
         last = job.status.last_scale_times.get(rtype)
         if last is None:
@@ -546,8 +583,10 @@ class PodReconciler:
         else:
             job.status.elastic_replicas[rtype] = new_width
         # A resize supersedes any in-flight probe (its reservations are
-        # deleted with the rest of the group below).
+        # deleted with the rest of the group below).  Resize holes clear
+        # too: the restart-the-world recreate fills every index < width.
         job.status.scale_probes.pop(rtype, None)
+        job.status.lost_indices.pop(rtype, None)
         job.status.last_scale_times[rtype] = time.time()
         self.metrics.inc("trainingjob_elastic_resizes_total")
         self.recorder.event(job, EventRecorder.NORMAL, constants.SCALING_REASON, msg)
@@ -570,9 +609,13 @@ class PodReconciler:
 
     def _restart_pods(self, job: TPUTrainingJob, rtype: str, rt: str, pod: Pod,
                       all_pods: List[Pod], pod_slices: List[List[Pod]],
-                      phase: str, msg: str) -> Optional[Tuple[str, str]]:
+                      phase: str, msg: str,
+                      node_ready: Optional[Dict[str, bool]] = None,
+                      ) -> Optional[Tuple[str, str]]:
         """Delete pods per RestartScope; NodeFail forces grace=0
-        (reference: pod.go:208-250)."""
+        (reference: pod.go:208-250).  Scope Resize takes the
+        survivor-keepalive fast path (docs/ELASTIC.md) and only downgrades
+        to the ALL drain when survivors would fall below the width floor."""
         force = phase == TrainingJobPhase.NODE_FAIL
         grace = 0 if force else None
         self._update_restart_count(job, rtype)
@@ -580,6 +623,17 @@ class PodReconciler:
         msg = f"restart times is {job.status.restart_counts.get(rtype, 0)}, {msg} "
         spec = job.spec.replica_specs[rtype]
         scope = spec.restart_scope
+        if scope == RestartScope.RESIZE:
+            ending = self._resize_keepalive(job, rtype, rt, pod, pod_slices,
+                                            grace, node_ready or {}, msg)
+            if ending is not None:
+                return ending
+            # Survivors can't form a quorum: restart the world instead.
+            self.recorder.event(
+                job, EventRecorder.WARNING, constants.RESHARD_FELL_BACK_REASON,
+                f"resize of {rt} would drop survivors below the width floor; "
+                f"falling back to scope=All restart")
+            scope = RestartScope.ALL
         self.recorder.event(job, EventRecorder.WARNING, constants.RESTARTING_REASON,
                             f"restarting scope={scope} trigger={pod.name}: {msg}")
         if scope == RestartScope.POD:
@@ -594,6 +648,119 @@ class PodReconciler:
         for p in all_pods:
             self.pod_control.delete_pod(p.namespace, p.name, job, grace_period=grace)
         return TrainingJobPhase.RESTARTING, msg
+
+    # -- elastic resize fast path (scope Resize, docs/ELASTIC.md) ------------
+
+    @staticmethod
+    def _resize_floor(spec: Any) -> int:
+        """Width floor for the survivor-keepalive path: min_replicas when
+        set, else 1 (unlike _min_width, not pinned to the declared width --
+        scope Resize is meaningful without elastic min/max config).  Multi-
+        host groups floor at a whole slice."""
+        lo = max(spec.min_replicas if spec.min_replicas is not None else 1, 1)
+        gang = gang_size(spec)
+        if gang > 1:
+            lo = max(round_to_gang(lo, gang, up=True), gang)
+        return lo
+
+    def _resize_keepalive(self, job: TPUTrainingJob, rtype: str, rt: str,
+                          trigger: Pod, pod_slices: List[List[Pod]],
+                          grace: Optional[int], node_ready: Dict[str, bool],
+                          msg: str) -> Optional[Tuple[str, str]]:
+        """The survivor-keepalive drain: delete only the failed pods (and
+        their gang siblings), record the vacated indices as holes, bump the
+        rendezvous generation, and hand off to status.py's resize
+        expectation logic.  Returns None when survivors would fall below
+        the floor -- the caller then restarts the world."""
+        spec = job.spec.replica_specs[rtype]
+        replicas = effective_replicas(job, rtype)
+        gang = gang_size(spec)
+        holes = set(job.status.lost_indices.get(rtype, ()))
+        newly_lost: set = set()
+        for index, pslice in enumerate(pod_slices[:replicas]):
+            if index in holes:
+                continue
+            dead = any(
+                p.status.phase == PodPhase.FAILED
+                or (p.spec.node_name and p.spec.node_name not in node_ready)
+                or p is trigger
+                for p in pslice)
+            if dead:
+                newly_lost.add(index)
+        if gang > 1:
+            # Slice-granular loss: any dead host loses the whole slice (its
+            # survivors' nodeSelector still demands the full topology).
+            for g in {i // gang for i in newly_lost}:
+                newly_lost.update(range(g * gang, min((g + 1) * gang, replicas)))
+        if not newly_lost:
+            return None
+        holes |= newly_lost
+        survivors = replicas - len(holes)
+        if survivors < self._resize_floor(spec) or survivors <= 0:
+            return None
+        # Victims: every pod at a lost index, plus any reservation pods an
+        # in-flight probe parked above the width (the probe is cancelled --
+        # its capacity answer predates the loss).
+        victims = [p for index, pslice in enumerate(pod_slices)
+                   for p in pslice
+                   if index in holes or index >= replicas]
+        job.status.lost_indices[rtype] = sorted(holes)
+        job.status.rendezvous_generation += 1
+        job.status.resize_replica_name = rtype
+        job.status.scale_probes.pop(rtype, None)
+        job.status.last_scale_times[rtype] = time.time()
+        self.metrics.inc("trainingjob_resizes_inplace_total")
+        self.recorder.event(
+            job, EventRecorder.NORMAL, constants.RESIZE_STARTED_REASON,
+            f"resize scope=Resize trigger={trigger.name}: draining "
+            f"{sorted(newly_lost)} of {rt}, keeping {survivors} survivor(s) "
+            f"alive; rendezvous generation -> "
+            f"{job.status.rendezvous_generation}")
+        with TRACER.span("resize.drain", job=meta_namespace_key(job),
+                         rtype=rt, victims=len(victims)):
+            for p in victims:
+                dead_node = (p.spec.node_name
+                             and p.spec.node_name not in node_ready)
+                g = 0 if (grace == 0 or dead_node
+                          or p.status.phase == PodPhase.FAILED) else grace
+                self.pod_control.delete_pod(p.namespace, p.name, job,
+                                            grace_period=g)
+        return TrainingJobPhase.SCALING, msg
+
+    def publish_generation(self, job: TPUTrainingJob,
+                           rtype: str) -> Dict[str, Any]:
+        """Atomically publish the bumped rendezvous generation -- new world
+        size + surviving host list -- into the job's resize dir.  Survivors
+        poll the file from the step loop (workloads/rendezvous.py) and
+        re-form the mesh in place; this is the injected-env/DNS analogue of
+        republishing the rendezvous without recreating pods."""
+        rt = rtype.lower()
+        replicas = effective_replicas(job, rtype)
+        holes = lost_indices(job, rtype)
+        world = [i for i in range(replicas) if i not in holes]
+        ports = get_ports_from_job(job, rtype)
+        coord_port = ports[0] if ports else constants.DEFAULT_COORDINATOR_PORT
+        instances = [f"{gen_general_name(job.name, rt, str(i))}.{job.namespace}"
+                     for i in world]
+        doc = {
+            "generation": job.status.rendezvous_generation,
+            "replica": rt,
+            "world": world,
+            "num_processes": len(world),
+            "hosts": instances,
+            "coordinator": f"{instances[0]}:{coord_port}" if instances else "",
+        }
+        base = resize_dir(job)
+        try:
+            os.makedirs(base, exist_ok=True)
+            tmp = os.path.join(base, ".generation.tmp")
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, os.path.join(base, "generation.json"))
+        except OSError:
+            log.warning("failed to publish generation for %s/%s under %s",
+                        job.namespace, job.name, base, exc_info=True)
+        return doc
 
     # -- container inspection (reference: pod.go:328-437) --------------------
 
@@ -884,6 +1051,12 @@ class PodReconciler:
                    f"{gen_general_name(job.name, rtype, index)}.{job.namespace}"),
             EnvVar(constants.JOB_NAME_ENV, job.name),
             EnvVar(constants.JOB_NAMESPACE_ENV, job.namespace),
+            # Elastic-resize generation channel (docs/ELASTIC.md): where the
+            # controller publishes bumped rendezvous generations, and the
+            # epoch this pod is born into (it reacts only to greater ones).
+            EnvVar(constants.RESIZE_DIR_ENV, resize_dir(job)),
+            EnvVar(constants.RENDEZVOUS_GENERATION_ENV,
+                   str(job.status.rendezvous_generation)),
         ]
         # Trace context, rendezvous-style: baked into the pod spec at create
         # time (we are inside the reconcile's sync_job span here), so the

@@ -31,6 +31,9 @@ from trainingjob_operator_tpu.controller.naming import (
     effective_replicas,
     filter_for_replica_type,
     full_width,
+    live_replicas,
+    lost_indices,
+    pod_index,
     pods_below_width,
 )
 from trainingjob_operator_tpu.core.objects import (
@@ -176,6 +179,45 @@ class StatusManager:
                     continue
             self._recount_replica_status(job, rtype, counted)
 
+        # Elastic-resize fast path drain (scope Resize, docs/ELASTIC.md):
+        # unlike every other drain, the expectation is NOT an empty pod set
+        # -- only the pods at the vacated indices must vanish, the
+        # survivors stay alive throughout.  Once they are gone, the bumped
+        # rendezvous generation (new world size + surviving host list) is
+        # republished through the injected generation channel and the job
+        # converges back to Running without passing through restart-all.
+        if job.status.resize_replica_name:
+            rname = job.status.resize_replica_name
+            if rname not in job.spec.replica_specs:
+                job.status.resize_replica_name = ""
+                return
+            holes = lost_indices(job, rname)
+            rt_pods = filter_for_replica_type(pods, rname.lower())
+            width = effective_replicas(job, rname)
+            still = [p for p in rt_pods
+                     if (idx := pod_index(p)) is not None
+                     and (idx in holes or idx >= width)]
+            if not still:
+                doc = self.publish_generation(job, rname)
+                live = width - len(holes)
+                self.recorder.event(
+                    job, EventRecorder.NORMAL,
+                    constants.RESHARD_COMPLETED_REASON,
+                    f"{rname.lower()} resize drain complete: republished "
+                    f"rendezvous generation {doc['generation']} to {live} "
+                    f"survivor(s) (world {doc['world']})")
+                update_job_conditions(
+                    job, TrainingJobPhase.SCALING, constants.SCALING_REASON,
+                    f"{rname.lower()} resized in place to {live} replicas; "
+                    f"survivors resharding")
+                job.status.resize_replica_name = ""
+            else:
+                # Converge stragglers (same rationale as the scaling drain).
+                for p in still:
+                    if p.metadata.deletion_timestamp is None:
+                        self.pod_control.delete_pod(p.namespace, p.name, job)
+            return
+
         # Elastic-resize drain: wait for the resized group's pods to vanish,
         # then clear the marker so the next sync recreates the group at the
         # new width with fresh rendezvous env (mirrors the restart drain).
@@ -220,6 +262,11 @@ class StatusManager:
                 job.status.restart_replica_name = ""
                 return
             scope = spec.restart_scope
+            if scope == RestartScope.RESIZE:
+                # A Resize-scope group only gets here via the width-floor
+                # fallback (pod.py _resize_keepalive returning None), which
+                # restarts the world -- drain like scope All.
+                scope = RestartScope.ALL
             rt_pods = filter_for_replica_type(pods, rname.lower())
             replicas = effective_replicas(job, rname)
             if scope == RestartScope.ALL and len(pods) == 0:
@@ -308,7 +355,9 @@ class StatusManager:
         is_running = True
         is_restarting = False
         for rtype in spec.replica_specs:
-            replicas = effective_replicas(job, rtype)
+            # Net of resize holes: a group that resized in place converges
+            # at its surviving world size, not the nominal index range.
+            replicas = live_replicas(job, rtype)
             rs = job.status.replica_statuses[rtype]
             is_scheduled = is_scheduled and (
                 rs.scheduled + rs.active + rs.succeeded + rs.failed
@@ -343,7 +392,7 @@ class StatusManager:
             job.status.scale_up_attempts = {
                 rt: n for rt, n in job.status.scale_up_attempts.items()
                 if rt in spec.replica_specs
-                and effective_replicas(job, rt) < full_width(spec.replica_specs[rt])}
+                and live_replicas(job, rt) < full_width(spec.replica_specs[rt])}
 
         if (is_creating and is_scheduled
                 and job.status.phase not in (TrainingJobPhase.RESTARTING,

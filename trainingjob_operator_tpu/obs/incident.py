@@ -11,7 +11,13 @@ abnormal transitions assembles an **incident bundle** attributing every ms
 of downtime to a named phase::
 
     detect -> teardown -> reschedule -> rendezvous -> restore -> compile
-           -> first_step       (+ ``unknown`` for evicted-ring residue)
+           -> reshard -> first_step   (+ ``unknown`` for evicted residue)
+
+An in-place resize (scope Resize, docs/ELASTIC.md) never tears the
+survivors down, so its window attributes to ``detect -> reshard ->
+first_step`` only: ``reshard`` is the survivors re-forming the mesh and
+exchanging shards peer-to-peer -- a window with ``teardown`` time in it
+means the fast path did not engage.
 
 Lifecycle mirrors the GOODPUT/TELEMETRY singletons: the controller calls
 ``on_interruption``/``on_running``/``on_complete``/``forget`` from the same
@@ -54,7 +60,7 @@ from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
 #: first post-recovery step; ``unknown`` absorbs windows whose markers were
 #: evicted from the ring.
 PHASES = ("detect", "teardown", "reschedule", "rendezvous", "restore",
-          "compile", "first_step", "unknown")
+          "compile", "reshard", "first_step", "unknown")
 
 #: Terminal phases that are incidents in their own right (spellings match
 #: api/types.py TrainingJobPhase; this module stays import-light like
@@ -68,6 +74,7 @@ _CORRECTIVE_REASONS = frozenset((
     constants.SCALING_REASON,
     constants.TERMINATING_REASON,
     constants.SUCCESSFUL_DELETE_POD_REASON,
+    constants.RESIZE_STARTED_REASON,
 ))
 
 #: Event reasons that are abnormal evidence on their own -- the earliest one
@@ -102,7 +109,7 @@ class _OpenIncident:
     def __init__(self, inc_id: int, kind: str, reason: str, scope: str,
                  started: float, trace: str) -> None:
         self.id = inc_id
-        self.kind = kind              # "restart" | "stall" | "terminal"
+        self.kind = kind              # "restart" | "resize" | "stall" | "terminal"
         self.reason = reason          # the triggering EVENT_REASONS member
         self.scope = scope            # RestartScope value, "scale", or ""
         self.started = started
@@ -164,6 +171,21 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
     corrective = [ts for ts, reason in window
                   if reason in _CORRECTIVE_REASONS]
     b_detect = _clamp(min(corrective), t0, t1c) if corrective else t0
+    if kind == "resize":
+        # Survivor-keepalive resize: nothing is torn down or rescheduled.
+        # Everything between the controller acting and the first survivor
+        # step is the peer-to-peer reshard (mesh re-form + shard exchange);
+        # the first step's own duration is first_step, as in the generic
+        # path.
+        first_steps = [s for s in steps if t1c < s[0] <= t_end]
+        if first_steps:
+            b_reshard = _clamp(t_end - first_steps[0][2] / 1e3,
+                               b_detect, t_end)
+        else:
+            b_reshard = _clamp(t1c, b_detect, t_end)
+        return [("detect", t0, b_detect),
+                ("reshard", b_detect, b_reshard),
+                ("first_step", b_reshard, t_end)]
     deletes = [ts for ts, reason in window
                if reason == constants.SUCCESSFUL_DELETE_POD_REASON]
     b_teardown = _clamp(max(deletes), b_detect, t1c) if deletes else b_detect
@@ -398,13 +420,16 @@ class IncidentRecorder:
         incident still waiting on its first post-recovery step."""
         now = time.time() if now is None else now
         emit: List[Tuple[str, str, str]] = []
+        # Spelling matches api/types.py RestartScope.RESIZE; this module
+        # stays import-light (see ABNORMAL_ENDINGS) and cannot pull types.py.
+        kind = "resize" if scope == "Resize" else "restart"
         with self._lock:
             st = self._state_locked(job)
             if st.completed:
                 return
             inc = st.open
             if inc is not None and inc.kind == "stall":
-                inc.kind = "restart"
+                inc.kind = kind
                 inc.scope = scope
                 inc.trace = inc.trace or trace
                 return
@@ -415,7 +440,7 @@ class IncidentRecorder:
                 emit = self._finalize_locked(job, st, ended=inc.running_at,
                                              close=True)
             st.seq += 1
-            st.open = _OpenIncident(st.seq, "restart", reason, scope, now,
+            st.open = _OpenIncident(st.seq, kind, reason, scope, now,
                                     trace)
         self._emit(emit)
 

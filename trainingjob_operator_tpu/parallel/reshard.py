@@ -1,0 +1,189 @@
+"""Peer-to-peer shard redistribution for in-place elastic resize.
+
+When a Resize-scope group loses a replica (docs/ELASTIC.md), the survivors
+keep their processes -- and therefore their live parameter/optimizer shards
+-- and re-form the mesh at the new width.  The shards they already hold are
+the wrong slices for the new layout, but almost all of the bytes are
+already resident: redistribution is a device-to-device exchange, not a
+checkpoint round-trip.
+
+Two layers:
+
+- **Plan arithmetic** (pure, testable): ``shard_ranges`` / ``plan_exchange``
+  model one array axis chunked jax-style (ceil division, last shard ragged)
+  across the old and new shard counts, and emit per-destination segments
+  tagged with the source shard that holds them.  A segment whose source
+  died with the lost replica is ``missing`` -- survivors cannot cover it and
+  the caller must fall back to the checkpoint (``plan.covered`` gates the
+  fast path).  With FSDP sharding the parameter axis never lives on a lost
+  host alone unless that host held the only copy, so in the common
+  dp-replicated case every segment is covered.
+- **Live executor** (``redistribute``): ``jax.device_put`` of the live
+  pytree onto the new mesh's shardings.  XLA turns the placement delta into
+  direct device-to-device copies; elements whose source and destination
+  shard coincide do not move at all (the plan's ``stationary`` share, the
+  reason wide->narrow resharding beats any restore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Segment:
+    """One contiguous run of elements destined for shard ``dst``.
+
+    ``src`` is the old shard that holds the run, or None when that shard
+    was lost with the dead replica (checkpoint fallback required).
+    """
+
+    dst: int
+    src: Optional[int]
+    start: int
+    stop: int
+
+    @property
+    def size(self) -> int:
+        return self.stop - self.start
+
+
+@dataclass(frozen=True)
+class ExchangePlan:
+    """The full segment list for one axis of one (logical) array."""
+
+    n: int
+    old_shards: int
+    new_shards: int
+    segments: Tuple[Segment, ...]
+
+    @property
+    def stationary(self) -> Tuple[Segment, ...]:
+        """Runs already resident on their destination shard: zero traffic."""
+        return tuple(s for s in self.segments
+                     if s.src is not None and s.src == s.dst)
+
+    @property
+    def moves(self) -> Tuple[Segment, ...]:
+        """Runs that cross shards: the peer-to-peer traffic."""
+        return tuple(s for s in self.segments
+                     if s.src is not None and s.src != s.dst)
+
+    @property
+    def missing(self) -> Tuple[Segment, ...]:
+        """Runs whose only source died: survivors cannot supply them."""
+        return tuple(s for s in self.segments if s.src is None)
+
+    @property
+    def covered(self) -> bool:
+        """True when the survivors hold every element of the new layout --
+        the gate for the in-place fast path (else: orbax fallback)."""
+        return not self.missing
+
+    def bytes_moved(self, itemsize: int = 4) -> int:
+        return sum(s.size for s in self.moves) * itemsize
+
+    def stats(self, itemsize: int = 4) -> Dict[str, int]:
+        return {
+            "moved_bytes": self.bytes_moved(itemsize),
+            "stationary_bytes": sum(s.size for s in self.stationary) * itemsize,
+            "missing_bytes": sum(s.size for s in self.missing) * itemsize,
+        }
+
+
+def shard_ranges(n: int, shards: int) -> List[Tuple[int, int]]:
+    """Per-shard [start, stop) element ranges, jax-style ceil chunking:
+    every shard but possibly the last holds ``ceil(n/shards)`` elements,
+    trailing shards may be empty when ``shards > n``."""
+    if n < 0 or shards <= 0:
+        raise ValueError(f"need n >= 0 and shards > 0, got n={n}, "
+                         f"shards={shards}")
+    chunk = -(-n // shards) if n else 0
+    return [(min(i * chunk, n), min((i + 1) * chunk, n))
+            for i in range(shards)]
+
+
+def plan_exchange(n: int, old_shards: int, new_shards: int,
+                  lost: Iterable[int] = ()) -> ExchangePlan:
+    """Plan the old->new redistribution of one axis of ``n`` elements.
+
+    ``lost`` are OLD shard indices that died with the resize: their runs
+    come out as ``src=None`` (missing).  The segments partition [0, n)
+    exactly -- every element of the new layout is accounted for, covered
+    or not.
+    """
+    dead = frozenset(lost)
+    old = shard_ranges(n, old_shards)
+    segments: List[Segment] = []
+    for dst, (a, b) in enumerate(shard_ranges(n, new_shards)):
+        for src, (oa, ob) in enumerate(old):
+            start, stop = max(a, oa), min(b, ob)
+            if stop > start:
+                segments.append(Segment(
+                    dst=dst, src=None if src in dead else src,
+                    start=start, stop=stop))
+    return ExchangePlan(n=n, old_shards=old_shards, new_shards=new_shards,
+                        segments=tuple(segments))
+
+
+def plan_pytree_exchange(shapes: Dict[str, Tuple[int, ...]],
+                         old_shards: int, new_shards: int,
+                         lost: Iterable[int] = (), axis: int = 0,
+                         itemsize: int = 4) -> Dict[str, Any]:
+    """Aggregate exchange plans over a pytree's leaf shapes.
+
+    ``shapes`` maps leaf path -> array shape (as the checkpoint layout
+    tool reports them); the sharded ``axis`` of each leaf is planned
+    independently, the off-axis extent scales the byte counts.  Returns
+    ``{"plans": {path: plan}, "covered": bool, "moved_bytes": int,
+    "stationary_bytes": int, "missing_bytes": int}`` -- the caller's one
+    fast-path/fallback decision plus the traffic it should expect.
+    """
+    plans: Dict[str, ExchangePlan] = {}
+    totals = {"moved_bytes": 0, "stationary_bytes": 0, "missing_bytes": 0}
+    for path, shape in sorted(shapes.items()):
+        if not shape:
+            continue
+        ax = axis if axis < len(shape) else 0
+        row = itemsize
+        for i, dim in enumerate(shape):
+            if i != ax:
+                row *= dim
+        plan = plan_exchange(shape[ax], old_shards, new_shards, lost)
+        plans[path] = plan
+        for key, value in plan.stats(row).items():
+            totals[key] += value
+    return {"plans": plans,
+            "covered": all(p.covered for p in plans.values()),
+            **totals}
+
+
+def redistribute(tree: Any, new_mesh: Any) -> Any:
+    """Device-to-device reshard of a LIVE pytree onto ``new_mesh``.
+
+    Each leaf keeps its own PartitionSpec -- the layout the sharding rules
+    chose at init -- re-fitted onto the new (narrower or wider) mesh via
+    ``fit_spec``, so one call handles params AND optimizer state without
+    re-deriving rules for optax's wrapper paths.  ``jax.device_put`` with
+    the new NamedShardings lets the runtime express the placement delta as
+    direct copies between the surviving devices -- no host staging, no
+    checkpoint round-trip.  The input tree must be fully addressable by
+    this process (single-process sim, or after the survivors'
+    re-initialize), which is exactly the state the resize loop is in when
+    it calls us.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trainingjob_operator_tpu.parallel.sharding import fit_spec
+
+    def place(leaf: Any) -> Any:
+        if not isinstance(leaf, jax.Array):
+            return leaf
+        old = leaf.sharding
+        spec = old.spec if isinstance(old, NamedSharding) else PartitionSpec()
+        fitted = fit_spec(tuple(spec), leaf.shape, new_mesh)
+        return jax.device_put(leaf, NamedSharding(new_mesh, fitted))
+
+    return jax.tree_util.tree_map(place, tree)

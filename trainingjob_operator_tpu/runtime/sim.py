@@ -123,6 +123,19 @@ class SimRuntime(PodStateRuntime):
         # (the finalize walk still owes it a ``finalize_delete``).
         self._active_cache: Dict[str, Pod] = {}
         self._nodes_cache: Dict[str, Node] = {}
+        # Incremental scheduler accounting, maintained from the same watch
+        # events: node -> [pod_count, tpu_used] and (namespace, gang) ->
+        # live member count.  The pending branch used to snapshot the FULL
+        # pod cache and rebuild both maps per tick -- O(pods) with 20k
+        # settled pods parked in the cache, the fleet harness's ~175
+        # reconciles/s ceiling (docs/FLEET.md).  Now a pending burst copies
+        # O(nodes + gangs) dicts instead.  ``_placed``/``_gang_member``
+        # remember each pod's counted contribution so MODIFIED events
+        # reconcile exactly (schedule, delete-stamp, finalize).
+        self._usage: Dict[str, list] = {}
+        self._placed: Dict[str, tuple] = {}
+        self._gang_totals: Dict[tuple, int] = {}
+        self._gang_member: Dict[str, tuple] = {}
         self._unsubs = [
             clientset.tracker.watch(Pod.KIND, self._on_pod_event),
             clientset.tracker.watch(Node.KIND, self._on_node_event),
@@ -145,6 +158,43 @@ class SimRuntime(PodStateRuntime):
             self._active_cache.pop(key, None)
         else:
             self._active_cache[key] = pod
+        self._account_pod_locked(key, pod)
+
+    def _account_pod_locked(self, key: str, pod: Optional[Pod]) -> None:
+        """Reconcile ``key``'s contribution to the usage/gang maps (pass
+        pod=None on deletion).  Placed pods occupy node capacity until they
+        are GONE (settled pods still hold their sim placement); gang
+        membership counts every live (not delete-stamped) pod carrying the
+        label -- identical semantics to the per-tick passes this replaces."""
+        old = self._placed.pop(key, None)
+        if old is not None:
+            node, tpu = old
+            entry = self._usage.get(node)
+            if entry is not None:
+                entry[0] -= 1
+                entry[1] -= tpu
+                if entry[0] <= 0:
+                    self._usage.pop(node, None)
+        if pod is not None and pod.spec.node_name:
+            tpu = self._pod_tpu_request(pod)
+            self._placed[key] = (pod.spec.node_name, tpu)
+            entry = self._usage.setdefault(pod.spec.node_name, [0, 0])
+            entry[0] += 1
+            entry[1] += tpu
+        gang_key = self._gang_member.pop(key, None)
+        if gang_key is not None:
+            left = self._gang_totals.get(gang_key, 1) - 1
+            if left > 0:
+                self._gang_totals[gang_key] = left
+            else:
+                self._gang_totals.pop(gang_key, None)
+        if pod is not None and pod.metadata.deletion_timestamp is None:
+            label = pod.metadata.labels.get(constants.GANG_LABEL)
+            if label:
+                gang_key = (pod.namespace, label)
+                self._gang_member[key] = gang_key
+                self._gang_totals[gang_key] = (
+                    self._gang_totals.get(gang_key, 0) + 1)
 
     def _on_pod_event(self, event: WatchEvent) -> None:
         pod = event.obj
@@ -153,6 +203,7 @@ class SimRuntime(PodStateRuntime):
             if event.type == DELETED:
                 self._pods_cache.pop(key, None)
                 self._active_cache.pop(key, None)
+                self._account_pod_locked(key, None)
             else:
                 self._on_pod_cached(key, pod)
 
@@ -229,39 +280,28 @@ class SimRuntime(PodStateRuntime):
 
         # Gang-aware scheduling: group pending pods by (namespace, gang); a
         # gang is placed only if every member fits simultaneously.  The
-        # usage/gang maps cost one pass over ALL pods (settled ones included
-        # -- their placements still occupy sim capacity), so the full cache
-        # is snapshotted only while something is actually pending (during
-        # churn bursts), not on every steady-state tick.
+        # usage/gang maps are maintained incrementally from watch events
+        # (``_account_pod_locked``) -- settled pods still occupy capacity
+        # but cost nothing per tick; a pending burst copies O(nodes +
+        # gangs), never O(pods).
         pending = [p for p in active
                    if p.status.phase == PodPhase.PENDING and not p.spec.node_name
                    and p.metadata.deletion_timestamp is None]
         if pending:
             with self._lock:
-                pods = list(self._pods_cache.values())
-            # node -> usage
-            pod_count: Dict[str, int] = {}
-            tpu_used: Dict[str, int] = {}
-            for pod in pods:
-                if pod.spec.node_name:
-                    pod_count[pod.spec.node_name] = pod_count.get(pod.spec.node_name, 0) + 1
-                    tpu_used[pod.spec.node_name] = (tpu_used.get(pod.spec.node_name, 0)
-                                                    + self._pod_tpu_request(pod))
+                # node -> usage (copies: _schedule_gang mutates them as it
+                # places, and a failed write must not poison the live maps)
+                pod_count = {n: u[0] for n, u in self._usage.items()}
+                tpu_used = {n: u[1] for n, u in self._usage.items()}
+                # Gang membership counts ALL live pods carrying the label,
+                # not just pending ones: a gap-filled single member of an
+                # otherwise-running gang must still be placeable (its
+                # siblings already hold nodes).
+                gang_totals = dict(self._gang_totals)
             gangs: Dict[tuple, list] = {}
             for pod in pending:
                 gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
                 gangs.setdefault((pod.namespace, gang), []).append(pod)
-            # Gang membership counts ALL live pods carrying the label, not just
-            # pending ones: a gap-filled single member of an otherwise-running
-            # gang must still be placeable (its siblings already hold nodes).
-            gang_totals: Dict[tuple, int] = {}
-            for pod in pods:
-                if pod.metadata.deletion_timestamp is not None:
-                    continue
-                label = pod.metadata.labels.get(constants.GANG_LABEL)
-                if label:
-                    key = (pod.namespace, label)
-                    gang_totals[key] = gang_totals.get(key, 0) + 1
             for key, gang_pods in gangs.items():
                 # Never place a partially OBSERVED gang: the controller creates
                 # a slice's pods over several API calls, and placing the
@@ -279,8 +319,15 @@ class SimRuntime(PodStateRuntime):
         # if one is deleted later).
         for pod, rt in self._pod_states(active):
             if pod.metadata.deletion_timestamp is not None:
-                if (rt.terminating_since is not None
-                        and now - rt.terminating_since >= self._termination_grace):
+                if rt.terminating_since is None:
+                    # The finalizer's stamp can be lost to the two-walk reap
+                    # when a tick stalls on a long event-drain (the reap then
+                    # runs against a pre-stall snapshot; see base.py).  A
+                    # kubelet re-observing a terminating pod just starts the
+                    # grace clock again -- without this the pod sits until
+                    # the GC's deletion-timestamp expiry sweep (30s).
+                    rt.terminating_since = now
+                elif now - rt.terminating_since >= self._termination_grace:
                     self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
                     self._drop_state(pod.namespace, pod.name)
                 continue

@@ -8,6 +8,16 @@ checkpoint, and keeps training -- the workload half of the operator's elastic
 resize (controller/pod.py _elastic_resize); recovery budget <90 s
 (BASELINE.md).
 
+On top of the restart path sits the IN-PLACE fast path (docs/ELASTIC.md):
+under ``restartScope: Resize`` the survivors never exit.  The controller
+republishes a bumped rendezvous generation (workloads/rendezvous.py
+GenerationWatcher), the step loop returns at the next step boundary, and
+this module re-forms the mesh at the new width, redistributes the LIVE
+parameter/optimizer shards device-to-device (parallel/reshard.py -- no
+checkpoint round-trip), rescales the batch geometry, and continues from the
+very next step.  The orbax restore only runs as a fallback when the
+survivors cannot cover a lost shard.
+
 Parallelism is the scaling-book layout: fsdp shards params/optimizer over the
 data axis (per-layer all-gathers ride ICI), tp shards heads/ffn, sp enables
 ring attention for long context (parallel/ringattention.py), dp carries
@@ -46,10 +56,16 @@ def main() -> int:
     import jax
     import numpy as np
     import optax
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from trainingjob_operator_tpu.models import llama
-    from trainingjob_operator_tpu.parallel.mesh import mesh_from_rendezvous
+    from trainingjob_operator_tpu.obs.trace import tracer_from_env
+    from trainingjob_operator_tpu.parallel import reshard
+    from trainingjob_operator_tpu.parallel.mesh import (
+        MeshSpec,
+        make_mesh,
+        mesh_from_rendezvous,
+    )
     from trainingjob_operator_tpu.parallel.sharding import (
         batch_spec,
         shard_pytree,
@@ -69,11 +85,11 @@ def main() -> int:
     sp = int(os.environ.get("LLAMA_SP", "1"))
     pp = int(os.environ.get("LLAMA_PP", "1"))
     steps = int(os.environ.get("LLAMA_STEPS", "20"))
-    global_batch = int(os.environ.get("LLAMA_BATCH", "8"))
+    batch_req = int(os.environ.get("LLAMA_BATCH", "8"))
     seq = int(os.environ.get("LLAMA_SEQ", "128"))
     lr = float(os.environ.get("LLAMA_LR", "3e-4"))
     ckpt_every = int(os.environ.get("LLAMA_CKPT_EVERY", "10"))
-    accum = int(os.environ.get("LLAMA_ACCUM", "1"))
+    accum_req = int(os.environ.get("LLAMA_ACCUM", "1"))
     # Remat defaults to "attn" for the 7B config (chip-saturating batches
     # do not fit 16 GB HBM without it; "attn" skips the quadratic
     # attention recompute at ~one [B, T, D] + lse per layer) and off for
@@ -90,73 +106,86 @@ def main() -> int:
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, sequence_parallel=sp,
                                 pipeline_parallel=pp)
     use_sp = sp > 1
+    rules = llama.sharding_rules(pipeline=pp > 1)
+    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
     print(f"elastic width {rdv.elastic_replicas}, mesh "
           f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, "
           f"{llama.num_params(cfg)/1e6:.1f}M params, restart "
           f"{rdv.restart_count}", flush=True)
 
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-    # The rounded batch must tile BOTH the data shards and the accumulation
-    # microbatches, at every elastic width; the helper sheds accumulation
-    # first so the global batch never exceeds the request.
-    global_batch, accum = train.round_global_batch(global_batch, n_data,
-                                                   accum=accum)
+    def width_build(mesh):
+        """Everything the mesh width determines: batch geometry, the jitted
+        step/eval functions, and the batch sources.  Called once at startup
+        and again after every in-place resize."""
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+        # The rounded batch must tile BOTH the data shards and the
+        # accumulation microbatches, at every elastic width; the helper
+        # sheds accumulation first so the global batch never exceeds the
+        # request.
+        global_batch, accum = train.round_global_batch(batch_req, n_data,
+                                                       accum=accum_req)
+        # Tokens are [B, seq+1] (targets shifted by one): the odd length
+        # cannot shard over sp, so the raw int tokens stay batch-sharded
+        # only -- GSPMD reshards the [B, T, D] activations onto sp at the
+        # ring attention's shard_map boundary, where the sequence split
+        # actually matters.
+        batch_sharding = NamedSharding(mesh, batch_spec(mesh))
+
+        @jax.jit
+        def step_fn(p, o, tokens):
+            def loss(p_, tb):
+                return llama.loss_fn(p_, {"tokens": tb}, cfg, mesh=mesh,
+                                     sequence_parallel=use_sp, remat=remat,
+                                     ce_chunk=ce_chunk)
+
+            l, grads = train.accumulated_value_and_grad(loss, p, tokens,
+                                                        accum)
+            updates, o = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o, l
+
+        local_batch = global_batch // max(jax.process_count(), 1)
+        batch_at, eval_batch_at, eval_every, eval_batches = (
+            train.build_batch_sources(
+                prefix="LLAMA", vocab_size=cfg.vocab_size,
+                global_batch=global_batch, local_batch=local_batch,
+                row0=rdv.process_id * local_batch, seq=seq,
+                batch_sharding=batch_sharding, synthetic_key=17))
+
+        eval_fn = None
+        if eval_batch_at is not None:
+            @jax.jit
+            def eval_loss(p, tokens):
+                # Same remat/ce_chunk as the train step: eval must fit
+                # exactly where training fits (a monolithic-logits eval
+                # would OOM at the first eval point of the config ce_chunk
+                # exists for).
+                return llama.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
+                                     sequence_parallel=use_sp, remat=remat,
+                                     ce_chunk=ce_chunk)
+
+            eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at,
+                                         eval_batches)
+        return (global_batch, accum, batch_sharding, step_fn, batch_at,
+                eval_fn, eval_every)
+
+    (global_batch, accum, batch_sharding, step_fn, batch_at,
+     eval_fn, eval_every) = width_build(mesh)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    params = shard_pytree(params, llama.sharding_rules(pipeline=pp > 1), mesh)
-    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    params = shard_pytree(params, rules, mesh)
     opt_state = tx.init(params)
     # Optimizer leaves created off-mesh (adamw's step counter) sit committed
     # on one device; replicate them on the mesh so the step signature is
     # IDENTICAL on cold start and warm resume (restore_or_init maps the same
     # leaves mesh-replicated) -- one persistent-cache entry, and the warm
     # AOT compile below hits it.
-    from jax.sharding import PartitionSpec
-
     replicated = NamedSharding(mesh, PartitionSpec())
     opt_state = jax.tree.map(
         lambda x: (jax.device_put(x, replicated)
                    if isinstance(x, jax.Array)
                    and not isinstance(x.sharding, NamedSharding) else x),
         opt_state)
-    # Tokens are [B, seq+1] (targets shifted by one): the odd length cannot
-    # shard over sp, so the raw int tokens stay batch-sharded only -- GSPMD
-    # reshards the [B, T, D] activations onto sp at the ring attention's
-    # shard_map boundary, where the sequence split actually matters.
-    batch_sharding = NamedSharding(mesh, batch_spec(mesh))
-
-    @jax.jit
-    def step_fn(p, o, tokens):
-        def loss(pp, tb):
-            return llama.loss_fn(pp, {"tokens": tb}, cfg, mesh=mesh,
-                                 sequence_parallel=use_sp, remat=remat,
-                                 ce_chunk=ce_chunk)
-
-        l, grads = train.accumulated_value_and_grad(loss, p, tokens, accum)
-        updates, o = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o, l
-
-    local_batch = global_batch // max(jax.process_count(), 1)
-    batch_at, eval_batch_at, eval_every, eval_batches = (
-        train.build_batch_sources(
-            prefix="LLAMA", vocab_size=cfg.vocab_size,
-            global_batch=global_batch, local_batch=local_batch,
-            row0=rdv.process_id * local_batch, seq=seq,
-            batch_sharding=batch_sharding, synthetic_key=17))
-
-    eval_fn = None
-    if eval_batch_at is not None:
-        @jax.jit
-        def eval_loss(p, tokens):
-            # Same remat/ce_chunk as the train step: eval must fit exactly
-            # where training fits (a monolithic-logits eval would OOM at
-            # the first eval point of the config ce_chunk exists for).
-            return llama.loss_fn(p, {"tokens": tokens}, cfg, mesh=mesh,
-                                 sequence_parallel=use_sp, remat=remat,
-                                 ce_chunk=ce_chunk)
-
-        eval_fn = train.mean_eval_fn(eval_loss, eval_batch_at, eval_batches)
 
     # Elastic resume: ONE checkpoint path shared across widths and ranks.
     # Sharded orbax save/restore -- each host writes/reads only its own
@@ -191,20 +220,29 @@ def main() -> int:
     # a small host, where an overlapped trace still competes with the
     # restore for the same cores.  Keyed on everything that shapes the
     # jaxpr/topology; any mismatch is a miss and we recompile.
-    exec_snap = ""
-    if train.resume_fastpath_enabled():
+    def snap_path(mesh, global_batch, accum):
+        """Snapshot file for a given topology + batch geometry ("" when the
+        fast path or cache dir is off).  Shared by the startup resume and
+        the post-resize re-AOT: a width this cache filer has compiled
+        before -- an earlier resize, or a prior job on equivalent topology
+        -- loads the serialized executable instead of recompiling."""
+        if not train.resume_fastpath_enabled():
+            return ""
         cache_dir = rendezvous.compile_cache_dir(rdv)
-        if cache_dir:
-            import hashlib
+        if not cache_dir:
+            return ""
+        import hashlib
 
-            desc = "|".join((jax.__version__, jax.default_backend(),
-                             str(jax.device_count()),
-                             str(tuple(mesh.devices.shape)),
-                             str(mesh.axis_names), repr(cfg), remat,
-                             str((global_batch, seq, accum, ce_chunk, lr))))
-            key = hashlib.sha256(desc.encode()).hexdigest()[:16]
-            os.makedirs(cache_dir, exist_ok=True)
-            exec_snap = os.path.join(cache_dir, f"exec-{key}.jexec")
+        desc = "|".join((jax.__version__, jax.default_backend(),
+                         str(jax.device_count()),
+                         str(tuple(mesh.devices.shape)),
+                         str(mesh.axis_names), repr(cfg), remat,
+                         str((global_batch, seq, accum, ce_chunk, lr))))
+        key = hashlib.sha256(desc.encode()).hexdigest()[:16]
+        os.makedirs(cache_dir, exist_ok=True)
+        return os.path.join(cache_dir, f"exec-{key}.jexec")
+
+    exec_snap = snap_path(mesh, global_batch, accum)
 
     def compile_fn():
         loaded = train.load_executable_snapshot(exec_snap)
@@ -239,18 +277,157 @@ def main() -> int:
     # dense-transformer estimate of 6 * params * tokens FLOPs per step
     # (fwd 2x + bwd 4x) -- feeds the controller-side MFU gauge.
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    tokens_per_step = global_batch * seq
-    params, opt_state, loss, t_start = train.run_elastic_loop(
-        step_fn=train.aot_or_jit(compiled, step_fn),
-        batch_at=batch_at, state=state, params=params,
-        opt_state=opt_state, steps=steps, start_step=start_step,
-        ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every,
-        units_per_step=tokens_per_step,
-        flops_per_step=6.0 * n_params * tokens_per_step)
+
+    # In-place resize machinery: the generation watcher (armed only when the
+    # operator injected the resize channel), the survivor world as a list of
+    # replica indices, and the device share each replica contributes to the
+    # sim's flat device pool.
+    watcher = (rendezvous.GenerationWatcher(rdv) if rdv.resize_dir else None)
+    tracer, trace_parent = tracer_from_env()
+    world = list(range(max(rdv.elastic_replicas, 1)))
+    per_replica_dev = max(len(jax.devices()) // max(len(world), 1), 1)
+    inner = tp * sp * pp
+    loop_step = train.aot_or_jit(compiled, step_fn)
+
+    def persist_and_exit(step: int) -> int:
+        state.save({"params": params, "opt_state": opt_state, "step": step},
+                   wait=True)
+        state.finalize()
+        return train.GracefulShutdown.EXIT_CODE
+
+    while True:
+        tokens_per_step = global_batch * seq
+        params, opt_state, loss, t_start = train.run_elastic_loop(
+            step_fn=loop_step, batch_at=batch_at, state=state, params=params,
+            opt_state=opt_state, steps=steps, start_step=start_step,
+            ckpt_every=ckpt_every, eval_fn=eval_fn, eval_every=eval_every,
+            units_per_step=tokens_per_step,
+            flops_per_step=6.0 * n_params * tokens_per_step,
+            resize_watch=watcher, tracer=tracer, trace_parent=trace_parent)
+        if watcher is None or watcher.pending is None:
+            break
+        doc = watcher.pending
+        watcher.pending = None
+        if jax.process_count() > 1:
+            # jax.distributed cannot re-form with fewer processes inside a
+            # live runtime today: multi-host jobs take the checkpoint
+            # baseline and let the operator restart them at the new width.
+            print("resize: multi-process fast path unavailable; "
+                  "checkpointing and exiting 143 for operator restart",
+                  flush=True)
+            return persist_and_exit(watcher.resume_step)
+        t_r0 = time.time()
+        new_world = [int(r) for r in doc["world"]]
+        lost_ranks = [i for i, r in enumerate(world)
+                      if r not in set(new_world)]
+        n_dev = int(doc.get("devices") or per_replica_dev * len(new_world))
+        if n_dev <= 0 or n_dev % inner != 0:
+            print(f"resize: {n_dev} devices not divisible by tp*sp*pp="
+                  f"{inner}; checkpointing and exiting 143", flush=True)
+            return persist_and_exit(watcher.resume_step)
+        # Host-level shard-exchange plan: the traffic estimate for the log
+        # line, and the fast-path gate -- a lost rank whose shards have no
+        # surviving copy forces the checkpoint fallback.  In the
+        # single-process sim every leaf is fully addressable, so the live
+        # arrays themselves cover everything the plan marks missing.
+        shapes = {jax.tree_util.keystr(kp): tuple(x.shape)
+                  for kp, x in jax.tree_util.tree_leaves_with_path(params)
+                  if hasattr(x, "shape") and x.shape}
+        agg = reshard.plan_pytree_exchange(shapes, len(world),
+                                           len(new_world), lost=lost_ranks)
+        addressable = all(getattr(x, "is_fully_addressable", True)
+                          for x in jax.tree_util.tree_leaves(params)
+                          if isinstance(x, jax.Array))
+        with tracer.span("resize.requod", parent=trace_parent,
+                         generation=doc["generation"],
+                         world=len(new_world), devices=n_dev):
+            data = n_dev // inner
+            dp = max(rdv.num_slices, 1)
+            if data % dp != 0:
+                dp = 1
+            new_mesh = make_mesh(
+                MeshSpec.of(dp=dp, pp=pp, fsdp=data // dp, tp=tp, sp=sp),
+                devices=jax.devices()[:n_dev])
+        t_r1 = time.time()
+        fellback = 0
+        if agg["covered"] or addressable:
+            with tracer.span("resize.reshard", parent=trace_parent,
+                             moved_bytes=agg["moved_bytes"]):
+                params = reshard.redistribute(params, new_mesh)
+                opt_state = reshard.redistribute(opt_state, new_mesh)
+                jax.block_until_ready((params, opt_state))
+            start_step = watcher.resume_step
+        else:
+            # Survivors cannot cover a lost shard: orbax fallback -- restore
+            # the last checkpoint onto the new mesh (still no process
+            # restart, but the downtime win shrinks to restore time).
+            fellback = 1
+            with tracer.span("resize.reshard", parent=trace_parent,
+                             fallback=True):
+                # The loop skipped its exit finalize on the resize path;
+                # this rung re-reads the checkpoint dir, so commit any
+                # in-flight save first (restoring mid-write would hand
+                # back the previous committed step under orbax's feet).
+                state.finalize()
+                params = shard_pytree(
+                    llama.init_params(cfg, jax.random.PRNGKey(0)), rules,
+                    new_mesh)
+                opt_state = tx.init(params)
+                rep = NamedSharding(new_mesh, PartitionSpec())
+                opt_state = jax.tree.map(
+                    lambda x: (jax.device_put(x, rep)
+                               if isinstance(x, jax.Array)
+                               and not isinstance(x.sharding, NamedSharding)
+                               else x),
+                    opt_state)
+                state = train.CheckpointState.restore_or_init(
+                    rdv, {"params": params, "opt_state": opt_state,
+                          "step": watcher.resume_step},
+                    subdir="llama", mesh=new_mesh)
+                params = state.value["params"]
+                opt_state = state.value["opt_state"]
+                start_step = int(state.value["step"])
+        t_r2 = time.time()
+        mesh = new_mesh
+        world = new_world
+        (global_batch, accum, batch_sharding, step_fn, batch_at,
+         eval_fn, eval_every) = width_build(mesh)
+        # Re-AOT at the new width through the same executable-snapshot
+        # machinery as the startup resume: a topology this cache has seen
+        # (an earlier resize cycle, or a prior job on the shared filer)
+        # deserializes the compiled step and skips trace+lower+compile;
+        # a first-seen width pays the compile once and seeds the snapshot
+        # for the next resize.
+        with tracer.span("resize.compile", parent=trace_parent,
+                         devices=n_dev):
+            snap = snap_path(mesh, global_batch, accum)
+            loaded = train.load_executable_snapshot(snap)
+            if loaded is None:
+                tok_abs2 = jax.ShapeDtypeStruct(
+                    (global_batch, seq + 1), jax.numpy.int32,
+                    sharding=batch_sharding)
+                loaded = step_fn.lower(abstract_like(params),
+                                       abstract_like(opt_state),
+                                       tok_abs2).compile()
+                train.store_executable_snapshot(snap, loaded)
+            loop_step = train.aot_or_jit(loaded, step_fn)
+        t_r3 = time.time()
+        # The resize counterpart of recovery_timing, parsed by
+        # bench_elastic_resize and tools/elastic_smoke.py.
+        print(f"resize_timing generation={doc['generation']} "
+              f"width={len(new_world)} requod_s={t_r1 - t_r0:.2f} "
+              f"reshard_s={t_r2 - t_r1:.2f} "
+              f"moved_mb={agg['moved_bytes'] / 2**20:.1f} "
+              f"fallback={fellback} compile_s={t_r3 - t_r2:.2f}",
+              flush=True)
+        print(f"resized in place: mesh "
+              f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+              f"resuming at step {start_step}", flush=True)
+
     dt = max(time.time() - (t_start or time.time()), 1e-9)
     done = max(steps - start_step - 1, 1)
     print(f"done: steps={done} tokens/s={done * global_batch * seq / dt:.0f} "
-          f"width={rdv.elastic_replicas} "
+          f"width={len(world)} "
           f"final_loss={float(loss) if loss is not None else -1:.4f} "
           f"restart_count={rdv.restart_count}", flush=True)
     return 0

@@ -9,9 +9,11 @@ JAX bootstrap set (coordinator address, process count/id, TPU topology).
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from trainingjob_operator_tpu.api import constants
 
@@ -36,6 +38,8 @@ class Rendezvous:
     slice_id: int = 0
     num_slices: int = 1
     is_reservation: bool = False
+    resize_dir: str = ""
+    rendezvous_generation: int = 0
     group_instances: Dict[str, List[str]] = field(default_factory=dict)
     group_hosts: Dict[str, List[str]] = field(default_factory=dict)
 
@@ -69,6 +73,92 @@ class Rendezvous:
         """host:port list of a replica group (after any localproc rewrite)."""
         return self.group_hosts.get(group.upper(), [])
 
+    @property
+    def generation_path(self) -> str:
+        """Where the controller republishes the rendezvous generation
+        (controller/pod.py publish_generation); "" when resize is not wired
+        for this job."""
+        return (os.path.join(self.resize_dir, "generation.json")
+                if self.resize_dir else "")
+
+
+def read_generation(path: str) -> Optional[Dict[str, Any]]:
+    """Parse a published generation doc; None on absence or garble.
+
+    The writer is atomic (tmp + os.replace) so a partial read means an
+    out-of-band scribble, not a torn write -- either way the contract is the
+    same: ignore anything that is not a well-formed doc and keep training at
+    the current generation."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if (isinstance(doc, dict)
+                and isinstance(doc.get("generation"), int)
+                and isinstance(doc.get("world"), list)
+                and doc["generation"] > 0):
+            return doc
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+class GenerationWatcher:
+    """Cheap per-step poll of the controller's generation channel.
+
+    Survivors call ``poll()`` at every step boundary; it is rate-limited to
+    ``TRAININGJOB_RESIZE_POLL_S`` (default 0.5 s) and stat-gated (a read only
+    happens when the file's mtime moved), so the steady-state cost is one
+    ``os.stat`` every poll interval.  A doc is surfaced once, and only when
+    its generation is beyond both the process's birth epoch (the injected
+    ``TRAININGJOB_RENDEZVOUS_GENERATION``) and the last surfaced doc --
+    a freshly (re)started pod never reacts to the generation it was born
+    into.
+    """
+
+    def __init__(self, rdv: Optional[Rendezvous] = None,
+                 path: Optional[str] = None,
+                 birth: Optional[int] = None,
+                 interval: Optional[float] = None) -> None:
+        if rdv is None and (path is None or birth is None):
+            rdv = from_env()
+        self.path = path if path is not None else rdv.generation_path
+        self.seen = birth if birth is not None else rdv.rendezvous_generation
+        if interval is None:
+            try:
+                interval = float(
+                    os.environ.get(constants.RESIZE_POLL_ENV, "") or 0.5)
+            except ValueError:
+                interval = 0.5
+        self.interval = max(interval, 0.0)
+        self._next_check = 0.0
+        self._mtime: Optional[float] = None
+        #: Set by train.run_elastic_loop when a poll fires mid-run: the doc
+        #: that interrupted the step loop, and the step to resume at after
+        #: the in-place reshard.
+        self.pending: Optional[Dict[str, Any]] = None
+        self.resume_step: int = 0
+
+    def poll(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """The freshest unseen generation doc, or None."""
+        if not self.path:
+            return None
+        now = time.monotonic() if now is None else now
+        if now < self._next_check:
+            return None
+        self._next_check = now + self.interval
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return None
+        if mtime == self._mtime:
+            return None
+        self._mtime = mtime
+        doc = read_generation(self.path)
+        if doc is not None and doc["generation"] > self.seen:
+            self.seen = doc["generation"]
+            return doc
+        return None
+
 
 def from_env(env: Optional[Dict[str, str]] = None) -> Rendezvous:
     e = dict(os.environ if env is None else env)
@@ -89,6 +179,9 @@ def from_env(env: Optional[Dict[str, str]] = None) -> Rendezvous:
         slice_id=int(e.get(constants.SLICE_ID_ENV, "0") or 0),
         num_slices=int(e.get(constants.NUM_SLICES_ENV, "1") or 1),
         is_reservation=e.get(constants.RESERVATION_ENV, "") == "1",
+        resize_dir=e.get(constants.RESIZE_DIR_ENV, ""),
+        rendezvous_generation=int(
+            e.get(constants.RENDEZVOUS_GENERATION_ENV, "0") or 0),
     )
     for key, value in e.items():
         if key.endswith("_INSTANCES") and not key.endswith("_NUM"):

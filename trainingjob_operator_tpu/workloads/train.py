@@ -931,7 +931,10 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                      eval_fn: Optional[Callable] = None,
                      eval_every: int = 0,
                      units_per_step: float = 0.0,
-                     flops_per_step: float = 0.0):
+                     flops_per_step: float = 0.0,
+                     resize_watch: Optional[Any] = None,
+                     tracer: Optional[Any] = None,
+                     trace_parent: str = ""):
     """The shared elastic train loop (llama_elastic / moe_pretrain):
     checkpoint every ``ckpt_every`` steps, print the first post-resume step
     (the elastic-recovery endpoint the bench keys on), honor the SIGTERM
@@ -939,6 +942,15 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
     collectives and the final ``finalize()`` commit barrier -- under
     ``peer_loss_guard`` so a peer preemption anywhere in the loop exits 143
     (restart-worthy), never a crash.
+
+    ``resize_watch`` (a ``rendezvous.GenerationWatcher``) arms the in-place
+    resize fast path: when the controller republishes a newer rendezvous
+    generation, the loop exits cleanly at the next step boundary with
+    ``resize_watch.pending`` set to the doc and ``resize_watch.resume_step``
+    to the step the caller should continue from after resharding
+    (docs/ELASTIC.md).  With ``TRAININGJOB_RESIZE_FASTPATH=0`` the signal
+    instead takes the baseline path: checkpoint and exit 143, letting the
+    operator restart the process at the new width.
 
     Returns ``(params, opt_state, loss, t_start)`` where ``t_start`` is the
     wall time after the first completed step (for throughput accounting).
@@ -953,7 +965,11 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
     # Workload half of the trace contract: enabled only when the operator
     # injected TRAININGJOB_TRACE_CONTEXT into the pod env (pod.set_env), so
     # the run span joins the trace of the reconcile that created this pod.
-    tracer, trace_parent = tracer_from_env()
+    # Callers that emit their own spans between loop invocations (the
+    # in-place resize cycle) pass their tracer in, so one instance -- and
+    # one exported trace file -- carries the whole lifetime.
+    if tracer is None:
+        tracer, trace_parent = tracer_from_env()
     loss = None
     t_start = None
     t_loop = time.time()
@@ -998,6 +1014,37 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
 
             if shutdown.requested:
                 shutdown.checkpoint_and_exit(lambda: save(i + 1, wait=True))
+            if resize_watch is not None:
+                doc = resize_watch.poll()
+                if doc is not None:
+                    # Drain the just-dispatched step before anchoring the
+                    # resize: steps dispatch async, so without this fence
+                    # the in-flight step's device time would be billed to
+                    # the resize window ("last step before" would print
+                    # before the last step finished).  Both paths below
+                    # pay this drain identically.
+                    jax.block_until_ready(loss)
+                    if (os.environ.get(constants.RESIZE_FASTPATH_ENV, "")
+                            == "0"):
+                        # Fast path disabled: the old contract -- persist
+                        # and exit 143 so the operator restarts us at the
+                        # new width.  Printed BEFORE the save so both A/B
+                        # arms of bench_elastic_resize anchor downtime at
+                        # the same loop position (last step done, resize
+                        # observed).
+                        print(f"resize: generation {doc['generation']} "
+                              f"observed at step {i+1}; fast path disabled, "
+                              f"checkpointing for operator restart",
+                              flush=True)
+                        shutdown.checkpoint_and_exit(
+                            lambda: save(i + 1, wait=True))
+                    resize_watch.pending = doc
+                    resize_watch.resume_step = i + 1
+                    print(f"resize: generation {doc['generation']} "
+                          f"(world {doc['world']}) observed at step {i+1}; "
+                          f"leaving step loop for in-place reshard",
+                          flush=True)
+                    break
             if (i + 1) % ckpt_every == 0 or i == steps - 1:
                 print(f"step {i+1}/{steps} loss {float(loss):.4f}",
                       flush=True)
@@ -1020,7 +1067,15 @@ def run_elastic_loop(*, step_fn: Callable, batch_at: Callable,
                   f"max_ms={max(stalls):.1f}", flush=True)
         profiler.close()
         jax.block_until_ready(loss)
-        state.finalize()  # commit any in-flight background save before exit
+        if resize_watch is None or resize_watch.pending is None:
+            # Commit any in-flight background save before exit.  NOT on
+            # the in-place resize exit: the survivors keep their live
+            # state, so the periodic save can finish committing in the
+            # background while they reshard -- blocking here would put a
+            # full checkpoint write inside the resize downtime window,
+            # the exact round-trip the fast path exists to avoid.  (The
+            # orbax fallback rung finalizes before it re-reads the dir.)
+            state.finalize()
     if units_per_step and t_start is not None:
         profiler.log_throughput(
             "train_done", max(steps - start_step - 1, 1), units_per_step,
