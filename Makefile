@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke
+.PHONY: lint test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -73,7 +73,14 @@ recovery-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.elastic_smoke
 
+# The continuous-batching decode service against open-loop synthetic
+# traffic (docs/SERVING.md): every request completes, zero stale-KV
+# violations (slot paging never leaks across requests), explicit
+# QueueFull backpressure, p99 token latency under a generous bound.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.serve_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke
+ci: lint test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke

@@ -234,7 +234,8 @@ def bench_train():
     result["pallas_speedup"] = (round(t_xla / t_pallas, 3) if t_xla else None)
 
     # Secondary legs ride along but never sink the headline number.
-    for name, leg in (("moe", bench_moe), ("decode", bench_decode)):
+    for name, leg in (("moe", bench_moe), ("decode", bench_decode),
+                      ("serving", bench_serving)):
         try:
             result[name] = leg(on_tpu)
         except Exception as exc:
@@ -246,13 +247,10 @@ def bench_train():
 def bench_moe(on_tpu: bool):
     """MoE train-step MFU on the active-params FLOPs basis (VERDICT r4 #3).
 
-    The PRIMARY leg runs whole-sequence routing (``router_group=0``, the
-    config default): BENCH_r05 measured grouped routing at 0.994x -- XLA
-    already fuses the dense-dispatch einsums at bench shapes, so grouping
-    buys nothing there and stays opt-in (models/moe.py).  The A/B leg
-    still measures grouped routing at the same shapes, so the crossover --
-    where the O(T^2) whole-seq dispatch starts losing -- is tracked, not
-    asserted.
+    Routing is whole-sequence, the only mode left: the ``router_group``
+    knob and its A/B were removed after BENCH_r05 measured grouped routing
+    at 0.994x (a no-op -- XLA already fuses the dense-dispatch einsums at
+    bench shapes; rationale in models/moe.py ``_moe_mlp``).
     """
     import dataclasses
 
@@ -266,13 +264,11 @@ def bench_moe(on_tpu: bool):
                             n_experts=8, experts_per_token=2,
                             max_seq_len=2048)
         batch, seq, steps = 8, 2048, 5
-        group_ab = 512
         peak = _chip_peak()
     else:
         cfg = moe.MoEConfig.tiny()
         cfg = dataclasses.replace(cfg, max_seq_len=128)
         batch, seq, steps, peak = 2, 64, 3, None
-        group_ab = 32
 
     flops = moe_train_flops_per_step(cfg, batch, seq)
     floor = flops / peak if peak else 0.0
@@ -297,31 +293,19 @@ def bench_moe(on_tpu: bool):
     result = {
         "params_m": round(moe.num_params(cfg) / 1e6, 1),
         "active_params_m": round(moe.active_params(cfg) / 1e6, 1),
-        "batch": batch, "seq": seq, "router_group": cfg.router_group,
+        "batch": batch, "seq": seq,
         "step_ms": round(t_step * 1e3, 1),
         "tokens_per_s": round(batch * seq / t_step),
         "active_tflops_per_step": round(flops / 1e12, 2),
         "mfu_pct": round(mfu, 1) if mfu is not None else None,
         "remat_policy": remat_policy,
     }
-    # A/B the (now opt-in) dispatch mitigation: grouped routing at the same
-    # shapes.  group_speedup = whole-seq time / grouped time, so > 1.0 would
-    # mean grouping pays at these shapes and the default should flip back.
-    try:
-        t_group = _timed_steps_moe(
-            dataclasses.replace(cfg, router_group=group_ab), batch, seq,
-            steps, remat=remat_policy, min_plausible_s=floor)
-        result["router_group_ab"] = group_ab
-        result["step_ms_grouped_ab"] = round(t_group * 1e3, 1)
-        result["group_speedup"] = round(t_step / t_group, 3)
-    except Exception as exc:
-        result["grouped_ab_error"] = type(exc).__name__
     return result
 
 
 def bench_decode(on_tpu: bool):
     """Serving-side numbers (VERDICT r4 #6): prefill tokens/s and per-token
-    decode latency, batch 1 and 8.
+    decode latency, with the int8 crossover table over batch 1/2/4/8.
 
     ``generate(steps)`` costs prefill + (steps-1) decode steps; timing two
     step counts isolates the two components without trusting any in-loop
@@ -337,13 +321,15 @@ def bench_decode(on_tpu: bool):
                                 n_heads=16, n_kv_heads=16, ffn_dim=6144,
                                 max_seq_len=2048)
         prompt_len, s_a, s_b = 512, 32, 96
+        batches = (1, 2, 4, 8)
     else:
         cfg = llama.LlamaConfig.tiny()
         prompt_len, s_a, s_b = 16, 4, 12
+        batches = (1, 8)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     out = {}
-    for batch in (1, 8):
+    for batch in batches:
         prompt = jax.random.randint(jax.random.PRNGKey(1),
                                     (batch, prompt_len), 0, cfg.vocab_size)
         max_len = prompt_len + s_b
@@ -376,40 +362,101 @@ def bench_decode(on_tpu: bool):
             "decode_tokens_per_s": round(batch / per_tok),
         }
         # Weight-only int8 A/B (models/quant.py): decode streams every
-        # weight per token, so int8 halves the HBM bytes that bound it --
-        # but only while the dot stays bandwidth-bound.  Past the batch
-        # gate, generate(quantize=True) IS the fp path (the gate refuses
-        # the regression BENCH_r05 measured at batch 8), so the speedup is
-        # exactly 1.0 by construction and re-timing would measure noise.
-        from trainingjob_operator_tpu.models.quant import int8_effective
-
-        if not int8_effective(batch):
-            leg["int8_gated"] = True
-            leg["decode_ms_per_token_int8"] = leg["decode_ms_per_token"]
-            leg["int8_speedup"] = 1.0
-        else:
-            try:
-                q_a, q_b = timed(s_a, quantize=True), timed(s_b,
-                                                            quantize=True)
-                q_tok = (q_b - q_a) / (s_b - s_a)
-                if q_tok > 0:
-                    leg["decode_ms_per_token_int8"] = round(q_tok * 1e3, 2)
-                    leg["int8_speedup"] = round(per_tok / q_tok, 3)
-                else:
-                    leg["int8_error"] = "timing not scaling with step count"
-            except Exception as exc:
-                leg["int8_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+        # weight per token, so int8 halves the HBM bytes that bound it.
+        # Since ``qmatmul`` fused the scale into the dot's epilogue the
+        # win holds at EVERY batch (the old dequant materialization made
+        # it REGRESS past batch 4 -- BENCH_r05 int8_speedup: 0.881 at 8);
+        # the per-batch crossover table below is the regression gate.
+        try:
+            q_a, q_b = timed(s_a, quantize=True), timed(s_b, quantize=True)
+            q_tok = (q_b - q_a) / (s_b - s_a)
+            if q_tok > 0:
+                leg["decode_ms_per_token_int8"] = round(q_tok * 1e3, 2)
+                leg["int8_speedup"] = round(per_tok / q_tok, 3)
+            else:
+                leg["int8_error"] = "timing not scaling with step count"
+        except Exception as exc:
+            leg["int8_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
         if on_tpu and leg.get("int8_speedup", 1.0) < 1.0:
-            # The gate exists so quantize=True never loses to fp; a
-            # sub-1.0 ungated point means INT8_DECODE_MAX_BATCH is wrong
-            # for this chip -- fail the bench rather than ship a lie.
+            # The whole point of scale-after-accumulate is that int8 never
+            # loses to fp; a sub-1.0 point at any batch means the fusion
+            # regressed -- fail the bench rather than ship a lie.
             # (Asserted on TPU only: CPU tiny-config decode differences
             # sit inside timer noise.)
             raise RuntimeError(
                 f"int8_speedup {leg['int8_speedup']} < 1.0 at batch "
-                f"{batch}: lower quant.INT8_DECODE_MAX_BATCH")
+                f"{batch}: the qmatmul scale-after-accumulate fusion "
+                f"has regressed")
         out[f"batch{batch}"] = leg
     return out
+
+
+def bench_serving(on_tpu: bool):
+    """Continuous batching vs static re-prefill batching, same open-loop
+    trace (workloads/serve.py; docs/SERVING.md).
+
+    Mixed output lengths are what make the win STRUCTURAL: a static batch
+    runs to its slowest member while finished rows idle, continuous
+    batching re-pages freed slots immediately.  Both arms run the same
+    fixed-shape executables, so the tokens/s ratio tracks the
+    scheduling-efficiency ratio and the >=1.5x gate is assertable on CPU
+    timer noise notwithstanding.  Greedy decode + deterministic traffic
+    also lets each arm self-check slot paging: identical requests must
+    decode identically from whatever slot they land in
+    (count_stale_kv_violations), gated at zero.
+    """
+    import jax
+
+    from trainingjob_operator_tpu.models import llama
+    from trainingjob_operator_tpu.workloads import serve
+
+    # Big enough that the batched decode step dominates per-tick dispatch
+    # overhead (tiny-config steps are dispatch-bound on CPU and would
+    # measure the Python scheduler, not the batching policy).
+    cfg = llama.LlamaConfig(vocab_size=512, dim=128, n_layers=4, n_heads=4,
+                            n_kv_heads=2, ffn_dim=256, max_seq_len=128)
+    n_requests, slots = (96, 8) if on_tpu else (64, 8)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    # Bimodal budgets (75% short completions, 25% long generations): the
+    # chat-vs-completion shape real traffic has.  One long request strands
+    # a static batch of short ones -- the straggler cost continuous
+    # batching exists to remove.
+    traffic = serve.synthetic_traffic(
+        n_requests, seed=7, rate=2.0, vocab=cfg.vocab_size,
+        prompt_lens=(4, 16), out_tokens=(2, 16),
+        long_frac=0.25, long_out_tokens=(64, 96))
+
+    result = {"requests": n_requests, "slots": slots}
+    for policy in ("continuous", "static"):
+        svc = serve.DecodeService(params, cfg, slots=slots,
+                                  prefill_chunk=16,
+                                  queue_cap=max(n_requests, 64),
+                                  policy=policy)
+        svc.warmup()  # compile outside the timed window
+        stats = serve.run_traffic(svc, traffic)["stats"]
+        if stats["stale_kv_violations"]:
+            raise RuntimeError(
+                f"{policy}: {stats['stale_kv_violations']} stale-KV "
+                f"violations -- slot paging leaked state across requests")
+        result[policy] = {
+            "aggregate_tokens_per_sec": stats["aggregate_tokens_per_sec"],
+            "token_latency_ms_p50": stats["token_latency_ms_p50"],
+            "token_latency_ms_p99": stats["token_latency_ms_p99"],
+            "ttft_ms_p50": stats["ttft_ms_p50"],
+            "scheduler_ticks": stats["steps"],
+            "completed": stats["completed_total"],
+        }
+    cont = result["continuous"]["aggregate_tokens_per_sec"]
+    stat = result["static"]["aggregate_tokens_per_sec"]
+    result["continuous_vs_static_speedup"] = round(cont / max(stat, 1e-9), 2)
+    if result["continuous_vs_static_speedup"] < 1.5:
+        # The headline claim of the serving plane; a miss means the
+        # scheduler stopped re-paging freed slots (or started stalling the
+        # batch on prefill) -- fail loudly, on every platform.
+        raise RuntimeError(
+            f"continuous batching {result['continuous_vs_static_speedup']}x "
+            f"vs static (< 1.5x): slot reuse is not delivering")
+    return result
 
 
 # ---------------------------------------------------------------------------

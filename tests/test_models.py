@@ -402,72 +402,6 @@ class TestMoE:
         assert active < total
 
 
-class TestMoERouterGroup:
-    """Grouped routing (models/moe.py router_group): linear-in-T dispatch
-    with group-local capacity; identical to whole-sequence routing when
-    capacity is ample (no drops either way)."""
-
-    def test_grouped_matches_wholeseq_when_capacity_ample(self):
-        import dataclasses
-
-        import jax
-        import jax.numpy as jnp
-
-        from trainingjob_operator_tpu.models import moe
-
-        # capacity_factor covering every possible assignment: no token can
-        # drop, so group-local capacity changes nothing.
-        base = moe.MoEConfig.tiny()
-        base = dataclasses.replace(base, capacity_factor=float(
-            base.n_experts / base.experts_per_token), dtype="float32")
-        grouped = dataclasses.replace(base, router_group=16)
-        params = moe.init_params(base, jax.random.PRNGKey(0))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
-                                    base.vocab_size)
-        logits_a, aux_a = moe.forward(params, tokens, base)
-        logits_b, aux_b = moe.forward(params, tokens, grouped)
-        np.testing.assert_allclose(np.asarray(logits_a),
-                                   np.asarray(logits_b), atol=2e-4)
-        # The aux loss estimates load balance over groups instead of the
-        # whole sequence -- same scale, not bit-identical.
-        assert abs(float(aux_a) - float(aux_b)) / float(aux_a) < 0.1
-
-    def test_grouped_trains_and_bounds_capacity(self):
-        import dataclasses
-
-        import jax
-
-        from trainingjob_operator_tpu.models import moe
-
-        cfg = dataclasses.replace(moe.MoEConfig.tiny(), router_group=16)
-        params = moe.init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0,
-                                    cfg.vocab_size)
-        loss, grads = jax.value_and_grad(lambda p: moe.loss_fn(
-            p, {"tokens": tokens}, cfg))(params)
-        assert np.isfinite(float(loss))
-        assert all(np.all(np.isfinite(np.asarray(g)))
-                   for g in jax.tree.leaves(grads))
-        # Per-group capacity is computed from the group length, not T.
-        assert moe.expert_capacity(cfg, cfg.router_group) < \
-            moe.expert_capacity(cfg, 64)
-
-    def test_indivisible_group_raises(self):
-        import dataclasses
-
-        import jax
-        import pytest as _pytest
-
-        from trainingjob_operator_tpu.models import moe
-
-        cfg = dataclasses.replace(moe.MoEConfig.tiny(), router_group=24)
-        params = moe.init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
-                                    cfg.vocab_size)
-        with _pytest.raises(ValueError, match="router_group"):
-            moe.forward(params, tokens, cfg)
-
-
 class TestMoEChunkedCE:
     def test_chunked_matches_monolithic(self):
         import jax

@@ -452,15 +452,17 @@ class TestCheckpointStallTelemetry:
 
 
 class TestInt8DecodeGate:
-    def test_gate_thresholds(self):
+    def test_effective_at_every_batch(self):
         from trainingjob_operator_tpu.models import quant
 
-        assert quant.int8_effective(1)
-        assert quant.int8_effective(quant.INT8_DECODE_MAX_BATCH)
-        assert not quant.int8_effective(quant.INT8_DECODE_MAX_BATCH + 1)
-        assert not quant.int8_effective(8)  # BENCH_r05's 0.88x regression
+        # qmatmul scales AFTER the accumulate, so the dequant epilogue is
+        # O(batch x out) and int8 pays at every batch -- including 8,
+        # BENCH_r05's old 0.88x regression that the deleted
+        # INT8_DECODE_MAX_BATCH gate papered over.
+        for batch in (1, 2, 4, 8, 64):
+            assert quant.int8_effective(batch)
 
-    def test_generate_skips_quantization_past_gate(self, monkeypatch):
+    def test_generate_quantizes_at_every_batch(self, monkeypatch):
         from trainingjob_operator_tpu.models import decode, llama, quant
 
         calls = []
@@ -470,15 +472,12 @@ class TestInt8DecodeGate:
             lambda p: (calls.append(1), real(p))[1])
         cfg = llama.LlamaConfig.tiny()
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
-        small = jnp.ones((2, 4), jnp.int32)
-        out = decode.generate(params, small, cfg, steps=2, quantize=True)
-        assert out.shape == (2, 2)
-        assert calls, "batch 2 is under the gate: int8 should engage"
-        calls.clear()
-        big = jnp.ones((8, 4), jnp.int32)
-        out = decode.generate(params, big, cfg, steps=2, quantize=True)
-        assert out.shape == (8, 2)
-        assert not calls, "batch 8 is past the gate: fp fallback"
+        for batch in (2, 8):
+            calls.clear()
+            toks = jnp.ones((batch, 4), jnp.int32)
+            out = decode.generate(params, toks, cfg, steps=2, quantize=True)
+            assert out.shape == (batch, 2)
+            assert calls, f"batch {batch}: int8 no longer gated, must engage"
 
 
 class TestSimSettledSkip:

@@ -1,0 +1,497 @@
+"""Serving plane: continuous-batching scheduler semantics, per-slot KV
+paging, telemetry/scale plumbing, and the sim e2e traffic-aware scale path.
+
+Unit layer first (scheduler driven tick by tick against a tiny llama --
+admission order, backpressure, prefill/decode interleave), then the
+decisive content checks (slot reuse must not leak KV; the serve path must
+reproduce offline ``decode.generate``), then the obs plane (serve record
+ingest, gauges, /debug/serve), then e2e: a sim ``serve`` replica group
+under queue-depth telemetry must scale out on backlog and back in when
+idle, riding the scope=Resize survivor-keepalive path (no restart-all).
+
+Content comparisons run in float32: chunked prefill and the flash prefill
+are different reduction orders, and in bf16 an exact top-2 logit tie can
+argmax differently across paths.  Within one path bf16 is deterministic;
+across paths only fp32 is exact.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from conftest import apply_jax_platform_override, wait_for
+
+apply_jax_platform_override()
+
+import jax  # noqa: E402
+
+from trainingjob_operator_tpu.models import decode, llama  # noqa: E402
+from trainingjob_operator_tpu.workloads import serve  # noqa: E402
+
+
+def _f32_tiny():
+    base = llama.LlamaConfig.tiny()
+    return llama.LlamaConfig(**{**base.__dict__, "dtype": "float32"})
+
+
+@pytest.fixture(scope="module")
+def f32_setup():
+    cfg = _f32_tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _service(params, cfg, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("queue_cap", 64)
+    return serve.DecodeService(params, cfg, **kw)
+
+
+def _run_until_done(svc, reqs, max_ticks=500):
+    done = []
+    for _ in range(max_ticks):
+        done.extend(svc.step())
+        if all(r.finished for r in reqs):
+            return done
+    raise AssertionError(f"requests did not finish in {max_ticks} ticks")
+
+
+class TestSchedulerAdmission:
+    def test_fifo_admission_and_eviction_order(self, f32_setup):
+        # 4 requests through 2 slots: r0/r1 admitted first; each freed slot
+        # goes to the NEXT queued request (r2 before r3), and completions
+        # come back shortest-budget-first within the running pair.
+        cfg, params = f32_setup
+        svc = _service(params, cfg, slots=2)
+        prompt = [1, 2, 3]
+        reqs = [svc.submit(prompt, budget, now=0.0)
+                for budget in (2, 6, 2, 2)]
+
+        done = svc.step(now=1.0)
+        assert reqs[0].slot == 0 and reqs[1].slot == 1
+        assert reqs[2].slot == -1 and reqs[3].slot == -1  # still queued
+
+        done = done + _run_until_done(svc, reqs)
+        # Waiters enter in queue order as slots free.
+        assert reqs[2].admitted <= reqs[3].admitted
+        assert 0.0 < reqs[0].admitted <= reqs[2].admitted
+        # Eviction: a request leaves the tick it finishes, so the 2-token
+        # r0 evicts before the 6-token r1 that was admitted alongside it.
+        order = [r.rid for r in done]
+        assert order.index(0) < order.index(1)
+        assert sorted(order) == [0, 1, 2, 3]
+        assert all(len(r.tokens) == r.max_new_tokens for r in reqs)
+        assert svc.completed_total == 4
+        assert all(sl.state == serve.FREE for sl in svc.slots)
+
+    def test_static_policy_gang_admission(self, f32_setup):
+        # The A/B baseline: with one slot still busy, NOTHING admits --
+        # the freed slot idles until the straggler finishes (the cost
+        # continuous batching removes, and what bench.py measures).
+        cfg, params = f32_setup
+        svc = _service(params, cfg, slots=2, policy="static")
+        short = svc.submit([1, 2], 1, now=0.0)
+        long = svc.submit([1, 2], 8, now=0.0)
+        waiter = svc.submit([1, 2], 1, now=0.0)
+
+        while not short.finished:
+            svc.step(now=1.0)
+        # short's slot is free but long still runs: waiter must NOT admit.
+        for _ in range(3):
+            svc.step(now=2.0)
+            if not long.finished:
+                assert waiter.slot == -1
+        while not long.finished:
+            svc.step(now=3.0)
+        svc.step(now=4.0)
+        assert waiter.slot != -1  # all-free batch formed
+
+    def test_queue_full_raises_and_counts(self, f32_setup):
+        cfg, params = f32_setup
+        svc = _service(params, cfg, queue_cap=3)
+        for _ in range(3):
+            svc.submit([1, 2], 1)
+        with pytest.raises(serve.QueueFull):
+            svc.submit([1, 2], 1)
+        assert svc.rejected_total == 1
+        # Backpressure is capacity-based, not permanent: draining readmits.
+        svc.step()
+        svc.submit([1, 2], 1)
+
+    def test_submit_validates_cache_fit(self, f32_setup):
+        cfg, params = f32_setup
+        svc = _service(params, cfg, max_len=16)
+        with pytest.raises(ValueError):
+            svc.submit(list(range(1, 13)), 8)  # 12 + 8 > 16
+        with pytest.raises(ValueError):
+            svc.submit([], 4)
+        with pytest.raises(ValueError):
+            svc.submit([1], 0)
+
+    def test_sliding_window_config_rejected(self, f32_setup):
+        import dataclasses
+
+        cfg, params = f32_setup
+        windowed = dataclasses.replace(cfg, sliding_window=8)
+        with pytest.raises(ValueError, match="sliding_window"):
+            serve.DecodeService(params, windowed)
+
+
+class TestPrefillDecodeInterleave:
+    def test_long_prompt_does_not_stall_decode(self, f32_setup):
+        # One request already decoding, then a LONG prompt arrives.  With
+        # chunked prefill the decoder must keep emitting one token per
+        # tick while the prompt pages in -- a scheduler that runs prefill
+        # to completion first shows a multi-tick gap here.
+        cfg, params = f32_setup
+        svc = _service(params, cfg, slots=2, prefill_chunk=4)
+        decoder = svc.submit([5, 6, 7], 24, now=0.0)
+        while not decoder.tokens:
+            svc.step(now=0.0)
+
+        long_prompt = [1 + (i % 100) for i in range(20)]  # 5 chunks
+        waiter = svc.submit(long_prompt, 2, now=0.0)
+        while waiter.slot == -1:
+            svc.step(now=0.0)
+        emitted_before = len(decoder.tokens)
+        remaining = len(long_prompt) - svc.slots[waiter.slot].prefill_pos
+        ticks = 0
+        while not waiter.tokens and ticks < 50:
+            svc.step(now=0.0)
+            ticks += 1
+        assert waiter.tokens, "prefill never completed"
+        # One chunk per tick, never more: the decode stall is bounded.
+        assert ticks == -(-remaining // svc.prefill_chunk)
+        assert ticks >= 2  # genuinely multi-tick: the interleave had teeth
+        # The decoder emitted on EVERY interleaved tick.
+        assert len(decoder.tokens) - emitted_before == ticks
+
+    def test_prefill_round_robin_is_fair(self, f32_setup):
+        # Two long prompts prefill concurrently: the round-robin cursor
+        # must alternate chunks, so both finish within one chunk-count of
+        # each other instead of one starving.
+        cfg, params = f32_setup
+        svc = _service(params, cfg, slots=2, prefill_chunk=4)
+        a = svc.submit([1 + (i % 100) for i in range(16)], 1, now=0.0)
+        b = svc.submit([2 + (i % 100) for i in range(16)], 1, now=0.0)
+        ticks_to_first = {}
+        for tick in range(40):
+            svc.step(now=0.0)
+            for name, req in (("a", a), ("b", b)):
+                if req.tokens and name not in ticks_to_first:
+                    ticks_to_first[name] = tick
+            if len(ticks_to_first) == 2:
+                break
+        assert len(ticks_to_first) == 2
+        # 4 chunks each, alternating: first tokens land 1 tick apart.
+        assert abs(ticks_to_first["a"] - ticks_to_first["b"]) <= 1
+
+
+class TestSlotPagingNoStaleKV:
+    def test_slot_reuse_two_sequence_content_check(self, f32_setup):
+        # THE paging invariant: decode request A in a fresh slot, then
+        # decode it again in a slot that just held an unrelated longer
+        # request B.  Greedy decode must produce byte-identical tokens --
+        # any divergence means reset_slot left B's K/V visible to A.
+        cfg, params = f32_setup
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+        prompt_b = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5]
+
+        fresh = _service(params, cfg, slots=1)
+        ref = fresh.submit(prompt_a, 12, now=0.0)
+        _run_until_done(fresh, [ref])
+
+        reused = _service(params, cfg, slots=1)
+        filler = reused.submit(prompt_b, 16, now=0.0)
+        again = reused.submit(prompt_a, 12, now=0.0)  # queued behind B
+        _run_until_done(reused, [filler, again])
+
+        assert again.slot == filler.slot == 0
+        assert again.tokens == ref.tokens, \
+            "slot reuse leaked stale KV into the next occupant"
+        assert filler.tokens != ref.tokens  # different request, not frozen
+
+    def test_serve_matches_offline_generate(self, f32_setup):
+        # Cross-path check: the chunked-prefill + slot-paged serve path
+        # must reproduce the offline scan-based generate exactly (fp32;
+        # both are greedy).  Catches position-offset and masking bugs the
+        # self-consistency check above cannot.
+        import jax.numpy as jnp
+
+        cfg, params = f32_setup
+        prompt = [7, 3, 11, 2, 9, 4]
+        steps = 10
+        offline = decode.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg, steps=steps)
+        svc = _service(params, cfg, slots=2, prefill_chunk=4)
+        req = svc.submit(prompt, steps, now=0.0)
+        _run_until_done(svc, [req])
+        assert req.tokens == np.asarray(offline[0]).tolist()
+
+    def test_traffic_run_has_zero_violations(self, f32_setup):
+        # The smoke-level detector over real churned traffic: repeated
+        # template prompts land in different, previously-used slots and
+        # must still decode identically.
+        cfg, params = f32_setup
+        svc = _service(params, cfg, slots=3, prefill_chunk=4)
+        traffic = serve.synthetic_traffic(
+            24, seed=3, rate=1.5, vocab=cfg.vocab_size,
+            prompt_lens=(3, 10), out_tokens=(2, 12))
+        result = serve.run_traffic(svc, traffic)
+        s = result["stats"]
+        assert s["completed_total"] == s["submitted"] > 0
+        assert s["stale_kv_violations"] == 0
+        # Distinct requests exercised distinct slots (the check had teeth).
+        assert len({r.slot for r in result["completed"]}) > 1
+
+
+class TestServeTelemetry:
+    def _agg(self):
+        from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+        from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+        from trainingjob_operator_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        return TelemetryAggregator(
+            metrics=m, goodput=GoodputTracker(metrics=m)), m
+
+    def _serve_rec(self, job="default/sj", depth=5.0, **extra):
+        rec = {"v": 1, "job": job, "rtype": "serve", "rank": 0,
+               "serve_queue_depth": depth, "serve_active_slots": 3,
+               "serve_slots": 4, "serve_p50_ms": 12.0, "serve_p99_ms": 80.0,
+               "serve_tokens_per_sec": 250.0, "serve_completed": 17}
+        rec.update(extra)
+        return rec
+
+    def test_ingest_snapshot_and_gauges(self):
+        agg, m = self._agg()
+        assert agg.ingest(self._serve_rec(), now=100.0)
+        snap = agg.serve_stats("default/sj")
+        assert snap["queue_depth"] == 5.0 and snap["at"] == 100.0
+        text = m.render_prometheus()
+        assert 'trainingjob_serve_queue_depth{job="default/sj"} 5.0' in text
+        assert 'trainingjob_serve_token_latency_ms{job="default/sj"} 80.0' \
+            in text
+        assert 'trainingjob_serve_tokens_per_sec{job="default/sj"} 250.0' \
+            in text
+        assert 'trainingjob_serve_batch_occupancy{job="default/sj"} 0.75' \
+            in text
+        # Later snapshots replace, never duplicate, the gauges.
+        assert agg.ingest(self._serve_rec(depth=0.0), now=101.0)
+        assert agg.serve_stats("default/sj")["queue_depth"] == 0.0
+        assert m.render_prometheus().count(
+            "trainingjob_serve_queue_depth{") == 1
+
+    def test_malformed_serve_records_counted(self):
+        agg, m = self._agg()
+        assert not agg.ingest(self._serve_rec(depth="nan-ish"), now=1.0)
+        assert not agg.ingest(self._serve_rec(depth=-2.0), now=1.0)
+        assert not agg.ingest(self._serve_rec(job="nonamespace"), now=1.0)
+        assert agg.serve_stats("default/sj") is None
+        assert "trainingjob_telemetry_malformed_total 3" in \
+            m.render_prometheus()
+
+    def test_forget_drops_serve_gauges(self):
+        agg, m = self._agg()
+        agg.ingest(self._serve_rec(), now=1.0)
+        agg.forget("default/sj")
+        assert agg.serve_stats("default/sj") is None
+        assert "trainingjob_serve" not in m.render_prometheus()
+
+    def test_emitter_serve_record_over_the_wire(self, monkeypatch):
+        from trainingjob_operator_tpu.api import constants
+        from trainingjob_operator_tpu.obs.telemetry import (
+            TelemetryEmitter,
+            TelemetrySink,
+        )
+
+        agg, _ = self._agg()
+        sink = TelemetrySink(aggregator=agg, publish=False).start()
+        try:
+            monkeypatch.setenv(constants.TELEMETRY_ADDR_ENV, sink.address)
+            monkeypatch.setenv(constants.JOB_NAMESPACE_ENV, "default")
+            monkeypatch.setenv(constants.JOB_NAME_ENV, "sj")
+            monkeypatch.setenv(constants.REPLICA_NAME_ENV, "serve")
+            em = TelemetryEmitter()
+            assert em.enabled
+            em.emit_serve(queue_depth=9, active_slots=4, slots=4,
+                          p50_ms=10.0, p99_ms=44.0, tokens_per_sec=123.0,
+                          completed=2)
+            em.close()
+            assert wait_for(
+                lambda: agg.serve_stats("default/sj") is not None, 5)
+            snap = agg.serve_stats("default/sj")
+            assert snap["queue_depth"] == 9.0 and snap["p99_ms"] == 44.0
+        finally:
+            sink.stop()
+
+
+class TestDebugServeEndpoint:
+    @pytest.fixture
+    def server(self):
+        from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+        from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+        from trainingjob_operator_tpu.utils.metrics import (
+            MetricsRegistry,
+            serve_metrics,
+        )
+
+        m = MetricsRegistry()
+        agg = TelemetryAggregator(metrics=m,
+                                  goodput=GoodputTracker(metrics=m))
+        agg.ingest({"v": 1, "job": "default/sj", "serve_queue_depth": 7,
+                    "serve_active_slots": 2, "serve_slots": 4,
+                    "serve_p99_ms": 33.0, "serve_tokens_per_sec": 99.0},
+                   now=50.0)
+        srv = serve_metrics(0, MetricsRegistry(), telemetry=agg)
+        yield srv.server_address[1]
+        srv.shutdown()
+
+    @staticmethod
+    def _get(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_job_snapshot_json(self, server):
+        status, body = self._get(server, "/debug/serve?job=default/sj")
+        doc = json.loads(body)
+        assert status == 200 and doc["job"] == "default/sj"
+        assert doc["serve"]["queue_depth"] == 7.0
+        assert doc["serve"]["occupancy"] == 0.5
+
+    def test_job_list_without_param(self, server):
+        status, body = self._get(server, "/debug/serve")
+        doc = json.loads(body)
+        assert status == 200 and doc == {"count": 1,
+                                         "jobs": ["default/sj"]}
+
+    def test_unknown_job_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            self._get(server, "/debug/serve?job=no/such")
+        assert exc.value.code == 404
+
+
+class TestServeScaleE2E:
+    """Queue-depth telemetry -> controller scale decision, end to end on
+    the sim cluster.  The serve group rides scope=Resize: scale-out only
+    raises the elastic width (survivors keep serving), scale-in deletes
+    the highest index -- never a restart-all."""
+
+    @pytest.fixture
+    def cluster(self):
+        from trainingjob_operator_tpu.client.clientset import Clientset
+        from trainingjob_operator_tpu.cmd.options import OperatorOptions
+        from trainingjob_operator_tpu.controller.controller import (
+            TrainingJobController,
+        )
+        from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
+        from trainingjob_operator_tpu.runtime.sim import SimRuntime
+
+        cs = Clientset()
+        tc = TrainingJobController(
+            cs, options=OperatorOptions(resync_period=0.05))
+        sim = SimRuntime(cs)
+        sim.add_node("n0")
+        sim.start()
+        tc.run(workers=2)
+        jobs = []
+        yield cs, tc, sim, jobs
+        tc.stop()
+        sim.stop()
+        for name in jobs:
+            TELEMETRY.forget(f"default/{name}")
+
+    @staticmethod
+    def _serve_job(name, replicas, queue_depth, *, max_replicas=None,
+                   active=None):
+        from trainingjob_operator_tpu.api.types import (
+            EdlPolicy,
+            ReplicaSpec,
+            RestartScope,
+            TPUTrainingJob,
+        )
+        from trainingjob_operator_tpu.core.objects import (
+            Container,
+            ContainerPort,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from trainingjob_operator_tpu.runtime.sim import (
+            RUN_SECONDS_ANNOTATION,
+            SERVE_ACTIVE_ANNOTATION,
+            SERVE_QUEUE_ANNOTATION,
+            SERVE_SLOTS_ANNOTATION,
+        )
+
+        ann = {RUN_SECONDS_ANNOTATION: "60",
+               SERVE_QUEUE_ANNOTATION: str(queue_depth),
+               SERVE_SLOTS_ANNOTATION: "4"}
+        if active is not None:
+            ann[SERVE_ACTIVE_ANNOTATION] = str(active)
+        job = TPUTrainingJob(
+            metadata=ObjectMeta(name=name, namespace="default"))
+        template = PodTemplateSpec(
+            metadata=ObjectMeta(annotations=ann),
+            spec=PodSpec(containers=[
+                Container(name="aitj-main",
+                          ports=[ContainerPort(name="aitj-7777",
+                                               container_port=7777)])]))
+        job.spec.replica_specs["serve"] = ReplicaSpec(
+            replicas=replicas, min_replicas=1, max_replicas=max_replicas,
+            template=template, edl_policy=EdlPolicy.AUTO,
+            restart_scope=RestartScope.RESIZE)
+        return job
+
+    def test_scale_out_on_backlog(self, cluster):
+        cs, tc, sim, jobs = cluster
+        jobs.append("serve-out")
+        # 32 backlogged requests >> the scale-up threshold (8): the
+        # controller must raise the elastic width toward maxReplicas and
+        # the creation loop must materialize the new index.
+        cs.trainingjobs.create(
+            self._serve_job("serve-out", 1, 32, max_replicas=3))
+
+        def scaled():
+            got = cs.trainingjobs.get("default", "serve-out")
+            return got.status.elastic_replicas.get("serve", 0) >= 2
+        assert wait_for(scaled, 15)
+        assert wait_for(lambda: len(cs.pods.list("default")) >= 2, 10)
+        got = cs.trainingjobs.get("default", "serve-out")
+        assert got.status.last_scale_times.get("serve", 0.0) > 0.0
+
+    def test_scale_in_when_idle_keeps_survivor(self, cluster):
+        from trainingjob_operator_tpu.api.types import TrainingJobPhase
+        from trainingjob_operator_tpu.controller.naming import pod_index
+
+        cs, tc, sim, jobs = cluster
+        jobs.append("serve-in")
+        # Empty queue + idle slots at width 2: shrink to the minReplicas
+        # floor by deleting the HIGHEST index; index 0 must keep its uid
+        # (survivor-keepalive -- a serving replica never restarts to
+        # shrink its group).
+        cs.trainingjobs.create(self._serve_job("serve-in", 2, 0, active=0))
+        assert wait_for(
+            lambda: cs.trainingjobs.get("default", "serve-in")
+            .status.phase == TrainingJobPhase.RUNNING, 15)
+        uid0 = {pod_index(p): p.metadata.uid
+                for p in cs.pods.list("default")
+                if "serve-in" in p.name}.get(0)
+        assert uid0 is not None
+
+        def shrunk():
+            got = cs.trainingjobs.get("default", "serve-in")
+            return got.status.elastic_replicas.get("serve") == 1
+        assert wait_for(shrunk, 15)
+        assert wait_for(lambda: len(
+            [p for p in cs.pods.list("default")
+             if "serve-in" in p.name]) == 1, 10)
+        survivor = [p for p in cs.pods.list("default")
+                    if "serve-in" in p.name][0]
+        assert pod_index(survivor) == 0
+        assert survivor.metadata.uid == uid0

@@ -42,6 +42,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from tools.analyze.findings import walk_fast
+
 #: Incremented by every real CFG construction; tests assert builds == number
 #: of distinct functions, i.e. the FileContext memo actually shares.
 BUILD_COUNT = 0
@@ -177,7 +179,7 @@ def may_raise(stmt: ast.AST) -> bool:
     if isinstance(stmt, (ast.Raise, ast.Assert)):
         return True
     for expr in stmt_expressions(stmt):
-        for node in ast.walk(expr):
+        for node in walk_fast(expr):
             if isinstance(node, (ast.Call, ast.Await, ast.Yield,
                                  ast.YieldFrom)):
                 return True
@@ -454,5 +456,5 @@ def build_cfg(func: ast.AST) -> CFG:
 
 def functions_in(tree: ast.AST) -> List[ast.AST]:
     """Every (possibly nested) function definition in a module tree."""
-    return [n for n in ast.walk(tree)
+    return [n for n in walk_fast(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]

@@ -57,27 +57,37 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
     per pass dominated the analyzer's --max-seconds budget."""
     cached = getattr(root, "_tja_local_walk", None)
     if cached is None:
+        # Inlined iter_child_nodes with hoisted locals and the fields read
+        # through ``__dict__`` (skips the descriptor machinery): this loop
+        # runs once per node of every function body per run and is a
+        # visible slice of the analyzer's wall-clock budget.
         cached = []
-        stack = [root]
-        first = True
+        isinst, AST, barriers = isinstance, ast.AST, _LOCAL_BARRIERS
+        stack = []
+        push, pop, keep = stack.append, stack.pop, cached.append
+        d = root.__dict__            # root itself: descend but do not yield
+        for name in root._fields:
+            v = d.get(name)
+            if v.__class__ is list:
+                for item in v:
+                    if isinst(item, AST):
+                        push(item)
+            elif isinst(v, AST):
+                push(v)
         while stack:
-            node = stack.pop()
-            if first:
-                first = False  # root itself: descend but do not yield
-            else:
-                cached.append(node)
-                if node.__class__ in _LOCAL_BARRIERS:
-                    continue
-            # Inlined iter_child_nodes: the generator-pair overhead per node
-            # is a visible slice of the analyzer's wall-clock budget.
+            node = pop()
+            keep(node)
+            if node.__class__ in barriers:
+                continue
+            d = node.__dict__
             for name in node._fields:
-                v = getattr(node, name, None)
+                v = d.get(name)
                 if v.__class__ is list:
                     for item in v:
-                        if isinstance(item, ast.AST):
-                            stack.append(item)
-                elif isinstance(v, ast.AST):
-                    stack.append(v)
+                        if isinst(item, AST):
+                            push(item)
+                elif isinst(v, AST):
+                    push(v)
         root._tja_local_walk = cached
     return iter(cached)
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from tools.analyze.findings import FileContext, Finding, WARNING
+from tools.analyze.findings import FileContext, Finding, WARNING, walk_fast
 from tools.analyze.runner import register
 
 LOGGING_METHODS = {"exception", "error", "warning", "critical", "info",
@@ -48,7 +48,7 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
 
 
 def _handler_is_accountable(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
+    for node in walk_fast(handler):
         if isinstance(node, ast.Raise):
             return True
         if (handler.name is not None and isinstance(node, ast.Name)

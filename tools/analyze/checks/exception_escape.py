@@ -162,16 +162,25 @@ class _Escapes:
             const[fid] = set()
             deps[fid] = []
             for node in walk_local(fn):
-                types: Set[str] = set()
-                callees: List[ast.AST] = []
-                if isinstance(node, ast.Raise):
-                    types = _raise_types(node, parents)
-                elif isinstance(node, ast.Assert):
-                    types = {"AssertionError"}
-                elif isinstance(node, ast.Call):
+                # Exact-class dispatch with no allocations on the skip
+                # path: ~95% of nodes are neither raise/assert/call, and
+                # this loop runs over every function body in the tree.
+                ncls = node.__class__
+                if ncls is ast.Call:
                     callees = self._callee_nodes(node, fn, parents,
                                                  mod_name, cls_name)
-                if not types and not callees:
+                    if not callees:
+                        continue
+                    types: Set[str] = set()
+                elif ncls is ast.Raise:
+                    types = _raise_types(node, parents)
+                    callees = []
+                    if not types:
+                        continue
+                elif ncls is ast.Assert:
+                    types = {"AssertionError"}
+                    callees = []
+                else:
                     continue
                 caught, all_caught = _caught_at(node, fn, parents)
                 if all_caught:

@@ -34,7 +34,7 @@ import ast
 from typing import Dict, Iterator, List, Set, Tuple
 
 from tools.analyze import dataflow
-from tools.analyze.findings import ERROR, FileContext, Finding
+from tools.analyze.findings import ERROR, FileContext, Finding, walk_fast
 from tools.analyze.runner import register
 from tools.analyze.checks._flow import call_dotted, functions_of, walk_local
 from tools.analyze.cfg import stmt_expressions
@@ -90,7 +90,7 @@ def _bound_names(stmt: ast.AST) -> Iterator[str]:
         yield stmt.name
         return
     for t in targets:
-        for node in ast.walk(t):
+        for node in walk_fast(t):
             if isinstance(node, ast.Name):
                 yield node.id
 
@@ -123,7 +123,7 @@ def _escaped_names(stmt: ast.AST) -> Set[str]:
 def _released_names(stmt: ast.AST) -> Set[str]:
     out: Set[str] = set()
     for expr in stmt_expressions(stmt):
-        for node in ast.walk(expr):
+        for node in walk_fast(expr):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and isinstance(node.func.value, ast.Name)
@@ -158,8 +158,10 @@ def check(ctx: FileContext) -> List[Finding]:
     findings: List[Finding] = []
     analysis = _Live()
     for fn in functions_of(ctx):
-        if not any(isinstance(n, ast.Call) and _factory_kind(n)
-                   for n in walk_local(fn)):
+        for n in walk_local(fn):
+            if n.__class__ is ast.Call and _factory_kind(n):
+                break
+        else:
             continue  # no factory anywhere: skip the CFG build entirely
         cfg = ctx.cfg(fn)
         sol = dataflow.solve(cfg, analysis)

@@ -29,7 +29,7 @@ import ast
 from typing import List, Optional, Set
 
 from tools.analyze.findings import Finding, WARNING
-from tools.analyze.findings import FileContext
+from tools.analyze.findings import FileContext, walk_fast
 from tools.analyze.runner import register
 from tools.analyze.checks._flow import (
     call_dotted, enclosing, functions_of, is_backoff_call, parents_of,
@@ -81,7 +81,7 @@ def _is_api_call(call: ast.Call) -> bool:
 
 
 def _swallows(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
+    for node in walk_fast(handler):
         if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
             return False
     return True
@@ -109,7 +109,7 @@ def check(ctx: FileContext) -> List[Finding]:
             if not isinstance(loop, ast.While):
                 continue
             if not any(isinstance(n, ast.Call) and _is_api_call(n)
-                       for b in t.body for n in ([b] + list(ast.walk(b)))):
+                       for b in t.body for n in walk_fast(b)):
                 continue
             for handler in t.handlers:
                 if not _swallows(handler) or _handler_is_timeout_only(handler):
@@ -123,7 +123,7 @@ def check(ctx: FileContext) -> List[Finding]:
                 paced = {b.bid for b in cfg.blocks
                          if any(isinstance(n, ast.Call) and is_backoff_call(n)
                                 for s in b.stmts
-                                for n in ast.walk(s))}
+                                for n in walk_fast(s))}
                 if cfg.reaches(h_entry, t_entry, blocked=paced):
                     caught = ", ".join(handler_type_names(handler))
                     findings.append(Finding(

@@ -26,7 +26,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from tools.analyze.findings import ERROR, FileContext, Finding, WARNING
+from tools.analyze.findings import (
+    ERROR, FileContext, Finding, WARNING, walk_fast,
+)
 from tools.analyze.runner import register
 
 SCOPE_DIRS = ("/models/", "/ops/", "/parallel/")
@@ -118,7 +120,7 @@ def _traced_params(fn: ast.FunctionDef, wrap: ast.Call) -> Set[str]:
 
 
 def _names_in(node: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+    return {n.id for n in walk_fast(node) if isinstance(n, ast.Name)}
 
 
 def _is_none_check(test: ast.expr) -> bool:
@@ -149,7 +151,7 @@ def check(ctx: FileContext) -> List[Finding]:
                         ast.Call)).items():
         fn = funcs[name]
         traced = _traced_params(fn, wrap)
-        for node in ast.walk(fn):
+        for node in walk_fast(fn):
             if isinstance(node, ast.If) and not _is_none_check(node.test):
                 if (isinstance(node.test, ast.Compare)
                         and _names_in(node.test) & traced):

@@ -1,10 +1,10 @@
 """On-chip perf sweep: remat policy x flash blocks x ce-chunk (Llama),
-MoE router-group sizes, and decode throughput -- the full on-chip record in
+the MoE bench config, and decode throughput -- the full on-chip record in
 one command.
 
 Run on the real TPU (no args):  python tools/tune_perf.py
 Prints one line per variant -- ms/step and MFU -- a WINNER line for the
-Llama leg, then moe_group and decode lines.  The winning settings get
+Llama leg, then MoE and decode lines.  The winning settings get
 baked into bench.py / workloads as defaults.
 
 Reuses bench.py's _timed_steps so every trial inherits its guards: the
@@ -87,11 +87,11 @@ def main():
                       "step_ms": round(t * 1e3, 1),
                       "mfu_pct": round(mfu, 1)}), flush=True)
 
-    # 4) MoE router-group sweep at the bench MoE config (active-params MFU
-    # basis) and the serving-side decode numbers -- the rest of the on-chip
-    # record (VERDICT r4 #3/#6), printed as labeled JSON lines.
-    import dataclasses
-
+    # 4) MoE timing at the bench MoE config (active-params MFU basis) and
+    # the serving-side decode numbers -- the rest of the on-chip record
+    # (VERDICT r4 #3/#6), printed as labeled JSON lines.  (The old
+    # router-group sweep died with the config knob: grouped dispatch lost
+    # its A/B once the dense-dispatch cost went linear in T by default.)
     from bench import _timed_steps_moe, bench_decode, moe_train_flops_per_step
     from trainingjob_operator_tpu.models import moe as moe_mod
 
@@ -101,18 +101,14 @@ def main():
                                 max_seq_len=2048)
     mb, mseq = 8, 2048
     mflops = moe_train_flops_per_step(moe_cfg, mb, mseq)
-    for group in (256, 512, 1024, 0):
-        cfg_g = dataclasses.replace(moe_cfg, router_group=group)
-        try:
-            t = _timed_steps_moe(cfg_g, mb, mseq, steps=3, remat="attn",
-                                 min_plausible_s=mflops / peak)
-            print(json.dumps({"moe_group": group,
-                              "step_ms": round(t * 1e3, 1),
-                              "mfu_pct": round(
-                                  mflops / t / peak * 100, 1)}), flush=True)
-        except Exception as exc:
-            print(json.dumps({"moe_group": group,
-                              "error": type(exc).__name__}), flush=True)
+    try:
+        t = _timed_steps_moe(moe_cfg, mb, mseq, steps=3, remat="attn",
+                             min_plausible_s=mflops / peak)
+        print(json.dumps({"moe_step_ms": round(t * 1e3, 1),
+                          "mfu_pct": round(
+                              mflops / t / peak * 100, 1)}), flush=True)
+    except Exception as exc:
+        print(json.dumps({"moe_error": type(exc).__name__}), flush=True)
     try:
         print(json.dumps({"decode": bench_decode(True)}), flush=True)
     except Exception as exc:

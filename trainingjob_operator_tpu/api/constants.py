@@ -170,6 +170,26 @@ RESIZE_POLL_ENV = "TRAININGJOB_RESIZE_POLL_S"
 # checkpoints and exits 143 (the restart-the-world A/B baseline that
 # bench.py's elastic_resize leg measures against).
 RESIZE_FASTPATH_ENV = "TRAININGJOB_RESIZE_FASTPATH"
+# Serving plane (workloads/serve.py, docs/SERVING.md).  Decode-batch slot
+# count (the continuous-batching batch axis), cache length override, prompt
+# prefill chunk size, bounded admission-queue capacity (QueueFull past it),
+# open-loop synthetic arrival rate (mean requests per scheduler tick),
+# total synthetic requests (0 = serve forever), and "1" for weight-only
+# int8 decode.
+SERVE_SLOTS_ENV = "TRAININGJOB_SERVE_SLOTS"
+SERVE_MAX_LEN_ENV = "TRAININGJOB_SERVE_MAX_LEN"
+SERVE_PREFILL_CHUNK_ENV = "TRAININGJOB_SERVE_PREFILL_CHUNK"
+SERVE_QUEUE_CAP_ENV = "TRAININGJOB_SERVE_QUEUE_CAP"
+SERVE_RATE_ENV = "TRAININGJOB_SERVE_RATE"
+SERVE_REQUESTS_ENV = "TRAININGJOB_SERVE_REQUESTS"
+SERVE_QUANT_ENV = "TRAININGJOB_SERVE_QUANT"
+# Traffic-aware serve scale policy (controller/pod.py _maybe_scale_serve):
+# queue depth that triggers scale-out, the depth below which an idle serve
+# replica scales back in, and the per-job cooldown seconds between scaling
+# actions (damps flapping on bursty arrivals).
+SERVE_SCALE_UP_QUEUE_ENV = "TRAININGJOB_SERVE_SCALE_UP_QUEUE"
+SERVE_SCALE_DOWN_QUEUE_ENV = "TRAININGJOB_SERVE_SCALE_DOWN_QUEUE"
+SERVE_SCALE_COOLDOWN_ENV = "TRAININGJOB_SERVE_SCALE_COOLDOWN_S"
 
 #: Env vars that are part of the contract but *user-set* (pod template or
 #: operator environment), never injected by the controller: workload tuning
@@ -202,6 +222,16 @@ USER_ENV_KNOBS = frozenset((
     HBM_SAMPLE_STEPS_ENV,
     RESIZE_POLL_ENV,
     RESIZE_FASTPATH_ENV,
+    SERVE_SLOTS_ENV,
+    SERVE_MAX_LEN_ENV,
+    SERVE_PREFILL_CHUNK_ENV,
+    SERVE_QUEUE_CAP_ENV,
+    SERVE_RATE_ENV,
+    SERVE_REQUESTS_ENV,
+    SERVE_QUANT_ENV,
+    SERVE_SCALE_UP_QUEUE_ENV,
+    SERVE_SCALE_DOWN_QUEUE_ENV,
+    SERVE_SCALE_COOLDOWN_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --

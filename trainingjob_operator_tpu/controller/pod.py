@@ -53,11 +53,21 @@ from trainingjob_operator_tpu.core.objects import (
     PodConditionType,
     PodPhase,
 )
-from trainingjob_operator_tpu.obs.telemetry import sink_address
+from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, sink_address
 from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.pod")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 
 def resize_dir(job: TPUTrainingJob) -> str:
@@ -358,9 +368,17 @@ class PodReconciler:
             getattr(self, "_gang_release_backoff", {}).pop(
                 f"{meta_namespace_key(job)}/{rtype}", None)
 
-        # Elastic re-expand: a degraded group that is stably running starts a
-        # non-destructive capacity probe after a (backed-off) delay.
-        self._maybe_start_expand_probe(job, rtype, rt, spec, replicas, rs, now)
+        # Traffic-aware serve scaling: a "serve" replica group with live
+        # serving telemetry is scaled by queue depth, not by the capacity
+        # re-expand probe (which would drag a deliberately scaled-in group
+        # back to full width against the traffic signal).
+        if not self._maybe_scale_serve(job, rtype, rt, spec, replicas,
+                                       replica_pods, now):
+            # Elastic re-expand: a degraded group that is stably running
+            # starts a non-destructive capacity probe after a (backed-off)
+            # delay.
+            self._maybe_start_expand_probe(job, rtype, rt, spec, replicas,
+                                           rs, now)
 
         if creating_msgs:
             msgs = [f"pods {pods_} {m}" for m, pods_ in creating_msgs.items()]
@@ -503,6 +521,89 @@ class PodReconciler:
             f"probing capacity to re-expand {rt} {replicas}->{full} "
             f"(attempt {attempts + 1})")
         self.enqueue_job(job)
+
+    def _maybe_scale_serve(self, job: TPUTrainingJob, rtype: str, rt: str,
+                           spec: Any, replicas: int,
+                           replica_pods: List[Pod], now: float) -> bool:
+        """Traffic-aware scale-out/in for a serving replica group
+        (docs/SERVING.md).  Returns True when this policy OWNS the group's
+        scaling (a ``serve`` group under edlPolicy Auto + restartScope
+        Resize with live serving telemetry) -- the caller then skips the
+        training-oriented re-expand probe.
+
+        Serve replicas are independent decode servers behind a shared
+        queue, so both directions ride the PR 9 survivor-keepalive
+        contract: scale-OUT just raises the elastic width (the missing-pod
+        loop creates the new index next sync; survivors keep serving,
+        never re-prefill, never re-rendezvous), scale-IN deletes the
+        highest index and lowers the width -- no drain, no restart-all.
+        Signals come from the telemetry plane's serve snapshots
+        (queue depth; p99 rides along in the event message): scale out at
+        ``TRAININGJOB_SERVE_SCALE_UP_QUEUE`` (default 8) backlogged
+        requests, back in when the queue sits at/below
+        ``TRAININGJOB_SERVE_SCALE_DOWN_QUEUE`` (default 0) with idle
+        slots.  A per-group cooldown
+        (``TRAININGJOB_SERVE_SCALE_COOLDOWN_S``, default 30) damps
+        flapping on bursty open-loop arrivals.
+        """
+        if (rt != "serve" or spec.edl_policy != EdlPolicy.AUTO
+                or spec.restart_scope != RestartScope.RESIZE):
+            return False
+        snap = TELEMETRY.serve_stats(meta_namespace_key(job))
+        if snap is None:
+            return False
+        cooldown = _env_float(constants.SERVE_SCALE_COOLDOWN_ENV, 30.0)
+        if now - snap.get("at", 0.0) > max(cooldown * 4.0, 120.0):
+            return True  # stale snapshot: own the group, but don't act
+        last = job.status.last_scale_times.get(rtype)
+        if last is not None and now - last < cooldown:
+            self.enqueue_job(job, delay=max(cooldown - (now - last), 1.0))
+            return True
+        up = _env_float(constants.SERVE_SCALE_UP_QUEUE_ENV, 8.0)
+        down = _env_float(constants.SERVE_SCALE_DOWN_QUEUE_ENV, 0.0)
+        depth = snap.get("queue_depth", 0.0)
+        full = self._full_width(spec)
+        gang = gang_size(spec)
+        if depth >= up and replicas < full:
+            new_width = min(replicas + max(gang, 1), full)
+            desired = spec.replicas if spec.replicas is not None else 1
+            if new_width == desired:
+                job.status.elastic_replicas.pop(rtype, None)
+            else:
+                job.status.elastic_replicas[rtype] = new_width
+            job.status.last_scale_times[rtype] = now
+            self.metrics.inc("trainingjob_serve_scales_total",
+                             direction="out")
+            self.recorder.event(
+                job, EventRecorder.NORMAL, constants.SCALING_REASON,
+                f"serve queue depth {depth:.0f} >= {up:.0f} "
+                f"(p99 {snap.get('p99_ms', 0.0):.1f} ms); scaling out "
+                f"{rt} {replicas}->{new_width}")
+            self.enqueue_job(job)  # next sync creates the new index
+            return True
+        idle = snap.get("active_slots", 0.0) < snap.get("slots", 0.0)
+        floor = self._resize_floor(spec)
+        if depth <= down and idle and replicas - max(gang, 1) >= floor:
+            new_width = replicas - max(gang, 1)
+            desired = spec.replicas if spec.replicas is not None else 1
+            if new_width == desired:
+                job.status.elastic_replicas.pop(rtype, None)
+            else:
+                job.status.elastic_replicas[rtype] = new_width
+            job.status.last_scale_times[rtype] = now
+            self.metrics.inc("trainingjob_serve_scales_total",
+                             direction="in")
+            self.recorder.event(
+                job, EventRecorder.NORMAL, constants.SCALING_REASON,
+                f"serve queue idle (depth {depth:.0f} <= {down:.0f}); "
+                f"scaling in {rt} {replicas}->{new_width}")
+            # Survivor-keepalive scale-in: only the highest indices go;
+            # the lowered width stops the creation loop refilling them.
+            for p in replica_pods:
+                idx = pod_index(p)
+                if idx is not None and idx >= new_width:
+                    self.pod_control.delete_pod(p.namespace, p.name, job)
+        return True
 
     def _resolve_expand_probe(self, job: TPUTrainingJob, rtype: str, rt: str,
                               replicas: int, probe_target: int,

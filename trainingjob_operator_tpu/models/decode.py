@@ -90,7 +90,12 @@ def _attend_cache(q, keys, values, t, group: int, window: int = 0):
     mode (window > 0): slot s holds position p = t - ((t - s) mod S), the
     newest position congruent to s; visible iff p >= 0 (written) and
     p > t - window (inside the band).  RoPE is applied at write time with
-    the ABSOLUTE position, so wrapped slots need no re-rotation."""
+    the ABSOLUTE position, so wrapped slots need no re-rotation.
+
+    ``t`` is a scalar (whole batch at one position -- offline ``generate``)
+    or a [B, 1, 1, 1] per-row position tensor (continuous batching:
+    ``serve_step`` rows each sit at their own position); both broadcast
+    through the same mask algebra."""
     import jax
     import jax.numpy as jnp
 
@@ -138,15 +143,18 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
 
     ``params`` may carry weight-only int8 leaves (models/quant.py
     ``quantize_weights``): decode streams every weight per token, so int8
-    halves the HBM bytes that bound decode throughput; ``_w`` resolves
-    either form and XLA fuses the dequant into the matmul operand read.
+    halves the HBM bytes that bound decode throughput; ``qmatmul``
+    contracts the int8 weight directly and applies the per-output-channel
+    scale after the accumulate, so the dequant cost is O(batch x out) --
+    it no longer regresses large batches (BENCH_r05's 0.88x at batch 8
+    came from materializing the dequantized weight per step).
     """
     import jax
     import jax.numpy as jnp
 
     from trainingjob_operator_tpu.models.quant import (
-        dequantize as _w,
         dequantize_rows,
+        qmatmul,
     )
 
     c = config
@@ -159,11 +167,11 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
     def layer_step(h, inputs):
         layer, k_cache, v_cache = inputs
         x = llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
-        q = (x @ _w(layer["attn"]["wq"], compute)).reshape(
+        q = qmatmul(x, layer["attn"]["wq"], compute).reshape(
             B, 1, c.n_heads, c.head_dim)
-        k = (x @ _w(layer["attn"]["wk"], compute)).reshape(
+        k = qmatmul(x, layer["attn"]["wk"], compute).reshape(
             B, 1, c.n_kv_heads, c.head_dim)
-        v = (x @ _w(layer["attn"]["wv"], compute)).reshape(
+        v = qmatmul(x, layer["attn"]["wv"], compute).reshape(
             B, 1, c.n_kv_heads, c.head_dim)
         q = llama._rope(q, pos, c.rope_theta)
         k = llama._rope(k, pos, c.rope_theta)
@@ -176,18 +184,200 @@ def decode_step(params, cache, token, t, config: llama.LlamaConfig, *,
             v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
         o = _attend_cache(q, k_cache, v_cache, t, group,
                           window=c.sliding_window).astype(compute)
-        h = h + o.reshape(B, 1, c.dim) @ _w(layer["attn"]["wo"], compute)
+        h = h + qmatmul(o.reshape(B, 1, c.dim), layer["attn"]["wo"], compute)
         x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
-        gate = jax.nn.silu(x @ _w(layer["mlp"]["w_gate"], compute))
-        up = x @ _w(layer["mlp"]["w_up"], compute)
-        h = h + (gate * up) @ _w(layer["mlp"]["w_down"], compute)
+        gate = jax.nn.silu(qmatmul(x, layer["mlp"]["w_gate"], compute))
+        up = qmatmul(x, layer["mlp"]["w_up"], compute)
+        h = h + qmatmul(gate * up, layer["mlp"]["w_down"], compute)
         return h, (k_cache, v_cache)
 
     h, (k_new, v_new) = jax.lax.scan(
         layer_step, h, (params["layers"], cache["k"], cache["v"]))
     h = llama._rmsnorm(h, params["final_norm"], c.norm_eps)
-    logits = (h[:, 0, :] @ _w(params["lm_head"], compute))
+    logits = qmatmul(h[:, 0, :], params["lm_head"], compute)
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def serve_step(params, cache, token, ts, config: llama.LlamaConfig, *,
+               mesh=None):
+    """One decode step for a continuous-batching slot batch: tokens [B] at
+    PER-SLOT positions ``ts`` [B] (int32) -> (logits [B, vocab], cache).
+
+    Identical layer math to ``decode_step`` with the two generalizations
+    the slot scheduler (workloads/serve.py) needs:
+
+    - each row b writes its K/V at its OWN position ts[b] (a vmapped
+      ``dynamic_update_slice`` -- one scatter along the slot axis), and
+    - causal visibility is evaluated per row (slots <= ts[b]; ring mode
+      applies the same slot->position congruence row-wise).
+
+    Free / mid-prefill rows still execute (the step is one fixed-shape
+    executable): the scheduler passes their next UNWRITTEN position, so
+    the junk K/V such a row writes lands exactly where admission or the
+    next prefill chunk will overwrite, and no row's mask can see past its
+    own ts -- slot reuse cannot leak stale KV (tests/test_serve.py pins
+    the two-sequence content check).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trainingjob_operator_tpu.models.quant import (
+        dequantize_rows,
+        qmatmul,
+    )
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B = token.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = dequantize_rows(params["tok_embed"], token, compute)[:, None, :]
+    pos = ts[:, None]                                           # [B, 1]
+    tb = ts.reshape(B, 1, 1, 1)
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = qmatmul(x, layer["attn"]["wq"], compute).reshape(
+            B, 1, c.n_heads, c.head_dim)
+        k = qmatmul(x, layer["attn"]["wk"], compute).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        v = qmatmul(x, layer["attn"]["wv"], compute).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        q = llama._rope(q, pos, c.rope_theta)
+        k = llama._rope(k, pos, c.rope_theta)
+        S = k_cache.shape[1]
+        slot = jnp.mod(ts, S) if c.sliding_window else ts       # [B]
+        write = jax.vmap(
+            lambda cc, kk, s: jax.lax.dynamic_update_slice(cc, kk, (s, 0, 0)))
+        k_cache = write(k_cache, k.astype(k_cache.dtype), slot)
+        v_cache = write(v_cache, v.astype(v_cache.dtype), slot)
+        o = _attend_cache(q, k_cache, v_cache, tb, group,
+                          window=c.sliding_window).astype(compute)
+        h = h + qmatmul(o.reshape(B, 1, c.dim), layer["attn"]["wo"], compute)
+        x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
+        gate = jax.nn.silu(qmatmul(x, layer["mlp"]["w_gate"], compute))
+        up = qmatmul(x, layer["mlp"]["w_up"], compute)
+        h = h + qmatmul(gate * up, layer["mlp"]["w_down"], compute)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = qmatmul(h[:, 0, :], params["lm_head"], compute)
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def _attend_cache_block(q, keys, values, positions, group: int):
+    """Chunked-prefill attention for ONE sequence: q [C, Hq, Dh] against
+    the full cache row [S, Hkv, Dh]; ``positions`` [C] are the queries'
+    absolute positions.  Full-causal only (slot == position, slots <=
+    position visible) -- the serving plane runs the full cache
+    (``prefill_chunk`` rejects sliding-window configs)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = q.shape[0]
+    S, Hkv, Dh = keys.shape
+    qh = q.reshape(C, Hkv, group, Dh).transpose(1, 2, 0, 3)  # [Hkv,g,C,Dh]
+    kh = keys.transpose(1, 0, 2).astype(jnp.float32)         # [Hkv,S,Dh]
+    vh = values.transpose(1, 0, 2).astype(jnp.float32)
+    scores = jnp.einsum("hgcd,hsd->hgcs", qh.astype(jnp.float32),
+                        kh) * (Dh ** -0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= positions[None, None, :, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hgcs,hsd->hgcd", probs, vh)
+    return out.transpose(2, 0, 1, 3).reshape(C, Hkv * group * Dh)
+
+
+def prefill_chunk(params, cache, tokens, slot, t0,
+                  config: llama.LlamaConfig, *, mesh=None):
+    """Prefill ONE slot with a fixed-size prompt chunk.
+
+    ``tokens`` [C] is the chunk (the LAST chunk of a prompt arrives padded
+    to the static C -- two compiled executables serve the whole plane:
+    this one and ``serve_step``); ``slot`` is the batch row, ``t0`` the
+    chunk's first absolute position.  Writes the chunk's K/V into cache
+    positions [t0, t0 + C) of that row and returns (logits [C, vocab],
+    cache); the caller reads the logit at its last VALID chunk offset and
+    ignores the padded tail -- the junk K/V the padding writes sits at
+    positions the sequence's own ``t`` has not reached, so no mask can see
+    it before the next chunk/decode overwrites it.
+
+    Requires a full-causal cache: in ring mode (sliding window) padded
+    positions would WRAP and clobber live slots.  The scheduler enforces
+    ``sliding_window == 0``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trainingjob_operator_tpu.models.quant import (
+        dequantize_rows,
+        qmatmul,
+    )
+
+    c = config
+    if c.sliding_window:
+        raise ValueError("chunked prefill requires a full-causal cache "
+                         "(sliding_window == 0): padded chunk positions "
+                         "would wrap the ring and clobber live slots")
+    compute = jnp.dtype(c.dtype)
+    C = tokens.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = dequantize_rows(params["tok_embed"], tokens, compute)[None, :, :]
+    positions = t0 + jnp.arange(C)
+    pos = positions[None, :]                                    # [1, C]
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = qmatmul(x, layer["attn"]["wq"], compute).reshape(
+            1, C, c.n_heads, c.head_dim)
+        k = qmatmul(x, layer["attn"]["wk"], compute).reshape(
+            1, C, c.n_kv_heads, c.head_dim)
+        v = qmatmul(x, layer["attn"]["wv"], compute).reshape(
+            1, C, c.n_kv_heads, c.head_dim)
+        q = llama._rope(q, pos, c.rope_theta)
+        k = llama._rope(k, pos, c.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (slot, t0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (slot, t0, 0, 0))
+        row_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, False)
+        row_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, False)
+        o = _attend_cache_block(q[0], row_k, row_v, positions,
+                                group).astype(compute)
+        h = h + qmatmul(o[None, :, :], layer["attn"]["wo"], compute)
+        x = llama._rmsnorm(h, layer["mlp_norm"], c.norm_eps)
+        gate = jax.nn.silu(qmatmul(x, layer["mlp"]["w_gate"], compute))
+        up = qmatmul(x, layer["mlp"]["w_up"], compute)
+        h = h + qmatmul(gate * up, layer["mlp"]["w_down"], compute)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = qmatmul(h[0], params["lm_head"], compute)
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def reset_slot(cache, slot):
+    """Per-slot cache paging: zero ONE batch row's K/V across all layers
+    (cache arrays are [L, B, S, Hkv, Dh]) so an admitted sequence starts
+    from a clean page.  Position masking already guarantees a new
+    occupant cannot attend the previous one's rows (its ``t`` restarts at
+    0 and every position below it is freshly written), so this is the
+    belt-AND-braces half of the no-stale-KV contract -- and what makes a
+    leak detectable as exact zeros in debugging dumps.  Survivor rows are
+    untouched: admission never re-prefills them."""
+    import jax
+    import jax.numpy as jnp
+
+    def zero_row(a):
+        upd = jnp.zeros((a.shape[0], 1) + a.shape[2:], a.dtype)
+        return jax.lax.dynamic_update_slice(a, upd, (0, slot, 0, 0, 0))
+
+    return {"k": zero_row(cache["k"]), "v": zero_row(cache["v"])}
 
 
 def _mask_logits(logits, top_k: int, top_p: float):
@@ -227,10 +417,10 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
 
     ``quantize`` runs the decode loop on weight-only int8 (models/quant.py)
     -- decode streams every weight per token, so int8 halves the HBM bytes
-    that bound its throughput.  The gate is batch-sized: past
-    ``quant.INT8_DECODE_MAX_BATCH`` rows per step the dot is no longer
-    bandwidth-bound and the dequant epilogue REGRESSES throughput (BENCH_r05
-    measured 0.88x at batch 8), so large batches silently keep fp weights.
+    that bound its throughput, at EVERY batch: ``qmatmul`` contracts the
+    int8 weight directly and scales after the accumulate, so the dequant
+    that used to regress past batch 4 (BENCH_r05 0.88x at batch 8, the old
+    ``INT8_DECODE_MAX_BATCH`` gate) is an O(batch x out) epilogue now.
     Prefill stays full-precision (one compute-bound pass over the prompt;
     also the KV cache source).  For a serving deployment that must also
     drop the fp weights from HBM, call ``quantize_weights`` once at load
@@ -256,13 +446,9 @@ def generate(params, prompt, config: llama.LlamaConfig, *, steps: int,
     logits, cache = prefill(params, prompt, config, max_len, mesh=mesh)
     step_params = params
     if quantize:
-        from trainingjob_operator_tpu.models.quant import (
-            int8_effective,
-            quantize_weights,
-        )
+        from trainingjob_operator_tpu.models.quant import quantize_weights
 
-        if int8_effective(B):
-            step_params = quantize_weights(params)
+        step_params = quantize_weights(params)
 
     def pick(logits, k):
         if temperature <= 0.0:

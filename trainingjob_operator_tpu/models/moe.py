@@ -42,20 +42,6 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     #: Sliding-window attention span (Mixtral uses 4096); 0 = full causal.
     sliding_window: int = 0
-    #: Routing group size (tokens), 0 = the whole sequence.  The dense
-    #: dispatch/combine einsums cost O(B*T*C*E*D) with C ~ T/E -- QUADRATIC
-    #: in sequence length.  Routing in groups of ``router_group`` tokens
-    #: (GShard's group dimension) bounds C by the group, making dispatch
-    #: linear in T; capacity (and hence token dropping) is then enforced
-    #: per group, which also matches how real batches arrive.
-    #:
-    #: OFF by default: BENCH_r05 measured grouped routing at 0.994x the
-    #: whole-sequence step time at bench shapes (T<=2048) -- XLA fuses the
-    #: dense-dispatch einsums well enough that the asymptotic win has not
-    #: kicked in yet, while per-group capacity drops tokens a whole-seq
-    #: capacity would have kept.  Opt in for long sequences; bench.py's
-    #: MoE leg keeps a grouped A/B so the crossover is tracked.
-    router_group: int = 0
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -184,29 +170,22 @@ def _dispatch_combine(probs, k: int, capacity: int):
 def _moe_mlp(h, layer, config: MoEConfig, compute):
     """Routed expert MLP for h [B, T, D] -> ([B, T, D], aux_loss).
 
-    With ``router_group`` g > 0 the sequence is routed in independent
-    g-token groups: fold T into the batch dim ([B, T, D] -> [B*T/g, g, D])
-    and recurse.  Capacity then scales with g, not T, so the dispatch/
-    combine einsums cost O(B*T*g*...) -- linear in sequence length --
-    instead of the O(B*T*C) ~ T^2 of whole-sequence routing.  The router
-    itself is per-token, unchanged; only the capacity budget (which tokens
-    drop under overflow) becomes group-local, the standard GShard group
-    semantics.
+    Routing is WHOLE-sequence by design.  A GShard-style ``router_group``
+    knob (fold T into the batch dim, route in g-token groups to bound
+    capacity and make dispatch linear in T) was tried and measured at
+    0.994x the whole-sequence step time at bench shapes, T <= 2048
+    (BENCH_r05 ``group_speedup``): XLA fuses the dense dispatch/combine
+    einsums well enough that the asymptotic win never materialized, while
+    per-group capacity drops tokens a whole-sequence budget would have
+    kept.  Decode never uses grouping at all (``moe_decode`` routes per
+    token, dropless), so the knob was a measured no-op and was removed
+    (docs/MIGRATION.md).  Revisit only with T >> 2048 training sequences.
     """
     import jax
     import jax.numpy as jnp
 
     c = config
     B, T, D = h.shape
-    g = c.router_group
-    if g and g < T:
-        import dataclasses
-
-        if T % g:
-            raise ValueError(f"router_group={g} does not divide seq {T}")
-        y, aux = _moe_mlp(h.reshape(B * T // g, g, D), layer,
-                          dataclasses.replace(c, router_group=0), compute)
-        return y.reshape(B, T, D), aux
     cap = expert_capacity(c, T)
 
     # Router in float32: tiny matmul, and routing decisions are precision-

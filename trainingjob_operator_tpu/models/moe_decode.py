@@ -140,6 +140,115 @@ def decode_step(params, cache, token, t, config: moe.MoEConfig, *,
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def serve_step(params, cache, token, ts, config: moe.MoEConfig, *,
+               mesh=None):
+    """Continuous-batching decode step: tokens [B] at PER-SLOT positions
+    ``ts`` [B] -> (logits [B, vocab], cache).  MoE mirror of
+    ``models.decode.serve_step`` -- per-row cache writes (vmapped
+    ``dynamic_update_slice``) and per-row causal masks; the routed MLP is
+    already per-token (``_routed_mlp_token``), so it needs no change."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    compute = jnp.dtype(c.dtype)
+    B = token.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = params["tok_embed"].astype(compute)[token][:, None, :]
+    pos = ts[:, None]
+    tb = ts.reshape(B, 1, 1, 1)
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = _llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = (x @ layer["attn"]["wq"].astype(compute)).reshape(
+            B, 1, c.n_heads, c.head_dim)
+        k = (x @ layer["attn"]["wk"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        v = (x @ layer["attn"]["wv"].astype(compute)).reshape(
+            B, 1, c.n_kv_heads, c.head_dim)
+        q = _llama._rope(q, pos, c.rope_theta)
+        k = _llama._rope(k, pos, c.rope_theta)
+        S = k_cache.shape[1]
+        slot = jnp.mod(ts, S) if c.sliding_window else ts
+        write = jax.vmap(
+            lambda cc, kk, s: jax.lax.dynamic_update_slice(cc, kk, (s, 0, 0)))
+        k_cache = write(k_cache, k.astype(k_cache.dtype), slot)
+        v_cache = write(v_cache, v.astype(v_cache.dtype), slot)
+        o = _decode._attend_cache(q, k_cache, v_cache, tb, group,
+                                  window=c.sliding_window).astype(compute)
+        h = h + o.reshape(B, 1, c.dim) @ layer["attn"]["wo"].astype(compute)
+        x = _llama._rmsnorm(h, layer["moe_norm"], c.norm_eps)
+        h = h + _routed_mlp_token(x, layer, c, compute)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = (h[:, 0, :] @ params["lm_head"].astype(compute))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def prefill_chunk(params, cache, tokens, slot, t0, config: moe.MoEConfig, *,
+                  mesh=None):
+    """Prefill ONE slot with a fixed-size chunk (MoE mirror of
+    ``models.decode.prefill_chunk``): tokens [C] at positions
+    [t0, t0 + C) -> (logits [C, vocab], cache).
+
+    The chunk's MLP routes per token via ``_routed_mlp_token`` (the chunk
+    is folded into the batch dim, [1, C, D] -> [C, 1, D]), so CHUNKED
+    prefill is dropless exactly like decode -- it sidesteps the
+    capacity-drop mismatch ``_check_capacity`` warns about in the
+    whole-prompt ``prefill`` path.  Full-causal cache only, as in the
+    Llama mirror."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    if c.sliding_window:
+        raise ValueError("chunked prefill requires a full-causal cache "
+                         "(sliding_window == 0): padded chunk positions "
+                         "would wrap the ring and clobber live slots")
+    compute = jnp.dtype(c.dtype)
+    C = tokens.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    h = params["tok_embed"].astype(compute)[tokens][None, :, :]
+    positions = t0 + jnp.arange(C)
+    pos = positions[None, :]
+
+    def layer_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = _llama._rmsnorm(h, layer["attn_norm"], c.norm_eps)
+        q = (x @ layer["attn"]["wq"].astype(compute)).reshape(
+            1, C, c.n_heads, c.head_dim)
+        k = (x @ layer["attn"]["wk"].astype(compute)).reshape(
+            1, C, c.n_kv_heads, c.head_dim)
+        v = (x @ layer["attn"]["wv"].astype(compute)).reshape(
+            1, C, c.n_kv_heads, c.head_dim)
+        q = _llama._rope(q, pos, c.rope_theta)
+        k = _llama._rope(k, pos, c.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (slot, t0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (slot, t0, 0, 0))
+        row_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, False)
+        row_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, False)
+        o = _decode._attend_cache_block(q[0], row_k, row_v, positions,
+                                        group).astype(compute)
+        h = h + o[None, :, :] @ layer["attn"]["wo"].astype(compute)
+        x = _llama._rmsnorm(h, layer["moe_norm"], c.norm_eps)
+        y = _routed_mlp_token(
+            x.reshape(C, 1, c.dim), layer, c, compute)      # [C, 1, D]
+        h = h + y.reshape(1, C, c.dim)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], cache["k"], cache["v"]))
+    h = _llama._rmsnorm(h, params["final_norm"], c.norm_eps)
+    logits = (h[0] @ params["lm_head"].astype(compute))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def generate(params, prompt, config: moe.MoEConfig, *, steps: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 0.0, key=None, mesh=None):

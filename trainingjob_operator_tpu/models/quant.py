@@ -24,21 +24,22 @@ from typing import Any, Dict
 #: Matmul-weight leaf names (quantize per output channel = axis -2 kept).
 _MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
-#: Largest decode batch where weight-only int8 still wins.  The win comes
-#: from halving the weight bytes each decode step streams; the cost is the
-#: per-element ``int8 -> compute`` convert + scale multiply, which grows
-#: with batch while the weight read is batch-invariant.  BENCH_r05 measured
-#: the crossover between batch 1 (1.28x) and batch 8 (0.88x -- a
-#: REGRESSION: at that arithmetic intensity the dot leaves the
-#: bandwidth-bound regime and the dequant epilogue is pure overhead).
-INT8_DECODE_MAX_BATCH = 4
-
 
 def int8_effective(batch: int) -> bool:
     """True when weight-only int8 is expected to pay for itself at this
-    decode batch size; callers fall back to fp weights otherwise
-    (models/decode.py ``generate(quantize=...)``)."""
-    return batch <= INT8_DECODE_MAX_BATCH
+    decode batch size.
+
+    Historically gated at batch <= 4: the old path MATERIALIZED the
+    dequantized weight (``dequantize``) before the dot, an O(in x out)
+    convert+multiply whose cost is batch-invariant while the bandwidth win
+    it buys shrinks with batch -- BENCH_r05 measured 1.28x at batch 1
+    degrading to 0.88x at batch 8.  ``qmatmul`` removed that term: the dot
+    contracts the int8 weight directly and the per-output-channel scale is
+    applied AFTER the accumulate, an O(batch x out) epilogue.  The weight
+    read stays int8 (the bandwidth win) at every batch, so the gate is
+    now unconditional; the function survives as the single place callers
+    ask the question (bench.py pins ``int8_speedup >= 1.0`` per batch)."""
+    return batch >= 1
 
 
 def _quantize_leaf(w, axis: int):
@@ -71,10 +72,34 @@ def quantize_weights(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def dequantize(leaf, compute):
-    """``{"q", "s"}`` (or a plain array) -> a ``compute``-dtype array."""
+    """``{"q", "s"}`` (or a plain array) -> a ``compute``-dtype array.
+
+    Materializes the FULL weight -- an O(in x out) convert whose cost does
+    not amortize at larger decode batches (the BENCH_r05 batch-8
+    regression).  Matmul call sites should use ``qmatmul`` instead; this
+    survives for non-contraction uses (error metrics, tests)."""
     if isinstance(leaf, dict) and "q" in leaf:
         return (leaf["q"].astype(compute) * leaf["s"].astype(compute))
     return leaf.astype(compute)
+
+
+def qmatmul(x, leaf, compute):
+    """``x @ leaf`` with dequantization fused AFTER the accumulate.
+
+    The per-OUTPUT-channel scale commutes with the contraction
+    (``x @ (q * s) == (x @ q) * s`` when ``s`` is constant along the
+    reduced axis), so the dot contracts the int8 weight directly -- the
+    HBM read stays int8 at any batch -- and the scale multiply becomes an
+    O(batch x out) epilogue instead of the O(in x out) weight
+    materialization that made int8 REGRESS past batch 4 (BENCH_r05
+    ``int8_speedup: 0.881``).  Plain (fp) leaves take the ordinary dot."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        y = x @ leaf["q"].astype(compute)
+        s = leaf["s"]
+        # Scale is stored keepdims over the reduced axis ([..., 1, out]);
+        # drop that axis so it broadcasts against y's [..., out].
+        return y * s.reshape(s.shape[:-2] + s.shape[-1:]).astype(compute)
+    return x @ leaf.astype(compute)
 
 
 def dequantize_rows(leaf, idx, compute):

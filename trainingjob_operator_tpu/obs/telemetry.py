@@ -170,7 +170,7 @@ class _ReplicaState:
 
 class _JobTelemetry:
     __slots__ = ("replicas", "suspended", "completed", "peak_flops",
-                 "gauges", "status_cache", "status_cache_at")
+                 "gauges", "status_cache", "status_cache_at", "serve")
 
     def __init__(self) -> None:
         self.replicas: Dict[Tuple[str, int], _ReplicaState] = {}
@@ -180,6 +180,11 @@ class _JobTelemetry:
         self.gauges: List[Tuple[str, Dict[str, str]]] = []
         self.status_cache = ""
         self.status_cache_at = 0.0
+        #: Latest serving-plane snapshot (workloads/serve.py emit_serve);
+        #: None until the job's first serve record.  Survives
+        #: on_interruption on purpose: a Resize drain keeps serve
+        #: survivors running, and the scale policy needs continuity.
+        self.serve: Optional[Dict[str, float]] = None
 
 
 class TelemetryAggregator:
@@ -248,6 +253,42 @@ class TelemetryAggregator:
             if self._incidents is not None:
                 self._incidents.record_resume(job, restore_ms, compile_ms,
                                               overlapped, now=now)
+            return True
+        if isinstance(record, dict) and "serve_queue_depth" in record:
+            # Serving-plane snapshot (workloads/serve.py): queue depth,
+            # occupancy, latency percentiles -- no step/ms fields, so
+            # detect it BEFORE step validation, like resume spans.  Feeds
+            # the serve gauges, /debug/serve, and the controller's
+            # traffic-aware scale policy (pod._maybe_scale_serve).
+            try:
+                job = str(record["job"])
+                snap = {
+                    "queue_depth": float(record["serve_queue_depth"]),
+                    "active_slots": float(record.get("serve_active_slots", 0)),
+                    "slots": float(record.get("serve_slots", 0)),
+                    "p50_ms": float(record.get("serve_p50_ms", 0.0)),
+                    "p99_ms": float(record.get("serve_p99_ms", 0.0)),
+                    "tokens_per_sec": float(
+                        record.get("serve_tokens_per_sec", 0.0)),
+                    "completed": float(record.get("serve_completed", 0)),
+                }
+            except (TypeError, KeyError, ValueError):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            if "/" not in job or snap["queue_depth"] < 0.0:
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            snap["at"] = now
+            with self._lock:
+                jt = self._jobs.get(job)
+                if jt is None:
+                    jt = self._jobs[job] = _JobTelemetry()
+                if jt.completed:
+                    return True
+                first = jt.serve is None
+                jt.serve = snap
+                if first:
+                    self._register_serve_gauges_locked(job, jt)
             return True
         try:
             job = str(record["job"])
@@ -362,6 +403,37 @@ class TelemetryAggregator:
                                labels: Dict[str, str]) -> None:
         self._metrics.gauge(name, fn, **labels)
         jt.gauges.append((name, labels))
+
+    def _register_serve_gauges_locked(self, job: str,
+                                      jt: _JobTelemetry) -> None:
+        """Serving-plane gauges, registered on the job's first serve
+        record.  Lazy like the MFU gauge: training-only jobs never show
+        zero-valued serve series."""
+        def snap_field(j: str, key: str) -> Callable[[], float]:
+            def read() -> float:
+                s = self.serve_stats(j)
+                return float(s[key]) if s else 0.0
+            return read
+
+        self._register_gauge_locked(
+            job, jt, "trainingjob_serve_queue_depth",
+            snap_field(job, "queue_depth"), {"job": job})
+        self._register_gauge_locked(
+            job, jt, "trainingjob_serve_token_latency_ms",
+            snap_field(job, "p99_ms"), {"job": job})
+        self._register_gauge_locked(
+            job, jt, "trainingjob_serve_tokens_per_sec",
+            snap_field(job, "tokens_per_sec"), {"job": job})
+
+        def occupancy(j: str = job) -> float:
+            s = self.serve_stats(j)
+            if not s or not s.get("slots"):
+                return 0.0
+            return s["active_slots"] / s["slots"]
+
+        self._register_gauge_locked(
+            job, jt, "trainingjob_serve_batch_occupancy",
+            occupancy, {"job": job})
 
     # -- lifecycle hooks (controller/status machine) --------------------------
 
@@ -497,6 +569,17 @@ class TelemetryAggregator:
             if mid <= 0.0:
                 return 0.0
             return medians[-1] / mid
+
+    def serve_stats(self, job: str) -> Optional[Dict[str, float]]:
+        """Latest serving snapshot (queue_depth, active_slots, slots,
+        p50_ms, p99_ms, tokens_per_sec, completed, at) or None for a job
+        that never served.  The scale policy and ``/debug/serve`` read
+        this; ``at`` lets callers judge staleness."""
+        with self._lock:
+            jt = self._jobs.get(job)
+            if jt is None or jt.serve is None:
+                return None
+            return dict(jt.serve)
 
     def stalled_count(self, job: str) -> int:
         with self._lock:
@@ -782,6 +865,24 @@ class TelemetryEmitter:
             "resume_restore_ms": round(restore_ms, 3),
             "resume_compile_ms": round(compile_ms, 3),
             "resume_overlapped": overlapped, "ts": time.time(),
+        })
+
+    def emit_serve(self, queue_depth: int, active_slots: int, slots: int,
+                   p50_ms: float, p99_ms: float, tokens_per_sec: float,
+                   completed: int) -> None:
+        """Serving-plane snapshot (workloads/serve.py, every emit_every
+        scheduler ticks): queue depth and latency percentiles are the
+        signals the controller's traffic-aware scale policy acts on."""
+        if not self.enabled or time.monotonic() < self._down_until:
+            return
+        self._send({
+            "v": 1, "job": self.job, "rtype": self.rtype, "rank": self.rank,
+            "serve_queue_depth": queue_depth,
+            "serve_active_slots": active_slots, "serve_slots": slots,
+            "serve_p50_ms": round(p50_ms, 3),
+            "serve_p99_ms": round(p99_ms, 3),
+            "serve_tokens_per_sec": round(tokens_per_sec, 2),
+            "serve_completed": completed, "ts": time.time(),
         })
 
     def _send(self, record: Dict[str, Any]) -> None:

@@ -73,6 +73,17 @@ CKPT_MS_ANNOTATION = "sim.tpu.trainingjob.dev/ckpt-ms"
 HBM_BYTES_ANNOTATION = "sim.tpu.trainingjob.dev/hbm-bytes"
 RESTORE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/restore-ms"
 COMPILE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/compile-ms"
+#: Serving-plane synthesis: a Running pod with serve-queue-depth set
+#: "serves", pushing one serve snapshot per kubelet tick (the records a
+#: real workloads/serve.py DecodeService emits).  Queue depth is the
+#: signal the controller's traffic-aware scale policy acts on, so a churn
+#: script annotates depth above/below the scale thresholds to drive
+#: scale-out/in end-to-end without running a model.
+SERVE_QUEUE_ANNOTATION = "sim.tpu.trainingjob.dev/serve-queue-depth"
+SERVE_SLOTS_ANNOTATION = "sim.tpu.trainingjob.dev/serve-slots"
+SERVE_ACTIVE_ANNOTATION = "sim.tpu.trainingjob.dev/serve-active-slots"
+SERVE_P99_ANNOTATION = "sim.tpu.trainingjob.dev/serve-p99-ms"
+SERVE_TPS_ANNOTATION = "sim.tpu.trainingjob.dev/serve-tokens-per-sec"
 
 #: Step records synthesized per pod per tick, at most (a pod "catching up"
 #: after a long scheduler pause must not flood the aggregator's window).
@@ -365,6 +376,7 @@ class SimRuntime(PodStateRuntime):
 
             elif pod.status.phase == PodPhase.RUNNING and rt.frozen_on == "":
                 self._synthesize_steps(pod, rt, now)
+                self._synthesize_serve(pod, now)
 
             if (pod.status.phase == PodPhase.RUNNING
                     and rt.will_exit_at is not None and now >= rt.will_exit_at):
@@ -456,6 +468,39 @@ class SimRuntime(PodStateRuntime):
             TELEMETRY.ingest(record, now=now)
             rt.steps_reported += 1
             budget -= 1
+
+    def _synthesize_serve(self, pod: Pod, now: float) -> None:
+        """Push the serve snapshot a real DecodeService would have emitted
+        (one per tick, naturally throttled by the kubelet cadence)."""
+        ann = pod.metadata.annotations
+        depth_raw = ann.get(SERVE_QUEUE_ANNOTATION)
+        if not depth_raw:
+            return
+        try:
+            depth = float(depth_raw)
+            slots = float(ann.get(SERVE_SLOTS_ANNOTATION, "4"))
+            # Unset active-slots defaults to the natural reading: a backed-
+            # up queue means a full batch, an empty one means idle slots.
+            active = float(ann.get(SERVE_ACTIVE_ANNOTATION,
+                                   str(slots if depth > 0 else 0.0)))
+            p99 = float(ann.get(SERVE_P99_ANNOTATION, "0"))
+            tps = float(ann.get(SERVE_TPS_ANNOTATION, "0"))
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+        except ValueError:
+            return  # malformed script annotations: no telemetry
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL, "")
+        if not job_name:
+            return
+        TELEMETRY.ingest({
+            "v": 1, "job": f"{pod.namespace}/{job_name}",
+            "rtype": pod.metadata.labels.get(constants.REPLICA_NAME_LABEL,
+                                             "serve"),
+            "rank": rank, "serve_queue_depth": depth,
+            "serve_active_slots": active, "serve_slots": slots,
+            "serve_p99_ms": p99, "serve_tokens_per_sec": tps,
+            "serve_completed": 0, "ts": now,
+        }, now=now)
 
     def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
         placements = []

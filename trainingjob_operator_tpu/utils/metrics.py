@@ -235,6 +235,28 @@ def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     return 200, "application/json", json.dumps(table, indent=2)
 
 
+def _render_serve(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/serve: the latest
+    serving-plane snapshot (queue depth, batch occupancy, token-latency
+    percentiles, tokens/s) for ?job=<namespace/name>, or the list of jobs
+    that have ever served when no job is given.  Unknown / never-served
+    job -> 404."""
+    job = params.get("job", [""])[0]
+    if not job:
+        jobs = [j for j in telemetry.jobs()
+                if telemetry.serve_stats(j) is not None]
+        return 200, "application/json", json.dumps(
+            {"count": len(jobs), "jobs": jobs}, indent=2)
+    snap = telemetry.serve_stats(job)
+    if snap is None:
+        return 404, "text/plain", ""
+    slots = snap.get("slots") or 0.0
+    snap["occupancy"] = (round(snap.get("active_slots", 0.0) / slots, 3)
+                         if slots else 0.0)
+    return 200, "application/json", json.dumps(
+        {"job": job, "serve": snap}, indent=2)
+
+
 def _render_incidents(incidents,
                       params: Dict[str, List[str]]) -> Tuple[int, str, str]:
     """(status, content-type, body) for /debug/incidents: the per-job list
@@ -277,9 +299,9 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                   ready_fn: Optional[Callable[[], bool]] = None,
                   telemetry=None, incidents=None):
     """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
-    /debug/threads, /debug/traces, /debug/events, /debug/steps and
-    /debug/incidents on a daemon thread; ``.shutdown()`` stops it and closes
-    the socket.
+    /debug/threads, /debug/traces, /debug/events, /debug/steps,
+    /debug/serve and /debug/incidents on a daemon thread; ``.shutdown()``
+    stops it and closes the socket.
 
     - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
     - ``events_fn``: zero-arg callable returning Event objects (e.g.
@@ -287,7 +309,7 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
     - ``ready_fn``: informer-synced gate for /readyz -- 503 until it returns
       truthy.  Omitted -> always ready (no controller to wait for).
     - ``telemetry``: an obs.telemetry.TelemetryAggregator; enables
-      /debug/steps (404 without).
+      /debug/steps and /debug/serve (404 without).
     - ``incidents``: an obs.incident.IncidentRecorder; enables
       /debug/incidents (404 without).
 
@@ -329,6 +351,10 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                                                                 params)
             elif path == "/debug/steps" and telemetry is not None:
                 status, ctype, body = _render_steps(telemetry, params)
+                if status == 404:
+                    body = None
+            elif path == "/debug/serve" and telemetry is not None:
+                status, ctype, body = _render_serve(telemetry, params)
                 if status == 404:
                     body = None
             elif path == "/debug/incidents" and incidents is not None:

@@ -8,7 +8,7 @@ einsum carries the all-to-all on ICI (models/moe.py).
 
 Run: ``python -m trainingjob_operator_tpu.workloads.moe_pretrain``.
 Env: MOE_CONFIG=tiny|8x7b, MOE_TP, MOE_EP, MOE_STEPS, MOE_BATCH (global),
-MOE_CE_CHUNK (chunked cross-entropy), MOE_ROUTER_GROUP (grouped routing),
+MOE_CE_CHUNK (chunked cross-entropy),
 MOE_WINDOW (sliding-window attention span),
 MOE_SEQ, MOE_LR, MOE_CKPT_EVERY, plus the shared data/eval set
 (MOE_DATA, MOE_SEED, MOE_EVAL_EVERY/_BATCHES/_FRACTION --
@@ -51,14 +51,11 @@ def main() -> int:
     ckpt_every = int(os.environ.get("MOE_CKPT_EVERY", "10"))
     remat = os.environ.get("MOE_REMAT", train.default_remat(cfg.n_layers))
     ce_chunk = int(os.environ.get("MOE_CE_CHUNK", "0"))
-    router_group = int(os.environ.get("MOE_ROUTER_GROUP", "0"))
     window = int(os.environ.get("MOE_WINDOW", "0"))
-    if router_group or window:
+    if window:
         import dataclasses
 
-        cfg = dataclasses.replace(
-            cfg, router_group=router_group or cfg.router_group,
-            sliding_window=window or cfg.sliding_window)
+        cfg = dataclasses.replace(cfg, sliding_window=window)
 
     mesh = mesh_from_rendezvous(rdv, model_parallel=tp, expert_parallel=ep)
     print(f"elastic width {rdv.elastic_replicas}, mesh "

@@ -1,0 +1,578 @@
+"""Serving plane: open-loop request queue + continuous-batching decode.
+
+The decode stack (models/decode.py, moe_decode.py, quant.py) ran only
+offline at fixed batch inside bench.py; this module is the request path --
+the "millions of users" leg of the north star (ROADMAP item 3).  The design
+is Orca-style continuous batching mapped onto static-shape XLA:
+
+- ONE fixed-shape batched decode executable (``serve_step``) runs every
+  scheduler tick; the scheduler owns a slot map over the batch axis.  A
+  sequence occupies one slot from admission to EOS/max-tokens; the step
+  after it finishes, its slot's K/V rows and position counter are reset
+  (``reset_slot`` -- per-slot cache paging via ``dynamic_update_slice``)
+  and the next queued request is admitted.  Survivors are NEVER
+  re-prefilled: their rows and positions simply persist across admissions.
+- Prompts prefill in fixed-size chunks (``prefill_chunk``, one slot per
+  tick) interleaved with the running batch's decode step, so a long prompt
+  delays the batch by at most one chunk per tick instead of stalling it.
+- The admission queue is bounded: ``submit`` raises ``QueueFull`` (explicit
+  backpressure callers can retry/shed on) instead of growing until OOM.
+- Per-request latency accounting: queue wait, time-to-first-token, and
+  inter-token gaps feed sliding-window p50/p99 plus aggregate tokens/s,
+  pushed over the telemetry plane (obs/telemetry.py serve records) so the
+  controller's traffic-aware scale policy (controller/pod.py
+  ``_maybe_scale_serve``) and ``/debug/serve`` see live load.
+
+``policy="static"`` is the A/B baseline bench.py scores against: classic
+static batching -- admit only into an ALL-free batch, then run it to the
+last straggler.  The continuous win is structural (freed slots do useful
+work while stragglers finish), so the >=1.5x gate holds on CPU.
+
+Decoding is greedy (argmax): a serving replica must be reproducible for the
+stale-KV self-check (identical request -> identical tokens, whichever slot
+it lands in); sampling policies live client-side.
+
+Run: ``python -m trainingjob_operator_tpu.workloads.serve``.
+Env (declared in api/constants.py): TRAININGJOB_SERVE_SLOTS,
+_MAX_LEN, _PREFILL_CHUNK, _QUEUE_CAP, _RATE (mean arrivals per tick,
+open-loop Poisson), _REQUESTS (0 = serve forever), _QUANT (weight-only
+int8 decode -- models/quant.py qmatmul keeps it a win at every batch),
+plus GEN_FAMILY / LLAMA_CONFIG / MOE_CONFIG and
+TRAININGJOB_CHECKPOINT_DIR from workloads/generate.py's loading contract.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+
+#: Slot states.  FREE slots ride the batched step as masked junk rows
+#: (static shapes); PREFILL slots consume one prompt chunk per tick;
+#: DECODE slots emit one token per tick.
+FREE, PREFILL, DECODE = 0, 1, 2
+
+
+class QueueFull(Exception):
+    """Raised by ``submit`` when the bounded admission queue is at
+    capacity -- the backpressure contract: callers shed or retry, the
+    service never buffers unboundedly toward OOM."""
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: float = 0.0       # wall-clock submit time
+    admitted: float = 0.0      # wall-clock slot assignment
+    first_token_at: float = 0.0
+    finished: float = 0.0
+    slot: int = -1
+    tokens: List[int] = field(default_factory=list)
+
+    @property
+    def ttft_ms(self) -> float:
+        return max(self.first_token_at - self.arrival, 0.0) * 1000.0
+
+
+class _Slot:
+    __slots__ = ("state", "req", "t", "pending", "prefill_pos", "last_emit")
+
+    def __init__(self) -> None:
+        self.state = FREE
+        self.req: Optional[Request] = None
+        self.t = 0             # next cache position this slot writes
+        self.pending = 0       # last sampled token (next decode input)
+        self.prefill_pos = 0   # prompt tokens already prefilled
+        self.last_emit = 0.0   # wall time of this slot's last token
+
+
+class DecodeService:
+    """Continuous-batching scheduler over one fixed-shape decode batch.
+
+    ``params`` may be fp or weight-only int8 (models/quant.py); ``family``
+    picks the model module ("llama" -> models.decode, "moe" ->
+    models.moe_decode).  The KV cache is allocated once ([L, slots,
+    max_len, Hkv, Dh]) and owned here; model code never sees request
+    identity, only (token, position, slot) triples.
+    """
+
+    def __init__(self, params, config, *, slots: int = 4,
+                 max_len: Optional[int] = None, prefill_chunk: int = 16,
+                 queue_cap: int = 64, eos_id: int = -1,
+                 family: str = "llama", policy: str = "continuous",
+                 emitter=None, emit_every: int = 32):
+        import jax
+        import jax.numpy as jnp
+
+        if family == "moe":
+            from trainingjob_operator_tpu.models import moe_decode as mod
+        else:
+            from trainingjob_operator_tpu.models import decode as mod
+        if policy not in ("continuous", "static"):
+            raise ValueError(f"unknown policy {policy!r}")
+        if config.sliding_window:
+            raise ValueError(
+                "the serving plane requires a full-causal cache "
+                "(sliding_window == 0): chunked prefill and per-slot "
+                "paging do not compose with the ring layout")
+        self.params = params
+        self.config = config
+        self.slots = [_Slot() for _ in range(slots)]
+        self.max_len = max_len or config.max_seq_len
+        self.prefill_chunk = prefill_chunk
+        self.queue_cap = queue_cap
+        self.eos_id = eos_id
+        self.policy = policy
+        self.emitter = emitter
+        self.emit_every = emit_every
+
+        c = config
+        dtype = jnp.dtype(c.dtype)
+        shape = (c.n_layers, slots, self.max_len, c.n_kv_heads, c.head_dim)
+        self.cache = {"k": jnp.zeros(shape, dtype),
+                      "v": jnp.zeros(shape, dtype)}
+        # Three executables serve the whole plane: slot/position/chunk
+        # indices are traced operands, so admission order and prompt
+        # lengths never trigger a recompile.
+        self._step_fn = jax.jit(
+            lambda p, cache, tok, ts: mod.serve_step(p, cache, tok, ts, c))
+        self._prefill_fn = jax.jit(
+            lambda p, cache, toks, slot, t0: mod.prefill_chunk(
+                p, cache, toks, slot, t0, c))
+        self._reset_fn = jax.jit(mod.reset_slot)
+
+        self.queue: Deque[Request] = deque()
+        self._next_rid = 0
+        self._prefill_rr = 0   # round-robin cursor over PREFILL slots
+        self.step_count = 0
+        self.completed_total = 0
+        self.rejected_total = 0
+        self.tokens_total = 0
+        #: Sliding windows feeding p50/p99 and tokens/s.
+        self._latency_ms: Deque[float] = deque(maxlen=2048)
+        self._emit_times: Deque[float] = deque(maxlen=2048)
+
+    def warmup(self) -> None:
+        """Compile the three serving executables before traffic arrives.
+        Slot / position / chunk indices are traced operands, so one
+        dispatch each covers every future admission pattern; the dropped
+        functional outputs leave ``self.cache`` untouched.  Latency-
+        sensitive deployments (and the bench A/B, which must not time XLA
+        compilation) call this once at startup."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.slots)
+        zeros = jnp.zeros((n,), jnp.int32)
+        chunk = jnp.zeros((self.prefill_chunk,), jnp.int32)
+        _, c = self._prefill_fn(self.params, self.cache, chunk, 0, 0)
+        _, c = self._step_fn(self.params, c, zeros, zeros)
+        c = self._reset_fn(c, 0)
+        jax.block_until_ready(c["k"])
+
+    # -- request surface ------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               now: Optional[float] = None) -> Request:
+        """Enqueue one request; raises ``QueueFull`` at capacity and
+        ``ValueError`` when it could never fit the cache."""
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"max_len {self.max_len}")
+        if max_new_tokens < 1 or not prompt:
+            raise ValueError("need a non-empty prompt and max_new >= 1")
+        if len(self.queue) >= self.queue_cap:
+            self.rejected_total += 1
+            raise QueueFull(
+                f"queue at capacity {self.queue_cap}; retry or shed")
+        req = Request(rid=self._next_rid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens,
+                      arrival=time.time() if now is None else now)
+        self._next_rid += 1
+        self.queue.append(req)
+        return req
+
+    # -- scheduler ------------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> List[Request]:
+        """One scheduler tick: admit -> one prefill chunk -> one batched
+        decode step.  Returns the requests that completed this tick."""
+        now = time.time() if now is None else now
+        self._admit(now)
+        self._prefill_one(now)
+        done = self._decode(now)
+        self.step_count += 1
+        if (self.emitter is not None
+                and self.step_count % self.emit_every == 0):
+            s = self.stats(now)
+            self.emitter.emit_serve(
+                queue_depth=s["queue_depth"],
+                active_slots=s["active_slots"], slots=s["slots"],
+                p50_ms=s["token_latency_ms_p50"],
+                p99_ms=s["token_latency_ms_p99"],
+                tokens_per_sec=s["tokens_per_sec"],
+                completed=s["completed_total"])
+        return done
+
+    def _admit(self, now: float) -> None:
+        if self.policy == "static":
+            # Static re-prefill batching (the A/B baseline): a new batch
+            # forms only once EVERY slot is free -- freed slots idle while
+            # stragglers finish, which is exactly the cost continuous
+            # batching removes.
+            if any(sl.state != FREE for sl in self.slots):
+                return
+        for idx, sl in enumerate(self.slots):
+            if not self.queue:
+                return
+            if sl.state != FREE:
+                continue
+            req = self.queue.popleft()
+            # Per-slot cache paging: zero just this slot's K/V rows; the
+            # position counter restarts at 0.  Survivor slots are never
+            # touched (the no-re-prefill contract).
+            self.cache = self._reset_fn(self.cache, idx)
+            sl.state = PREFILL
+            sl.req = req
+            sl.t = 0
+            sl.prefill_pos = 0
+            req.admitted = now
+            req.slot = idx
+
+    def _prefill_one(self, now: float) -> None:
+        """Advance at most ONE slot by one prompt chunk per tick: prefill
+        interleaves with decode instead of stalling it (a long prompt costs
+        the running batch one chunk of latency per tick, bounded)."""
+        import jax.numpy as jnp
+
+        n = len(self.slots)
+        for off in range(n):
+            idx = (self._prefill_rr + off) % n
+            sl = self.slots[idx]
+            if sl.state != PREFILL:
+                continue
+            req = sl.req
+            chunk = req.prompt[sl.prefill_pos:
+                               sl.prefill_pos + self.prefill_chunk]
+            valid = len(chunk)
+            chunk = chunk + [0] * (self.prefill_chunk - valid)
+            logits, self.cache = self._prefill_fn(
+                self.params, self.cache, jnp.asarray(chunk, jnp.int32),
+                idx, sl.prefill_pos)
+            sl.prefill_pos += valid
+            if sl.prefill_pos >= len(req.prompt):
+                # Prompt fully cached: the last VALID chunk offset's logit
+                # is the prompt's next-token distribution.
+                import numpy as np
+
+                first = int(np.argmax(np.asarray(logits[valid - 1])))
+                sl.state = DECODE
+                sl.t = len(req.prompt)
+                sl.pending = first
+                req.first_token_at = now
+                self._emit_token(sl, first, now)
+            self._prefill_rr = (idx + 1) % n
+            return
+
+    def _decode(self, now: float) -> List[Request]:
+        import numpy as np
+
+        active = [i for i, sl in enumerate(self.slots)
+                  if sl.state == DECODE]
+        if not active:
+            return []
+        import jax.numpy as jnp
+
+        # Fixed-shape batch: every row steps.  FREE / mid-PREFILL rows get
+        # their next UNWRITTEN position, so the junk K/V they write lands
+        # exactly where admission's reset or the next prefill chunk
+        # overwrites it, and their own mask never reaches it.
+        toks, ts = [], []
+        for sl in self.slots:
+            if sl.state == DECODE:
+                toks.append(sl.pending)
+                ts.append(sl.t)
+            elif sl.state == PREFILL:
+                toks.append(0)
+                ts.append(sl.prefill_pos)
+            else:
+                toks.append(0)
+                ts.append(0)
+        logits, self.cache = self._step_fn(
+            self.params, self.cache, jnp.asarray(toks, jnp.int32),
+            jnp.asarray(ts, jnp.int32))
+        picks = np.argmax(np.asarray(logits), axis=-1)
+        done: List[Request] = []
+        for i in active:
+            sl = self.slots[i]
+            if sl.req.finished:
+                # Completed during this tick's prefill phase (single-token
+                # request): the batched step already ran with its row, but
+                # nothing reads its output.
+                done.append(self._release(sl))
+                continue
+            sl.t += 1
+            nxt = int(picks[i])
+            sl.pending = nxt
+            self._emit_token(sl, nxt, now)
+            if sl.req.finished:
+                done.append(self._release(sl))
+        return done
+
+    def _emit_token(self, sl: _Slot, tok: int, now: float) -> None:
+        req = sl.req
+        req.tokens.append(tok)
+        self.tokens_total += 1
+        if len(req.tokens) > 1:
+            self._latency_ms.append((now - sl.last_emit) * 1000.0)
+        else:
+            self._latency_ms.append(req.ttft_ms)
+        sl.last_emit = now
+        self._emit_times.append(now)
+        if (tok == self.eos_id
+                or len(req.tokens) >= req.max_new_tokens
+                or len(req.prompt) + len(req.tokens) >= self.max_len):
+            req.finished = now
+
+    def _release(self, sl: _Slot) -> Request:
+        """Free the slot; the NEXT tick's admission pass may re-page it.
+        The K/V rows are left as-is here -- admission's ``reset_slot`` is
+        the paging point, so a slot freed and never reused costs nothing."""
+        req = sl.req
+        sl.state = FREE
+        sl.req = None
+        self.completed_total += 1
+        return req
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = time.time() if now is None else now
+        lat = sorted(self._latency_ms)
+
+        def q(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(int(p * len(lat)), len(lat) - 1)]
+
+        span = (self._emit_times[-1] - self._emit_times[0]
+                if len(self._emit_times) > 1 else 0.0)
+        tps = (len(self._emit_times) - 1) / span if span > 0 else 0.0
+        active = sum(1 for sl in self.slots if sl.state != FREE)
+        return {
+            "policy": self.policy,
+            "slots": len(self.slots),
+            "active_slots": active,
+            "occupancy": active / max(len(self.slots), 1),
+            "queue_depth": len(self.queue),
+            "steps": self.step_count,
+            "completed_total": self.completed_total,
+            "rejected_total": self.rejected_total,
+            "tokens_total": self.tokens_total,
+            "tokens_per_sec": round(tps, 2),
+            "token_latency_ms_p50": round(q(0.5), 3),
+            "token_latency_ms_p99": round(q(0.99), 3),
+        }
+
+
+# -- synthetic open-loop traffic ---------------------------------------------
+
+def synthetic_traffic(n: int, *, seed: int = 0, rate: float = 0.5,
+                      vocab: int = 256, templates: int = 6,
+                      prompt_lens: Tuple[int, int] = (4, 16),
+                      out_tokens: Tuple[int, int] = (4, 32),
+                      long_frac: float = 0.0,
+                      long_out_tokens: Tuple[int, int] = (48, 96)
+                      ) -> List[Tuple[int, List[int], int]]:
+    """``n`` requests as (arrival_tick, prompt, max_new) triples.
+
+    Open-loop: arrivals are Poisson in TICK time (mean ``rate`` per tick),
+    fixed up front -- load does not slacken when the service falls behind,
+    which is what makes queue depth a real signal.  Prompts are drawn from
+    ``templates`` deterministic token patterns so the same prompt recurs
+    across different slots; a serving run can then self-check that repeats
+    decode identically (the stale-KV detector tools/serve_smoke.py pins).
+    Mixed prompt/output lengths are the point: the straggler spread is what
+    continuous batching monetizes.  ``long_frac`` > 0 makes the mix
+    bimodal -- that fraction of requests draws its budget from
+    ``long_out_tokens`` instead (the chat-vs-completion shape real serving
+    traffic has, and the worst case for static batching: one long request
+    strands a whole batch of short ones).
+    """
+    import random
+
+    rng = random.Random(seed)
+    tick = 0
+    out: List[Tuple[int, List[int], int]] = []
+    for _ in range(n):
+        # Geometric inter-arrival ~ Poisson process in discrete ticks.
+        while rng.random() > rate:
+            tick += 1
+        g = rng.randrange(templates)
+        plen = rng.randint(*prompt_lens)
+        # Template g's prompt: deterministic in (g, plen) only, so equal
+        # (g, plen) pairs are byte-identical requests.
+        prompt = [1 + (g * 37 + 7 * i) % (vocab - 1) for i in range(plen)]
+        budget = (rng.randint(*long_out_tokens)
+                  if long_frac and rng.random() < long_frac
+                  else rng.randint(*out_tokens))
+        out.append((tick, prompt, budget))
+    return out
+
+
+def run_traffic(service: DecodeService,
+                traffic: List[Tuple[int, List[int], int]],
+                max_ticks: int = 100000) -> Dict[str, Any]:
+    """Drive ``service`` through an open-loop trace: submissions fire by
+    tick regardless of service progress (QueueFull rejections are dropped
+    and counted), then the loop drains until every admitted request
+    completes.  Returns stats + completed requests + the stale-KV
+    self-check verdict."""
+    completed: List[Request] = []
+    submitted = 0
+    i = 0
+    tick = 0
+    t0 = time.time()
+    while i < len(traffic) or any(sl.state != FREE for sl in service.slots) \
+            or service.queue:
+        while i < len(traffic) and traffic[i][0] <= tick:
+            _, prompt, max_new = traffic[i]
+            try:
+                service.submit(prompt, max_new)
+                submitted += 1
+            except QueueFull:
+                pass  # open-loop shed; counted in rejected_total
+            i += 1
+        completed.extend(service.step())
+        tick += 1
+        if tick > max_ticks:
+            raise RuntimeError(f"traffic did not drain in {max_ticks} ticks")
+    wall = time.time() - t0
+    stats = service.stats()
+    stats.update({
+        "submitted": submitted,
+        "wall_s": round(wall, 3),
+        "aggregate_tokens_per_sec": round(
+            service.tokens_total / wall, 1) if wall > 0 else 0.0,
+        "stale_kv_violations": count_stale_kv_violations(completed),
+        "ttft_ms_p50": _quantile([r.ttft_ms for r in completed], 0.5),
+    })
+    return {"stats": stats, "completed": completed}
+
+
+def count_stale_kv_violations(completed: List[Request]) -> int:
+    """Identical (prompt, max_new) requests must decode identically no
+    matter which slot they landed in or what occupied it before -- greedy
+    decode is deterministic, so ANY divergence means a slot leaked state
+    into its next occupant.  Returns the number of divergent requests."""
+    reference: Dict[Tuple[Tuple[int, ...], int], List[int]] = {}
+    violations = 0
+    for req in completed:
+        key = (tuple(req.prompt), req.max_new_tokens)
+        ref = reference.setdefault(key, req.tokens)
+        if req.tokens != ref:
+            violations += 1
+    return violations
+
+
+def _quantile(values: List[float], p: float) -> float:
+    if not values:
+        return 0.0
+    v = sorted(values)
+    return round(v[min(int(p * len(v)), len(v) - 1)], 3)
+
+
+# -- operator entrypoint ------------------------------------------------------
+
+def main() -> int:
+    from trainingjob_operator_tpu.workloads import rendezvous, train
+
+    rdv = rendezvous.initialize_jax_distributed()
+
+    import jax
+
+    family = os.environ.get("GEN_FAMILY", "llama")
+    if family == "moe":
+        from trainingjob_operator_tpu.models import moe
+
+        cfg = (moe.MoEConfig.mixtral_8x7b()
+               if os.environ.get("MOE_CONFIG", "tiny") == "8x7b"
+               else moe.MoEConfig.tiny())
+        init_params, subdir = moe.init_params, "moe"
+    else:
+        from trainingjob_operator_tpu.models import llama
+
+        cfg = (llama.LlamaConfig.llama2_7b()
+               if os.environ.get("LLAMA_CONFIG", "tiny") == "7b"
+               else llama.LlamaConfig.tiny())
+        init_params, subdir = llama.init_params, "llama"
+
+    env = os.environ
+    slots = int(env.get(constants.SERVE_SLOTS_ENV, "4"))
+    max_len = int(env.get(constants.SERVE_MAX_LEN_ENV, "0")) or None
+    chunk = int(env.get(constants.SERVE_PREFILL_CHUNK_ENV, "16"))
+    queue_cap = int(env.get(constants.SERVE_QUEUE_CAP_ENV, "64"))
+    rate = float(env.get(constants.SERVE_RATE_ENV, "0.5"))
+    n_requests = int(env.get(constants.SERVE_REQUESTS_ENV, "200"))
+    quantize = env.get(constants.SERVE_QUANT_ENV, "") in ("1", "true")
+
+    # Same checkpoint contract as workloads/generate.py: serve the trained
+    # weights when a checkpoint exists, random init otherwise (smoke runs).
+    state = train.CheckpointState.restore_or_init(
+        rdv,
+        {"params": init_params(cfg, jax.random.PRNGKey(0)),
+         "opt_state": train.ckpt_placeholder(), "step": 0},
+        subdir=subdir)
+    params = state.value["params"]
+    if quantize and family != "moe":
+        from trainingjob_operator_tpu.models.quant import quantize_weights
+
+        params = quantize_weights(params)
+        print("serving weight-only int8", flush=True)
+
+    from trainingjob_operator_tpu.obs.telemetry import TelemetryEmitter
+
+    service = DecodeService(params, cfg, slots=slots, max_len=max_len,
+                            prefill_chunk=chunk, queue_cap=queue_cap,
+                            family=family, emitter=TelemetryEmitter())
+    print(f"serve: family={family} slots={slots} max_len={service.max_len} "
+          f"chunk={chunk} queue_cap={queue_cap} rate={rate}", flush=True)
+
+    if n_requests > 0:
+        traffic = synthetic_traffic(n_requests, rate=rate,
+                                    vocab=cfg.vocab_size)
+        result = run_traffic(service, traffic)
+        s = result["stats"]
+        print(f"serve done: completed={s['completed_total']} "
+              f"rejected={s['rejected_total']} "
+              f"tokens/s={s['aggregate_tokens_per_sec']} "
+              f"p50_ms={s['token_latency_ms_p50']} "
+              f"p99_ms={s['token_latency_ms_p99']} "
+              f"stale_kv_violations={s['stale_kv_violations']}", flush=True)
+        return 0 if s["stale_kv_violations"] == 0 else 1
+
+    # Serve forever: a persistent replica under the operator.  The
+    # synthetic generator keeps feeding open-loop load (a real deployment
+    # would splice a network frontend in here); SIGTERM from the drain
+    # machinery ends the process like any workload.
+    import itertools
+
+    gen = iter(itertools.count())
+    rng_seed = 0
+    while True:
+        batch_no = next(gen)
+        traffic = synthetic_traffic(512, seed=rng_seed + batch_no,
+                                    rate=rate, vocab=cfg.vocab_size)
+        run_traffic(service, traffic)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
