@@ -64,6 +64,9 @@ def _timed_loop(step, params, opt, tokens, steps, min_plausible_s=0.0):
     params, opt, l = step(params, opt, tokens)  # compile
     for _ in range(2):                          # warmup
         params, opt, l = step(params, opt, tokens)
+    # analyzer: allow[host-sync-in-hot-loop] the D2H read IS the fence this
+    # harness depends on (block_until_ready does not wait on this runtime;
+    # see the docstring) -- it runs once per timing leg, not per step.
     float(l)  # d2h fence; see note above
 
     def timed(n):
@@ -71,6 +74,9 @@ def _timed_loop(step, params, opt, tokens, steps, min_plausible_s=0.0):
         t0 = time.perf_counter()
         for _ in range(n):
             params, opt, l = step(params, opt, tokens)
+        # analyzer: allow[host-sync-in-hot-loop] deliberate timing fence,
+        # once per measured window of n steps (not per step); the only
+        # reliable sync on this runtime per the _timed_loop docstring.
         float(l)  # forced sync
         return (time.perf_counter() - t0) / n
 

@@ -26,6 +26,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _jax_version_info():
+    import jax
+
+    return jax.__version_info__
+
+
+@pytest.mark.skipif(
+    _jax_version_info() < (0, 5, 0),
+    reason="CPU cross-process collectives (gloo) need jax>=0.5; on older "
+           "runtimes device_put into a multi-process sharding raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'")
 def test_two_process_data_parallel_train(tmp_path):
     from trainingjob_operator_tpu.data import write_tokens
 

@@ -536,6 +536,16 @@ class TestPipelineFlashAttention:
 
         import jax
 
+        from trainingjob_operator_tpu.parallel.pipeline import (
+            partial_manual_shard_map)
+
+        if partial_manual_shard_map() is None:
+            # Tracking condition: partial-manual shard_map (axis_names=)
+            # landed in jax 0.8; until the runtime has it, gpipe documents
+            # the attention_xla fallback this test deliberately poisons.
+            pytest.skip("partial-manual shard_map needs jax>=0.8; gpipe "
+                        "falls back to attention_xla on this runtime")
+
         from trainingjob_operator_tpu.models import llama
         from trainingjob_operator_tpu.parallel.sharding import shard_pytree
 

@@ -1614,15 +1614,15 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_nineteen_checks_registered(self):
+    def test_all_twenty_three_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
             "TJA007", "TJA008", "TJA009", "TJA015", "TJA018", "TJA019"}
         assert {cid for cid, _fn in runner.PROJECT_REGISTRY.values()} == {
             "TJA010", "TJA011", "TJA012", "TJA013", "TJA014", "TJA016",
-            "TJA017"}
-        assert len(runner.all_checks()) == 19
+            "TJA017", "TJA020", "TJA021", "TJA022", "TJA023"}
+        assert len(runner.all_checks()) == 23
 
     def test_sarif_roundtrip(self):
         err = Finding("TJA015", "resource-leak", "a/b.py", 7, 2, "error",
@@ -1699,3 +1699,343 @@ class TestRepoIsClean:
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
         assert proc.returncode == 1
         assert "TJA004" in proc.stdout
+
+
+# -- TJA020-023: the jit-boundary layer --------------------------------------
+
+def _boundary_of(tmp_path, files):
+    """Build the traced-region closure/hot map for a fixture tree."""
+    from tools.analyze import jit_boundary as jb
+    from tools.analyze.project import ProjectContext
+
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    contexts = {}
+    for abs_path in runner.iter_py_files([str(tmp_path)], str(tmp_path)):
+        ctx = runner.make_context(abs_path, str(tmp_path))
+        contexts[ctx.path] = ctx
+    pc = runner.ProjectContext.build(str(tmp_path), contexts)
+    return jb.boundary(pc)
+
+
+class TestTracedClosure:
+    def test_closure_reaches_through_helper_calls(self, tmp_path):
+        """The closure is interprocedural: helpers reachable from a jitted
+        entry are traced too, with static argnums recorded on the site."""
+        b = _boundary_of(tmp_path, {"mod.py": """
+            import jax
+
+            def norm(x):
+                return x / (x.sum() + 1e-6)
+
+            def entry(x, k):
+                return norm(x) * k
+
+            step = jax.jit(entry, static_argnums=(1,))
+        """})
+        assert "mod.entry" in b.closure
+        assert "mod.norm" in b.closure       # reached via entry, not jitted
+        (site,) = b.sites
+        assert site.static_argnums == (1,) and site.has_static
+
+    def test_hot_loop_seeded_from_loop_carried_device_value(self, tmp_path):
+        """The hot map keys off loop-carried device values (a jitted call's
+        output feeding its next-iteration input) -- not file names."""
+        b = _boundary_of(tmp_path, {"anyname.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                for _ in range(100):
+                    s = step(s)
+                return s
+        """})
+        assert any(h.fn_qual == "anyname.run" for h in b.hot_loops)
+        # Functions invoked from the hot loop are hot too.
+        assert "anyname.step" in b.hot_fns
+
+    def test_straight_line_dispatch_is_not_hot(self, tmp_path):
+        b = _boundary_of(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                s = step(s)
+                return step(s)
+        """})
+        assert b.hot_loops == []
+
+    def test_boundary_built_once_across_all_four_passes(self, tmp_path):
+        """TJA020-023 all consume the closure; the ProjectContext memo means
+        exactly one build (same contract as the CFG memo)."""
+        from tools.analyze import jit_boundary as jb
+
+        files = {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                for _ in range(10):
+                    s = step(s)
+                return s
+        """}
+        for rel, source in files.items():
+            (tmp_path / rel).write_text(textwrap.dedent(source))
+        before = jb.BUILD_COUNT
+        run_checks([str(tmp_path)], root=str(tmp_path),
+                   only=["recompile-hazard", "host-sync-in-hot-loop",
+                         "donation-discipline", "impure-capture"])
+        assert jb.BUILD_COUNT - before == 1
+
+
+class TestRecompileHazard:
+    def test_fires_on_wrapper_built_inside_loop(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            def run(xs):
+                out = []
+                for x in xs:
+                    step = jax.jit(lambda v: v + 1)
+                    out.append(step(x))
+                return out
+        """}, only=["recompile-hazard"])
+        assert ids(findings) == ["TJA020"]
+        assert any(f.severity == "error" and "loop" in f.message
+                   for f in findings)
+
+    def test_fires_on_unhashable_static_argument(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            def f(x, dims):
+                return x.reshape(dims)
+
+            step = jax.jit(f, static_argnums=(1,))
+
+            def run(x):
+                return step(x, [4, 4])
+        """}, only=["recompile-hazard"])
+        assert ids(findings) == ["TJA020"]
+        assert any("static" in f.message for f in findings)
+
+    def test_quiet_on_hoisted_wrapper_and_hashable_statics(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            def f(x, dims):
+                return x.reshape(dims)
+
+            step = jax.jit(f, static_argnums=(1,))
+
+            def run(xs):
+                return [step(x, (4, 4)) for x in xs]
+        """}, only=["recompile-hazard"])
+        assert findings == []
+
+
+class TestHostSyncHotLoop:
+    def test_fires_on_float_read_in_hot_loop(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                for _ in range(100):
+                    s = step(s)
+                    print(float(s))
+                return s
+        """}, only=["host-sync-in-hot-loop"])
+        assert ids(findings) == ["TJA021"]
+        assert all(f.severity == "warning" for f in findings)
+
+    def test_quiet_when_read_happens_after_the_loop(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                for _ in range(100):
+                    s = step(s)
+                return float(s)
+        """}, only=["host-sync-in-hot-loop"])
+        assert findings == []
+
+    def test_waiver_routes_deliberate_fence(self, tmp_path):
+        """A documented completion fence stays, with the waiver naming it."""
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s + 1
+
+            def run(s):
+                for _ in range(100):
+                    s = step(s)
+                    # analyzer: allow[host-sync-in-hot-loop] deliberate
+                    # per-step fence for this fixture.
+                    print(float(s))
+                return s
+        """}, only=["host-sync-in-hot-loop"])
+        assert findings == []
+
+
+class TestDonationDiscipline:
+    def test_fires_on_read_after_donate_in_loop(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def run(state, xs):
+                for x in xs:
+                    step(state, x)
+                return state
+        """}, only=["donation-discipline"])
+        assert any(f.check_id == "TJA022" and f.severity == "error"
+                   for f in findings)
+
+    def test_advises_missing_donation_on_hot_round_trip(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(state):
+                return state * 2
+
+            def run(state):
+                for _ in range(100):
+                    state = step(state)
+                return state
+        """}, only=["donation-discipline"])
+        assert any(f.check_id == "TJA022" and f.severity == "warning"
+                   and "donate" in f.message for f in findings)
+
+    def test_quiet_when_donated_state_is_rebound(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def run(state, xs):
+                for x in xs:
+                    state = step(state, x)
+                return state
+        """}, only=["donation-discipline"])
+        assert findings == []
+
+
+class TestImpureCapture:
+    def test_fires_on_module_state_mutation_in_traced_code(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            TRACE_LOG = []
+
+            def helper(x):
+                TRACE_LOG.append(x)
+                return x + 1
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """}, only=["impure-capture"])
+        assert any(f.check_id == "TJA023" and f.severity == "error"
+                   for f in findings)
+
+    def test_fires_on_print_inside_traced_region(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                print(x)
+                return x + 1
+        """}, only=["impure-capture"])
+        assert any(f.check_id == "TJA023" and f.severity == "warning"
+                   for f in findings)
+
+    def test_quiet_on_pure_traced_code_with_local_mutation(self, tmp_path):
+        findings = analyze_tree(tmp_path, {"m.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                parts = []
+                for i in range(4):
+                    parts.append(x * i)
+                return sum(parts)
+        """}, only=["impure-capture"])
+        assert findings == []
+
+
+class TestChangedSinceMode:
+    def _git(self, cwd, *args):
+        subprocess.run(["git", *args], cwd=cwd, check=True,
+                       capture_output=True, text=True)
+
+    def test_reports_only_into_ast_changed_files(self, tmp_path):
+        """Two files with the same seeded bug; only the one whose AST
+        changed since the ref is reported.  A comment-only edit does not
+        count as changed."""
+        clean = "def f():\n    return 1\n"
+        bad = ("def f():\n    try:\n        g()\n"
+               "    except Exception:\n        pass\n")
+        (tmp_path / "changed.py").write_text(clean)
+        (tmp_path / "unchanged.py").write_text(bad)
+        (tmp_path / "commented.py").write_text(bad)
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "add", "-A")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "-qm", "seed")
+        (tmp_path / "changed.py").write_text(bad)          # AST changed
+        (tmp_path / "commented.py").write_text("# note\n" + bad)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", str(tmp_path),
+             "--changed-since", "HEAD", "--no-baseline"],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "changed.py" in proc.stdout
+        assert "unchanged.py" not in proc.stdout
+        assert "commented.py" not in proc.stdout
+
+    def test_exits_zero_fast_when_nothing_changed(self, tmp_path):
+        (tmp_path / "ok.py").write_text("def f():\n    return 1\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "add", "-A")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "-qm", "seed")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", str(tmp_path),
+             "--changed-since", "HEAD"],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no AST-changed files" in proc.stderr

@@ -1,0 +1,163 @@
+"""TJA022 donation-discipline: donated buffers and the ones that should be.
+
+``donate_argnums`` lets XLA alias an input buffer to an output, so a
+state-in/state-out step (``params, opt = step(params, opt, batch)``; the
+serve K/V cache) runs without holding two copies of the state in HBM --
+the difference between fitting and OOM at the sizes the paper's jobs run
+(PAPER.md; the snapshot-donate checkpoint path was built on exactly this).
+Donation has a sharp edge though: the donated input buffer is *gone* after
+the call, and reading it afterwards returns garbage or raises.
+
+Two rules over the ``jit_boundary`` layer:
+
+- **read-after-donate** (error): an argument at a donated position, when
+  it is a plain name or ``self.attr``, must be rebound by the call's own
+  assignment targets or not read again afterwards; a donating call inside
+  a loop that does not rebind feeds the dead buffer back next iteration.
+  (Line-order approximation; a rebind between the call and the read
+  kills the finding.)
+- **missing-donation** (advisory): a hot-path call into a jitted binding
+  that round-trips the same names in and out, where the binding's wrap
+  site has no ``donate_argnums``/``donate_argnames`` at all.  Advisory:
+  donation is wrong when the caller keeps the old state on purpose
+  (the elastic reshard keeps pre-resize state alive until the exchange
+  commits), so fixing vs waiving is a per-site decision.
+
+``tests/`` are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.analyze import jit_boundary as jb
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+
+def _is_test_path(path: str) -> bool:
+    return path.startswith("tests/") or "/tests/" in path
+
+
+def _as_ref(arg: ast.expr):
+    """A trackable donated operand: 'name' or ('self', attr)."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if (isinstance(arg, ast.Attribute)
+            and isinstance(arg.value, ast.Name)
+            and arg.value.id == "self"):
+        return ("self", arg.attr)
+    return None
+
+
+def _name_of(ref) -> str:
+    return ref if isinstance(ref, str) else f"self.{ref[1]}"
+
+
+def _next_event(rec: jb.FnRec, ref, after_line: int) -> Optional[Tuple[str, int]]:
+    """First ('load'|'store', line) for ``ref`` strictly after a line."""
+    best: Optional[Tuple[int, str]] = None
+    for n in ast.walk(rec.node):
+        line = getattr(n, "lineno", None)
+        if line is None or line <= after_line:
+            continue
+        kind = None
+        if isinstance(ref, str) and isinstance(n, ast.Name) and n.id == ref:
+            kind = "store" if isinstance(n.ctx, ast.Store) else "load"
+        elif (not isinstance(ref, str) and isinstance(n, ast.Attribute)
+                and n.attr == ref[1] and isinstance(n.value, ast.Name)
+                and n.value.id == "self"):
+            kind = "store" if isinstance(n.ctx, ast.Store) else "load"
+        if kind and (best is None or line < best[0]):
+            best = (line, kind)
+    if best is None:
+        return None
+    return best[1], best[0]
+
+
+@register_project("TJA022", "donation-discipline")
+def check(pc: ProjectContext) -> List[Finding]:
+    b = jb.boundary(pc)
+    findings: List[Finding] = []
+
+    def emit(path: str, line: int, col: int, sev: str, msg: str) -> None:
+        findings.append(Finding("TJA022", "donation-discipline", path,
+                                line, col, sev, msg))
+
+    # -- read-after-donate (all scopes) ---------------------------------------
+    for qual, rec in b.fns.items():
+        if _is_test_path(rec.path):
+            continue
+        for cr in rec.calls:
+            site = b.site_for_call(rec, cr)
+            if site is None or not (site.donate_argnums
+                                    or site.donate_argnames):
+                continue
+            call = cr.node
+            donated = []
+            for idx in site.donate_argnums:
+                if idx < len(call.args):
+                    donated.append(call.args[idx])
+            for kw in call.keywords:
+                if kw.arg and kw.arg in site.donate_argnames:
+                    donated.append(kw.value)
+            for arg in donated:
+                ref = _as_ref(arg)
+                if ref is None:
+                    continue
+                if ref in cr.targets:
+                    continue        # x = f(x): rebound, the normal shape
+                nm = _name_of(ref)
+                if cr.loop_stack:
+                    emit(rec.path, call.lineno, call.col_offset, ERROR,
+                         f"'{nm}' is donated to the {site.describe()} "
+                         "inside a loop without being rebound by the "
+                         "call's result; next iteration passes a dead "
+                         "buffer")
+                    continue
+                after = getattr(call, "end_lineno", call.lineno)
+                ev = _next_event(rec, ref, after)
+                if ev is not None and ev[0] == "load":
+                    emit(rec.path, call.lineno, call.col_offset, ERROR,
+                         f"'{nm}' is donated to the {site.describe()} but "
+                         f"read again at line {ev[1]}; the donated buffer "
+                         "is dead after the call -- rebind the result or "
+                         "drop the donation")
+
+    # -- missing-donation advisory (hot path only) ----------------------------
+    advised: Set[int] = set()
+    hot_scopes = [(hl.fn_qual, hl, True) for hl in b.hot_loops]
+    hot_scopes += [(q, hl, False) for q, hl in b.hot_fns.items()]
+    for qual, hl, loop_only in hot_scopes:
+        rec = b.fns.get(qual)
+        if rec is None or _is_test_path(rec.path):
+            continue
+        loops = [lp for lp in rec.loops if lp.lineno == hl.line] \
+            if loop_only else []
+        for cr in rec.calls:
+            if loop_only and not any(lp in cr.loop_stack for lp in loops):
+                continue
+            site = b.site_for_call(rec, cr)
+            if site is None or site.has_donate or id(site) in advised:
+                continue
+            refs = set()
+            for a in cr.node.args:
+                r = _as_ref(a)
+                if r is not None:
+                    refs.add(r)
+            carried = sorted(_name_of(r) for r in refs
+                             if r in set(cr.targets))
+            if not carried:
+                continue
+            advised.add(id(site))
+            emit(rec.path, cr.node.lineno, cr.node.col_offset, WARNING,
+                 f"state-in/state-out step on the hot path round-trips "
+                 f"{carried} through the {site.describe()}, which has no "
+                 "donate_argnums; donating the state input lets XLA alias "
+                 "it to the output and halves its peak HBM (waive if the "
+                 "old state must stay readable)")
+
+    findings.sort(key=Finding.sort_key)
+    return findings

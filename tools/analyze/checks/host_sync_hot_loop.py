@@ -1,0 +1,162 @@
+"""TJA021 host-sync-in-hot-loop: device round-trips on the hot path.
+
+A TPU step loop sustains its throughput by keeping the device queue fed
+ahead of the host (SURVEY.md §5: the dispatch-ahead pipeline *is* the
+goodput).  One ``.item()`` / ``float()`` / ``np.asarray`` / ``argmax`` on
+a device value inside the loop drains that pipeline: the host blocks until
+the step finishes, the device then idles until the host re-dispatches.
+The Gemma-serving comparison (PAPERS.md) measures exactly this class of
+stall as the dominant serving overhead after recompiles.
+
+Scope: the ``jit_boundary`` hot-loop map -- loops whose iterations carry
+device values, plus every function those loops invoke per tick.  A sync
+op is only flagged when its operand is *device-tainted* (produced by or
+round-tripped through a dispatching call), so host-side bookkeeping in
+the same loop stays quiet.
+
+Deliberate fences stay, waived with a reason -- the canonical ones are
+``StepProfiler.step_end``'s ``jax.device_get(sync)`` (the measured
+completion barrier; ``block_until_ready`` can return early on the axon
+runtime) and the serve tick's per-token ``np.argmax`` (the sampler is
+host-side by design; one batched D2H per tick is the documented cost).
+``tests/`` are exempt -- asserting on device values is what tests do.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from tools.analyze import jit_boundary as jb
+from tools.analyze.findings import Finding, WARNING
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+#: numpy module aliases whose array-taking calls copy device -> host.
+NP_ALIASES = {"np", "numpy", "onp"}
+NP_SYNC_ATTRS = {"asarray", "array", "argmax", "argmin"}
+SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist"}
+JAX_FENCES = {"device_get", "block_until_ready"}
+
+
+def _is_test_path(path: str) -> bool:
+    return path.startswith("tests/") or "/tests/" in path
+
+
+def _tainted_names(taint: Set, node: ast.AST) -> List[str]:
+    """Device-tainted value names referenced anywhere under ``node``."""
+    hits: List[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in taint:
+            hits.append(n.id)
+        elif (isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                and ("self", n.attr) in taint):
+            hits.append(f"self.{n.attr}")
+    return sorted(set(hits))
+
+
+@register_project("TJA021", "host-sync-in-hot-loop")
+def check(pc: ProjectContext) -> List[Finding]:
+    b = jb.boundary(pc)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, int]] = set()
+
+    def emit(path: str, node: ast.AST, msg: str) -> None:
+        key = (path, node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding("TJA021", "host-sync-in-hot-loop", path,
+                                node.lineno, node.col_offset, WARNING, msg))
+
+    def classify(rec: jb.FnRec, cr: jb.CallRec, taint: Set,
+                 where: str) -> None:
+        ref = cr.ref
+        if ref is None:
+            return
+        call = cr.node
+        if ref[0] == "name":
+            name = ref[1]
+            if name in SYNC_BUILTINS:
+                hits = [h for a in call.args
+                        for h in _tainted_names(taint, a)]
+                if hits:
+                    emit(rec.path, call,
+                         f"{name}() on device value(s) {hits} {where}; "
+                         "each call blocks the host on the device queue "
+                         "-- keep the value on-device or read it outside "
+                         "the loop")
+            elif name in JAX_FENCES:
+                hits = [h for a in call.args
+                        for h in _tainted_names(taint, a)]
+                if hits:
+                    emit(rec.path, call,
+                         f"{name}() fences on device value(s) {hits} "
+                         f"{where}; the dispatch-ahead pipeline drains "
+                         "every iteration -- fence once outside, or waive "
+                         "with the reason if this is the deliberate "
+                         "completion barrier")
+        elif ref[0] == "attr":
+            leaf, meth = ref[1], ref[2]
+            if leaf == "jax" and meth in JAX_FENCES:
+                hits = [h for a in call.args
+                        for h in _tainted_names(taint, a)]
+                if hits:
+                    emit(rec.path, call,
+                         f"jax.{meth}() on device value(s) {hits} {where}; "
+                         "this is a full host sync per iteration -- hoist "
+                         "it, or waive with the reason if it is a "
+                         "deliberate fence")
+            elif leaf in NP_ALIASES and meth in NP_SYNC_ATTRS:
+                hits = [h for a in call.args
+                        for h in _tainted_names(taint, a)]
+                if hits:
+                    emit(rec.path, call,
+                         f"{leaf}.{meth}() copies device value(s) {hits} "
+                         f"to host {where}; use the jnp equivalent "
+                         "on-device, or waive if the host-side read is "
+                         "the design (e.g. the serve sampler)")
+            elif meth in SYNC_METHODS and leaf in taint:
+                emit(rec.path, call,
+                     f".{meth}() on device value '{leaf}' {where}; one "
+                     "blocking device-to-host round-trip per call")
+            elif meth == "block_until_ready" and leaf in taint:
+                emit(rec.path, call,
+                     f"'{leaf}.block_until_ready()' {where}; drains the "
+                     "dispatch pipeline every iteration")
+        elif ref[0] == "selfattr":
+            attr, meth = ref[1], ref[2]
+            if meth in SYNC_METHODS | {"block_until_ready"} \
+                    and ("self", attr) in taint:
+                emit(rec.path, call,
+                     f".{meth}() on device value 'self.{attr}' {where}")
+
+    # Ops lexically inside a hot loop.
+    for hl in b.hot_loops:
+        rec = b.fns.get(hl.fn_qual)
+        if rec is None or _is_test_path(rec.path):
+            continue
+        taint = b.device_taint.get(hl.fn_qual, set())
+        loops = [lp for lp in rec.loops if lp.lineno == hl.line]
+        for cr in rec.calls:
+            if any(lp in cr.loop_stack for lp in loops):
+                classify(rec, cr, taint, f"inside the {hl.describe()}")
+
+    # Ops in functions invoked (transitively) once per hot-loop iteration.
+    for qual, hl in b.hot_fns.items():
+        rec = b.fns.get(qual)
+        if rec is None or _is_test_path(rec.path):
+            continue
+        taint = b.device_taint.get(qual, set())
+        if not taint:
+            continue
+        where = (f"in '{qual.rsplit('.', 1)[-1]}', which runs every "
+                 f"iteration of the {hl.describe()}")
+        for cr in rec.calls:
+            classify(rec, cr, taint, where)
+
+    findings.sort(key=Finding.sort_key)
+    return findings

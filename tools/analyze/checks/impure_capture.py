@@ -1,0 +1,170 @@
+"""TJA023 impure-capture: side effects inside the traced region.
+
+A function staged out by jit runs its *Python* body once, at trace time;
+only the jaxpr runs per step.  Code inside the traced-region closure that
+mutates state outliving the trace is therefore a silent semantic bug:
+
+- appending to / updating a module-global or closed-over container
+  records ONE entry ever, not one per step;
+- ``global`` / ``nonlocal`` writes fire once at trace time;
+- ``self.attr = ...`` in a traced method mutates the object during
+  tracing, then never again;
+- ``print`` / ``logging`` emit a tracer repr once, which reads like a
+  per-step log but is not (``jax.debug.print`` is the staged form).
+
+TJA006 catches the print/host-sync shapes per file for functions visibly
+wrapped in the same module; this pass extends the same discipline to the
+whole interprocedural closure from ``jit_boundary`` -- helpers two modules
+away from the ``jax.jit`` call included.
+
+Trace-local mutation stays allowed: building a Python list of per-layer
+outputs inside the traced entry (the unrolled-loop idiom) is fine, so a
+mutator is only flagged when its receiver resolves *outside* the traced
+region -- to module scope or to a lexical parent that is not itself part
+of the closure (e.g. ``__init__`` locals captured by a jitted lambda).
+``tests/`` are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze import jit_boundary as jb
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+MUTATORS = {"append", "extend", "add", "insert", "update", "setdefault",
+            "pop", "popleft", "appendleft", "remove", "clear", "write"}
+LOG_RECEIVERS = {"logging", "logger", "log", "LOG"}
+LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_test_path(path: str) -> bool:
+    return path.startswith("tests/") or "/tests/" in path
+
+
+def _owner_scope(b: jb.Boundary, rec: jb.FnRec,
+                 name: str) -> Optional[jb.FnRec]:
+    """The lexical scope that binds ``name``, walking outwards; the module
+    scope (``*.<module>``) when it is a module-level binding."""
+    scope = rec
+    while scope is not None:
+        if name in scope.local_names:
+            return scope
+        scope = b.fns.get(scope.parent) if scope.parent else None
+    modscope = b.fns.get(f"{rec.module}.<module>")
+    if modscope is not None and name in modscope.local_names:
+        return modscope
+    return None
+
+
+def _body_stmts(rec: jb.FnRec) -> List[ast.stmt]:
+    node = rec.node
+    if isinstance(node, ast.Lambda):
+        return []
+    return list(node.body)
+
+
+def _own_nodes(rec: jb.FnRec):
+    """Walk this scope's statements without descending into nested defs
+    (they are separate closure members and report for themselves)."""
+    stack: List[ast.AST] = list(_body_stmts(rec))
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPE_TYPES):
+                stack.append(child)
+
+
+@register_project("TJA023", "impure-capture")
+def check(pc: ProjectContext) -> List[Finding]:
+    b = jb.boundary(pc)
+    findings: List[Finding] = []
+
+    def emit(path: str, node: ast.AST, sev: str, msg: str) -> None:
+        findings.append(Finding("TJA023", "impure-capture", path,
+                                node.lineno, node.col_offset, sev, msg))
+
+    for qual, sites in sorted(b.closure.items()):
+        rec = b.fns.get(qual)
+        if rec is None or _is_test_path(rec.path):
+            continue
+        via = sites[0].describe() if sites else "a traced region"
+        short = qual.rsplit(".", 1)[-1]
+
+        # Calls recorded by the scope walker: mutators, print, logging.
+        for cr in rec.calls:
+            ref = cr.ref
+            if ref is None:
+                continue
+            if ref[0] == "name" and ref[1] == "print":
+                emit(rec.path, cr.node, WARNING,
+                     f"print() inside '{short}', traced from the {via}; "
+                     "it runs once at trace time, not per step -- use "
+                     "jax.debug.print")
+            elif (ref[0] == "attr" and ref[1] in LOG_RECEIVERS
+                    and ref[2] in LOG_METHODS):
+                emit(rec.path, cr.node, WARNING,
+                     f"{ref[1]}.{ref[2]}() inside '{short}', traced from "
+                     f"the {via}; it logs a tracer repr once at trace "
+                     "time -- log outside the traced region or use "
+                     "jax.debug.print")
+            elif ref[0] == "attr" and ref[2] in MUTATORS:
+                # A mutator whose result is bound is a functional API that
+                # happens to share the name (optax's ``tx.update(...)``
+                # returns new state); only a discarded result is the
+                # in-place shape.
+                if cr.targets:
+                    continue
+                leaf = ref[1]
+                owner = _owner_scope(b, rec, leaf)
+                if owner is None:
+                    continue        # unknown receiver: stay quiet
+                if owner.qual in b.closure:
+                    continue        # trace-local container: allowed
+                kind = ("module-level state"
+                        if owner.qual.endswith(".<module>")
+                        else f"state captured from '{owner.qual}'")
+                emit(rec.path, cr.node, ERROR,
+                     f"'{leaf}.{ref[2]}()' inside '{short}' mutates "
+                     f"{kind} at trace time (traced from the {via}); the "
+                     "mutation happens once, not per step -- thread the "
+                     "value through the computation instead")
+            elif ref[0] == "selfattr" and ref[2] in MUTATORS \
+                    and not cr.targets:
+                emit(rec.path, cr.node, ERROR,
+                     f"'self.{ref[1]}.{ref[2]}()' inside traced method "
+                     f"'{short}' (from the {via}) mutates object state at "
+                     "trace time; it will not happen per step")
+
+        # Statement-level writes: global/nonlocal and self.attr targets.
+        for node in _own_nodes(rec):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if (isinstance(n, ast.Name)
+                                and n.id in rec.outer_decls):
+                            emit(rec.path, node, ERROR,
+                                 f"write to global/nonlocal '{n.id}' "
+                                 f"inside '{short}', traced from the "
+                                 f"{via}; it executes once at trace "
+                                 "time -- return the value instead")
+                        elif (isinstance(n, ast.Attribute)
+                                and isinstance(n.value, ast.Name)
+                                and n.value.id == "self"
+                                and isinstance(n.ctx, ast.Store)):
+                            emit(rec.path, node, WARNING,
+                                 f"'self.{n.attr} = ...' inside traced "
+                                 f"method '{short}' (from the {via}); "
+                                 "object state mutates at trace time "
+                                 "only -- return the new value")
+
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -1,0 +1,164 @@
+"""TJA020 recompile-hazard: traced call sites that retrigger compilation.
+
+The serving plane's headline claim ("three traced executables total, no
+admission-pattern recompiles", docs/SERVING.md) and the step-loop goodput
+math both die quietly when a jit boundary sees a new shape, a new static
+value, or a brand-new wrapper object.  Three syntactic shapes cover the
+regressions the bench gates have actually caught:
+
+- **wrapper built per iteration**: ``jax.jit(...)`` constructed inside a
+  loop (or inside a function that runs once per hot-loop tick) misses the
+  jit cache -- every pass traces and compiles from scratch;
+- **runtime-varying statics**: a ``static_argnums``/``static_argnames``
+  argument fed ``len(queue)``-shaped values compiles one executable per
+  distinct value; a list/dict/set literal is not even hashable and fails
+  at dispatch;
+- **unpadded slices**: a traced operand built from a runtime-bound slice
+  (``prompt[pos:pos+n]`` with non-constant bounds) changes shape per call,
+  and every shape is a fresh compile.  Pad to a fixed shape (serve.py's
+  prefill chunk is the exemplar).
+
+Every finding names the varying source and the jit site it hits, via the
+memoized ``jit_boundary`` layer.  ``tests/`` are exempt: tests compile on
+purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.analyze import jit_boundary as jb
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+
+def _short(qual: str) -> str:
+    return qual.rsplit(".", 1)[-1]
+
+
+def _is_test_path(path: str) -> bool:
+    return path.startswith("tests/") or "/tests/" in path
+
+
+def _loop_assigned(rec: jb.FnRec) -> Set[str]:
+    """Names (re)bound somewhere under a loop in this scope."""
+    out: Set[str] = set()
+    for loop in rec.loops:
+        for node in ast.walk(loop):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.For, ast.NamedExpr)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+    return out
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except (ValueError, AttributeError):
+        return "<expr>"
+
+
+@register_project("TJA020", "recompile-hazard")
+def check(pc: ProjectContext) -> List[Finding]:
+    b = jb.boundary(pc)
+    findings: List[Finding] = []
+
+    def emit(path: str, line: int, col: int, sev: str, msg: str) -> None:
+        findings.append(Finding("TJA020", "recompile-hazard", path, line,
+                                col, sev, msg))
+
+    # Wrapper objects constructed per iteration / per tick.
+    for site in b.sites:
+        if site.kind in ("scan", "decorator") or _is_test_path(site.path):
+            continue
+        if site.wrap_in_loop:
+            emit(site.path, site.line, site.col, ERROR,
+                 f"jax.{site.kind} wrapper constructed inside a loop; each "
+                 "iteration builds a fresh wrapper, misses the jit cache "
+                 "and re-traces/recompiles -- hoist the wrapper out of the "
+                 "loop")
+        elif site.owner_qual in b.hot_fns:
+            hl = b.hot_fns[site.owner_qual]
+            emit(site.path, site.line, site.col, ERROR,
+                 f"jax.{site.kind} wrapper constructed in "
+                 f"'{_short(site.owner_qual)}', which runs every iteration "
+                 f"of the {hl.describe()}; each tick compiles a new "
+                 "executable -- build it once at init")
+
+    # Call-site hazards against known jitted bindings.
+    for qual, rec in b.fns.items():
+        if _is_test_path(rec.path):
+            continue
+        loop_names: Set[str] = set()
+        loop_names_built = False
+        for cr in rec.calls:
+            site = b.site_for_call(rec, cr)
+            if site is None:
+                continue
+            call = cr.node
+
+            def static_arg(arg: ast.expr, what: str) -> None:
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    emit(rec.path, arg.lineno, arg.col_offset, ERROR,
+                         f"non-hashable {arg.__class__.__name__.lower()} "
+                         f"literal passed as {what} to the "
+                         f"{site.describe()}; static arguments must be "
+                         "hashable (tuple it) or the dispatch raises")
+                    return
+                for n in ast.walk(arg):
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Name)
+                            and n.func.id == "len"):
+                        emit(rec.path, n.lineno, n.col_offset, WARNING,
+                             f"'{_src(n)}' feeds {what} of the "
+                             f"{site.describe()}; every distinct length "
+                             "compiles a new executable -- pad/bucket it "
+                             "or pass it traced")
+                        return
+                nonlocal loop_names_built, loop_names
+                if isinstance(arg, ast.Name) and cr.loop_stack:
+                    if not loop_names_built:
+                        loop_names = _loop_assigned(rec)
+                        loop_names_built = True
+                    if arg.id in loop_names:
+                        emit(rec.path, arg.lineno, arg.col_offset, WARNING,
+                             f"loop-varying '{arg.id}' feeds {what} of the "
+                             f"{site.describe()}; each new value is a "
+                             "cache miss and a recompile inside the loop")
+
+            for idx in site.static_argnums:
+                if idx < len(call.args):
+                    static_arg(call.args[idx], f"static_argnums[{idx}]")
+            for kw in call.keywords:
+                if kw.arg and kw.arg in site.static_argnames:
+                    static_arg(kw.value, f"static_argnames '{kw.arg}'")
+
+            # Traced (non-static) operands built from runtime-bound slices.
+            for i, arg in enumerate(call.args):
+                if i in site.static_argnums:
+                    continue
+                for n in ast.walk(arg):
+                    if not (isinstance(n, ast.Subscript)
+                            and isinstance(n.slice, ast.Slice)):
+                        continue
+                    bounds = [x for x in (n.slice.lower, n.slice.upper)
+                              if x is not None]
+                    if bounds and not all(isinstance(x, ast.Constant)
+                                          for x in bounds):
+                        emit(rec.path, n.lineno, n.col_offset, WARNING,
+                             f"traced operand '{_src(n)}' takes a "
+                             "runtime-bound slice; its shape varies per "
+                             f"call into the {site.describe()} and every "
+                             "shape recompiles -- pad to a fixed shape "
+                             "first")
+                        break
+
+    findings.sort(key=Finding.sort_key)
+    return findings

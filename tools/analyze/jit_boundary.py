@@ -1,0 +1,1147 @@
+"""Jit-boundary semantic layer: traced-region closure + hot-loop map.
+
+The serving and training planes stake their throughput claims on three
+static disciplines (docs/SERVING.md, docs/RECOVERY.md): fixed-shape traced
+executables (no admission-pattern recompiles), no host synchronization
+inside the step/decode hot loops beyond the deliberate fences, and buffer
+donation where a step function is state-in/state-out.  The bench gates
+enforce those dynamically; this module gives the analyzer the two facts it
+needs to enforce them *statically*:
+
+- the **traced-region closure**: every function reachable from a
+  ``jax.jit`` / ``pjit`` / ``pmap`` / ``shard_map`` / ``jax.lax.scan`` site,
+  with the wrapping call site and its static/donated argnums recorded.
+  Entries may be decorated defs, ``jit(fn)`` / ``jit(partial(fn, ...))``
+  wrap calls (including cross-module ``jit(lambda ...: mod.fn(...))``
+  shapes -- serve.py's three executables), or scan bodies; the closure
+  walks calls interprocedurally through the project symbol table.
+- the **hot-loop map**: loops that drive a device computation per
+  iteration, *seeded from loop-carried device values* -- a loop is hot
+  when a value produced by a dispatching call feeds back into a
+  dispatching call (``params, opt, loss = step_fn(params, opt, batch)``),
+  or when it invokes a *tick function*, one that round-trips object state
+  through a dispatching call (``self.cache = self._step_fn(..,
+  self.cache, ..)`` -- the serve scheduler).  No file names are special-
+  cased; train.py's step loop qualifies because ``step_fn`` is *tainted*
+  as a dispatching callable through the ``aot_or_jit`` higher-order chain,
+  not because of its path.
+
+"Dispatching callable" is a small fixpoint over the whole tree: jit
+bindings seed it; a function that calls one dispatches; a function that
+returns one (or returns a nested def that dispatches) yields dispatching
+call results; arguments referencing dispatching callables taint the
+callee's parameter.  Everything is a conservative, syntactic
+approximation, same trade as project.py: dynamic dispatch is invisible,
+waivers cover the rest.
+
+The boundary is built **once per run** and memoized on the
+``ProjectContext`` instance (like the MRO maps); ``BUILD_COUNT`` exists so
+tests can assert that.  All walks reuse the per-file ASTs and
+``by_type``/``parents`` caches the runner already built -- no re-parse.
+
+Consumed by TJA020 (recompile-hazard), TJA021 (host-sync-in-hot-loop),
+TJA022 (donation-discipline) and TJA023 (impure-capture).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import FileContext
+from tools.analyze.project import ProjectContext, _dotted
+
+#: jit-like wrappers: first positional arg (or the decorated def) is traced.
+TRACING_WRAPPERS = {"jit", "pjit", "pmap", "shard_map"}
+
+#: builds per process -- the boundary must be computed at most once per
+#: ProjectContext (tests assert this, like cfg.BUILD_COUNT).
+BUILD_COUNT = 0
+
+
+def is_test_path(path: str) -> bool:
+    """Test-suite files: excluded from the boundary graph (and from every
+    pass that consumes it)."""
+    return path.startswith("tests/") or "/tests/" in path
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _int_tuple(call: ast.Call, kwarg: str) -> Tuple[Tuple[int, ...], bool]:
+    """(literal ints, kwarg-present) for ``static_argnums=(0, 2)`` shapes."""
+    for kw in call.keywords:
+        if kw.arg != kwarg:
+            continue
+        v = kw.value
+        parts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        out = tuple(p.value for p in parts
+                    if isinstance(p, ast.Constant) and isinstance(p.value, int))
+        return out, True
+    return (), False
+
+
+def _str_tuple(call: ast.Call, kwarg: str) -> Tuple[Tuple[str, ...], bool]:
+    for kw in call.keywords:
+        if kw.arg != kwarg:
+            continue
+        v = kw.value
+        parts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        out = tuple(p.value for p in parts
+                    if isinstance(p, ast.Constant) and isinstance(p.value, str))
+        return out, True
+    return (), False
+
+
+@dataclass
+class JitSite:
+    """One place where Python code crosses into a traced computation."""
+    path: str
+    line: int
+    col: int
+    kind: str                       # jit|pjit|pmap|shard_map|scan|decorator
+    entry_qual: Optional[str] = None   # FnRec qual of the traced entry fn
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    donate_argnames: Tuple[str, ...] = ()
+    #: the kwarg appeared at all (literal or not) -- "donation considered".
+    has_static: bool = False
+    has_donate: bool = False
+    #: the scope that created the wrapper (module scope for top-level
+    #: bindings, ``__init__`` for the serve executables).
+    owner_qual: Optional[str] = None
+    #: the wrap call itself sits under a loop -- a fresh wrapper (and a
+    #: fresh jit cache entry) per iteration.
+    wrap_in_loop: bool = False
+
+    def describe(self) -> str:
+        return f"{self.kind} site at {self.path}:{self.line}"
+
+
+@dataclass
+class CallRec:
+    """One call expression inside a function scope."""
+    node: ast.Call
+    ref: Optional[tuple]            # ("name", n) | ("self", m)
+    #                               # | ("selfattr", attr, m)
+    #                               # | ("attr", leaf, m) | ("dotted", full)
+    #: flattened assignment targets when the call is an Assign RHS:
+    #: plain names as str, ``self.X`` as ("self", X).
+    targets: Tuple = ()
+    #: enclosing For/While nodes in this scope, outermost first.
+    loop_stack: Tuple = ()
+
+
+@dataclass
+class FnRec:
+    """Per-scope facts for one def/lambda (nested scopes get their own)."""
+    qual: str
+    node: ast.AST
+    path: str
+    module: str
+    cls: Optional[str] = None       # enclosing class qual for methods
+    parent: Optional[str] = None    # lexically enclosing FnRec qual
+    params: List[str] = field(default_factory=list)
+    local_names: Set[str] = field(default_factory=set)
+    calls: List[CallRec] = field(default_factory=list)
+    loops: List[ast.AST] = field(default_factory=list)
+    #: local name -> JitSite from ``x = jax.jit(...)`` in this scope.
+    jit_bindings: Dict[str, JitSite] = field(default_factory=dict)
+    #: local name -> class qual from ``x = ClassName(...)``.
+    local_ctors: Dict[str, str] = field(default_factory=dict)
+    #: function-level imports, alias -> dotted module/name (serve.py's
+    #: ``from ..models import decode as mod`` inside ``__init__``).
+    imports: Dict[str, str] = field(default_factory=dict)
+    nested: List[str] = field(default_factory=list)
+    #: plain names appearing in ``return <name>`` statements.
+    returns_names: Set[str] = field(default_factory=set)
+    #: every Name read anywhere in a return expression (tuples included) --
+    #: coarser than returns_names, used for device-value return taint.
+    return_name_refs: Set[str] = field(default_factory=set)
+    #: nested-def quals that are returned.
+    returns_nested: Set[str] = field(default_factory=set)
+    #: names declared global/nonlocal (writes hit enclosing state).
+    outer_decls: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class HotLoop:
+    path: str
+    line: int
+    fn_qual: str
+    #: the loop-carried device values that made it hot (witness).
+    carried: Tuple[str, ...] = ()
+    #: human-readable seed description for finding messages.
+    via: str = ""
+
+    def describe(self) -> str:
+        return f"hot loop at {self.path}:{self.line}"
+
+
+@dataclass
+class Boundary:
+    """The memoized product: closure + hot map + dispatch facts."""
+    sites: List[JitSite] = field(default_factory=list)
+    fns: Dict[str, FnRec] = field(default_factory=dict)
+    #: traced-region closure: fn qual -> the sites it is reachable from.
+    closure: Dict[str, List[JitSite]] = field(default_factory=dict)
+    #: module-level jitted callables: (module, name) -> site;
+    #: class-attr jitted callables: ("cls", class qual, attr) -> site.
+    bindings: Dict[tuple, JitSite] = field(default_factory=dict)
+    #: fn qual -> params known to receive dispatching callables.
+    param_taint: Dict[str, Set[str]] = field(default_factory=dict)
+    #: fn qual -> local names bound to dispatching call results.
+    dispatch_names: Dict[str, Set[str]] = field(default_factory=dict)
+    #: fn quals whose invocation dispatches device work.
+    dispatching: Set[str] = field(default_factory=set)
+    #: fn quals whose return value is a dispatching callable.
+    returns_dispatch: Set[str] = field(default_factory=set)
+    hot_loops: List[HotLoop] = field(default_factory=list)
+    #: fn qual -> witness loop, for functions invoked from a hot loop.
+    hot_fns: Dict[str, HotLoop] = field(default_factory=dict)
+    #: fn qual -> names/("self", attr) holding device values (hot scope only).
+    device_taint: Dict[str, Set] = field(default_factory=dict)
+    _pc: Optional[ProjectContext] = None
+    #: (fn.qual, ref) -> callee qual; resolution reads only structure fixed
+    #: before the fixpoint (defs, imports, ctors), so it never invalidates.
+    _resolve_cache: Dict = field(default_factory=dict)
+    #: id(CallRec) -> JitSite|None; jit bindings are likewise pre-fixpoint.
+    _site_cache: Dict = field(default_factory=dict)
+    #: id(CallRec) set of calls already proven to dispatch device work.
+    _device_true: Set = field(default_factory=set)
+    #: id(CallRec) set of calls that can never dispatch (static verdict).
+    _device_false: Set = field(default_factory=set)
+
+    # -- resolution shared by the TJA020-023 passes --------------------------
+
+    def resolve_callee(self, fn: FnRec, ref: tuple) -> Optional[str]:
+        """FnRec qual for a call ref as written inside ``fn``, or None."""
+        key = (fn.qual, ref)
+        try:
+            return self._resolve_cache[key]
+        except KeyError:
+            out = self._resolve_cache[key] = self._resolve_callee(fn, ref)
+            return out
+
+    def _resolve_callee(self, fn: FnRec, ref: tuple) -> Optional[str]:
+        pc = self._pc
+        mod = pc.modules.get(fn.module) if pc else None
+        if ref is None or mod is None:
+            return None
+        kind = ref[0]
+        if kind == "name":
+            name = ref[1]
+            # Lexically visible nested def shadows module scope.
+            scope = fn
+            while scope is not None:
+                cand = f"{scope.qual}.<locals>.{name}"
+                if cand in self.fns:
+                    return cand
+                imp = scope.imports.get(name)
+                if imp and imp in self.fns:
+                    return imp
+                scope = self.fns.get(scope.parent) if scope.parent else None
+            if f"{fn.module}.{name}" in self.fns:
+                return f"{fn.module}.{name}"
+            target = mod.imports.get(name)
+            if target and target in self.fns:
+                return target
+            return None
+        if kind == "self":
+            return self._method_qual(fn, ref[1])
+        if kind == "selfattr":
+            attr, meth = ref[1], ref[2]
+            ci = pc.classes.get(fn.cls) if fn.cls else None
+            if ci is not None:
+                ctor = ci.attr_ctors.get(attr)
+                if ctor:
+                    owner = pc.resolve_class(fn.module, ctor)
+                    if owner is not None:
+                        return self._class_method_qual(owner, meth)
+            return None
+        if kind == "attr":
+            leaf, meth = ref[1], ref[2]
+            ctor = None
+            scope = fn
+            while scope is not None and ctor is None:
+                ctor = scope.local_ctors.get(leaf)
+                scope = self.fns.get(scope.parent) if scope.parent else None
+            ctor = ctor or mod.global_ctors.get(leaf)
+            if ctor:
+                owner = (pc.classes.get(ctor)
+                         or pc.resolve_class(fn.module, ctor))
+                if owner is not None:
+                    return self._class_method_qual(owner, meth)
+            target = self._scope_import(fn, leaf) or mod.imports.get(leaf)
+            if target and f"{target}.{meth}" in self.fns:
+                return f"{target}.{meth}"
+            return None
+        if kind == "dotted":
+            full = ref[1]
+            head, _, rest = full.partition(".")
+            target = mod.imports.get(head)
+            if target and f"{target}.{rest}" in self.fns:
+                return f"{target}.{rest}"
+            return full if full in self.fns else None
+        return None
+
+    def _scope_import(self, fn: FnRec, name: str) -> Optional[str]:
+        scope = fn
+        while scope is not None:
+            imp = scope.imports.get(name)
+            if imp:
+                return imp
+            scope = self.fns.get(scope.parent) if scope.parent else None
+        return None
+
+    def _method_qual(self, fn: FnRec, meth: str) -> Optional[str]:
+        pc = self._pc
+        ci = pc.classes.get(fn.cls) if fn.cls else None
+        if ci is None:
+            return None
+        hit = pc.mro_methods(ci).get(meth)
+        if hit is None:
+            return None
+        owner, _node = hit
+        qual = f"{owner.qual}.{meth}"
+        return qual if qual in self.fns else None
+
+    def _class_method_qual(self, ci, meth: str) -> Optional[str]:
+        hit = self._pc.mro_methods(ci).get(meth)
+        if hit is None:
+            return None
+        owner, _node = hit
+        qual = f"{owner.qual}.{meth}"
+        return qual if qual in self.fns else None
+
+    def site_for_call(self, fn: FnRec, rec: CallRec) -> Optional[JitSite]:
+        """The JitSite a call dispatches through, when its callee is a known
+        jitted binding (local/enclosing name, ``self._step_fn``, module
+        binding, or a jit-decorated function)."""
+        key = id(rec)
+        try:
+            return self._site_cache[key]
+        except KeyError:
+            out = self._site_cache[key] = self._site_for_call(fn, rec)
+            return out
+
+    def _site_for_call(self, fn: FnRec, rec: CallRec) -> Optional[JitSite]:
+        ref = rec.ref
+        if ref is None:
+            return None
+        pc = self._pc
+        if ref[0] == "name":
+            name = ref[1]
+            scope = fn
+            while scope is not None:
+                site = scope.jit_bindings.get(name)
+                if site is not None:
+                    return site
+                scope = self.fns.get(scope.parent) if scope.parent else None
+            site = self.bindings.get((fn.module, name))
+            if site is not None:
+                return site
+            mod = pc.modules.get(fn.module)
+            target = mod.imports.get(name) if mod else None
+            if target:
+                owner, _, leaf = target.rpartition(".")
+                return self.bindings.get((owner, leaf))
+            return None
+        if ref[0] == "self" and fn.cls:
+            ci = pc.classes.get(fn.cls)
+            for c in (pc.mro_classes(ci) if ci else []):
+                site = self.bindings.get(("cls", c.qual, ref[1]))
+                if site is not None:
+                    return site
+            return None
+        if ref[0] == "attr":
+            mod = pc.modules.get(fn.module)
+            target = self._scope_import(fn, ref[1]) or (
+                mod.imports.get(ref[1]) if mod else None)
+            if target:
+                return self.bindings.get((target, ref[2]))
+        return None
+
+    def is_device_call(self, fn: FnRec, rec: CallRec) -> bool:
+        """True when the call dispatches device work: a jitted binding, a
+        tainted dispatching name/param, or a dispatching function."""
+        # Monotone memo: the taint sets consulted below only ever grow, so
+        # a True verdict stays True across fixpoint rounds.  Negatives are
+        # memoized only when nothing dynamic could flip them: an
+        # unresolvable ref, or a non-name ref whose (static) resolution
+        # found no callee to ever join ``dispatching``.
+        key = id(rec)
+        if key in self._device_true:
+            return True
+        if key in self._device_false:
+            return False
+        hit = self._is_device_call(fn, rec)
+        if hit:
+            self._device_true.add(key)
+        else:
+            ref = rec.ref
+            if ref is None or (ref[0] != "name"
+                               and self.resolve_callee(fn, ref) is None):
+                self._device_false.add(key)
+        return hit
+
+    def _is_device_call(self, fn: FnRec, rec: CallRec) -> bool:
+        if self.site_for_call(fn, rec) is not None:
+            return True
+        ref = rec.ref
+        if ref is None:
+            return False
+        if ref[0] == "name":
+            name = ref[1]
+            scope = fn
+            while scope is not None:
+                if name in self.dispatch_names.get(scope.qual, ()):
+                    return True
+                if name in self.param_taint.get(scope.qual, ()):
+                    return True
+                scope = self.fns.get(scope.parent) if scope.parent else None
+        callee = self.resolve_callee(fn, ref)
+        return callee is not None and callee in self.dispatching
+
+
+# -- per-file scope extraction ------------------------------------------------
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+#: Node classes the scope walker handles specially; everything else recurses.
+_SCOPE_NODES = frozenset({
+    ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Call,
+    ast.Assign, ast.AugAssign, ast.AnnAssign, ast.NamedExpr,
+    ast.For, ast.AsyncFor, ast.While, ast.Return,
+    ast.Global, ast.Nonlocal, ast.Import, ast.ImportFrom,
+    ast.withitem, ast.comprehension,
+})
+
+#: Childless (or child-irrelevant) nodes: recursing into them only visits
+#: ctx/operator tokens.
+_LEAF_NODES = frozenset({
+    ast.Name, ast.Constant, ast.Pass, ast.Break, ast.Continue,
+    ast.Load, ast.Store, ast.Del, ast.alias,
+})
+
+
+class _ScopeWalker:
+    """Fill one FnRec from its body, stopping at nested function scopes
+    (they get their own FnRec; call facts must not leak across -- same
+    deferred-execution rule as project._BodyWalker)."""
+
+    def __init__(self, rec: FnRec, register_nested):
+        self.rec = rec
+        self.register_nested = register_nested
+
+    def _flat_targets(self, target: ast.expr) -> List:
+        out: List = []
+        stack = [target]
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            elif isinstance(t, ast.Name):
+                out.append(t.id)
+            elif (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.append(("self", t.attr))
+        return out
+
+    def _callee_ref(self, call: ast.Call) -> Optional[tuple]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("name", f.id)
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self":
+                    return ("self", f.attr)
+                return ("attr", recv.id, f.attr)
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)):
+                if recv.value.id == "self":
+                    return ("selfattr", recv.attr, f.attr)
+                full = _dotted(f)
+                if full is not None:
+                    return ("dotted", full)
+        return None
+
+    def walk(self, body) -> None:
+        for stmt in body:
+            self.visit(stmt, (), ())
+
+    def _children(self, node: ast.AST, loops: tuple, targets: tuple) -> None:
+        # Inlined iter_child_nodes: two generator resumptions per node add
+        # up over ~150k visits (same trick as findings._build_walk).
+        visit = self.visit
+        d = node.__dict__
+        for name in node._fields:
+            v = d.get(name)
+            if v.__class__ is list:
+                for item in v:
+                    if isinstance(item, ast.AST):
+                        visit(item, loops, targets)
+            elif isinstance(v, ast.AST):
+                visit(v, loops, targets)
+
+    def visit(self, node: ast.AST, loops: tuple, targets: tuple) -> None:
+        cls = node.__class__
+        # Fast path: the vast majority of nodes are plain expressions with
+        # no scope-relevant structure -- recurse (or stop, for leaves)
+        # without running the dispatch chain below.
+        if cls not in _SCOPE_NODES:
+            if cls in _LEAF_NODES:
+                return
+            self._children(node, loops,
+                           targets if cls is ast.Expr else ())
+            return
+        rec = self.rec
+        if cls in (ast.FunctionDef, ast.AsyncFunctionDef):
+            rec.local_names.add(node.name)
+            self.register_nested(node, rec)
+            return
+        if cls is ast.Lambda:
+            self.register_nested(node, rec)
+            return
+        if cls is ast.Call:
+            rec.calls.append(CallRec(node, self._callee_ref(node),
+                                     targets=targets, loop_stack=loops))
+            self._children(node, loops, ())
+            return
+        if cls is ast.Assign:
+            tgts = []
+            for t in node.targets:
+                tgts.extend(self._flat_targets(t))
+            rec.local_names.update(t for t in tgts if isinstance(t, str))
+            # ``profiler = StepProfiler(...)``: a local object whose method
+            # calls resolve through the class (same heuristic as
+            # project.attr_ctors -- capitalized callee name).
+            if (len(tgts) == 1 and isinstance(tgts[0], str)
+                    and isinstance(node.value, ast.Call)):
+                cname = _base_name(node.value.func)
+                if cname and cname[:1].isupper():
+                    rec.local_ctors[tgts[0]] = cname
+            self.visit(node.value, loops, tuple(tgts))
+            return
+        if cls is ast.AugAssign or cls is ast.AnnAssign:
+            tgts = self._flat_targets(node.target)
+            rec.local_names.update(t for t in tgts if isinstance(t, str))
+            if node.value is not None:
+                self.visit(node.value, loops, tuple(tgts))
+            return
+        if cls is ast.NamedExpr:
+            tgts = self._flat_targets(node.target)
+            rec.local_names.update(t for t in tgts if isinstance(t, str))
+            self.visit(node.value, loops, tuple(tgts))
+            return
+        if cls is ast.For or cls is ast.AsyncFor:
+            rec.local_names.update(
+                t for t in self._flat_targets(node.target)
+                if isinstance(t, str))
+            rec.loops.append(node)
+            inner = loops + (node,)
+            self.visit(node.iter, loops, ())
+            for stmt in node.body:
+                self.visit(stmt, inner, ())
+            for stmt in node.orelse:
+                self.visit(stmt, loops, ())
+            return
+        if cls is ast.While:
+            rec.loops.append(node)
+            inner = loops + (node,)
+            self.visit(node.test, inner, ())
+            for stmt in node.body:
+                self.visit(stmt, inner, ())
+            for stmt in node.orelse:
+                self.visit(stmt, loops, ())
+            return
+        if cls is ast.Return:
+            if node.value is not None:
+                rec.return_name_refs.update(
+                    n.id for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name))
+            if isinstance(node.value, ast.Name):
+                rec.returns_names.add(node.value.id)
+            elif node.value is not None:
+                self.visit(node.value, loops, ())
+            return
+        if cls is ast.Global or cls is ast.Nonlocal:
+            rec.outer_decls.update(node.names)
+            return
+        if cls is ast.Import:
+            for alias in node.names:
+                key = alias.asname or alias.name.split(".")[0]
+                rec.imports[key] = alias.name
+                rec.local_names.add(key)
+            return
+        if cls is ast.ImportFrom:
+            base = node.module or ""
+            if node.level:
+                prefix = rec.module.split(".")[:-node.level]
+                base = ".".join(prefix + ([base] if base else []))
+            for alias in node.names:
+                key = alias.asname or alias.name
+                rec.imports[key] = f"{base}.{alias.name}" if base \
+                    else alias.name
+                rec.local_names.add(key)
+            return
+        if cls is ast.withitem:
+            if node.optional_vars is not None:
+                rec.local_names.update(
+                    t for t in self._flat_targets(node.optional_vars)
+                    if isinstance(t, str))
+            self.visit(node.context_expr, loops, ())
+            return
+        if cls is ast.comprehension:
+            rec.local_names.update(
+                t for t in self._flat_targets(node.target)
+                if isinstance(t, str))
+        self._children(node, loops, targets if cls is ast.Expr else ())
+
+
+# -- boundary construction ----------------------------------------------------
+
+def boundary(pc: ProjectContext) -> Boundary:
+    """The jit boundary for this run, built once and memoized on ``pc``."""
+    cached = getattr(pc, "_jit_boundary", None)
+    if cached is not None:
+        return cached
+    global BUILD_COUNT
+    BUILD_COUNT += 1
+    b = _build(pc)
+    pc._jit_boundary = b
+    return b
+
+
+def _build(pc: ProjectContext) -> Boundary:
+    b = Boundary(_pc=pc)
+    builder = _Builder(pc, b)
+    builder.collect_scopes()
+    builder.collect_sites()
+    builder.dispatch_fixpoint()
+    builder.hot_map()
+    builder.traced_closure()
+    builder.taint_device_values()
+    return b
+
+
+class _Builder:
+    def __init__(self, pc: ProjectContext, b: Boundary):
+        self.pc = pc
+        self.b = b
+        #: ast node id -> FnRec (for site entry resolution).
+        self.by_node: Dict[int, FnRec] = {}
+
+    # -- scopes ---------------------------------------------------------------
+
+    def collect_scopes(self) -> None:
+        for rel, ctx in self.pc.files.items():
+            if ctx.tree is None:
+                continue
+            # Test directories are outside the runtime dispatch graph the
+            # boundary models, and every TJA020-023 consumer exempts them
+            # anyway -- indexing their scopes is ~30% pure overhead.
+            if is_test_path(rel):
+                continue
+            mod = self.pc.module_of_path(rel)
+            if mod is None:
+                continue
+            cls_by_node = {id(ci.node): ci.qual
+                           for ci in mod.classes.values()}
+            # Top-level functions + methods seed the scope worklist; nested
+            # defs/lambdas are registered by their enclosing _ScopeWalker.
+            for name, node in mod.functions.items():
+                self._add_scope(node, f"{mod.name}.{name}", rel, mod.name,
+                                cls=None, parent=None)
+            for ci in mod.classes.values():
+                for name, node in ci.methods.items():
+                    self._add_scope(node, f"{ci.qual}.{name}", rel,
+                                    mod.name, cls=ci.qual, parent=None)
+            # Module top-level statements form an implicit scope so module-
+            # level jit bindings and loops are visible too.
+            self._add_module_scope(ctx, mod, cls_by_node)
+
+    def _add_module_scope(self, ctx: FileContext, mod, cls_by_node) -> None:
+        qual = f"{mod.name}.<module>"
+        rec = FnRec(qual=qual, node=ctx.tree, path=ctx.path,
+                    module=mod.name)
+        self.b.fns[qual] = rec
+        self.by_node[id(ctx.tree)] = rec
+        walker = _ScopeWalker(rec, self._register_nested)
+        body = [stmt for stmt in ctx.tree.body
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))]
+        walker.walk(body)
+
+    def _add_scope(self, node: ast.AST, qual: str, path: str, module: str,
+                   cls: Optional[str], parent: Optional[str]) -> FnRec:
+        rec = FnRec(qual=qual, node=node, path=path, module=module,
+                    cls=cls, parent=parent)
+        a = node.args
+        rec.params = [p.arg for p in a.posonlyargs + a.args]
+        rec.params += [p.arg for p in a.kwonlyargs]
+        if a.vararg:
+            rec.params.append(a.vararg.arg)
+        if a.kwarg:
+            rec.params.append(a.kwarg.arg)
+        rec.local_names.update(rec.params)
+        # Annotated params type their receiver: ``service: DecodeService``
+        # makes ``service.step()`` resolvable (string annotations too).
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            ann = getattr(p, "annotation", None)
+            cname = None
+            if isinstance(ann, (ast.Name, ast.Attribute)):
+                cname = _base_name(ann)
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                cname = ann.value.split(".")[-1].strip()
+            if cname and cname[:1].isupper():
+                rec.local_ctors.setdefault(p.arg, cname)
+        self.b.fns[qual] = rec
+        self.by_node[id(node)] = rec
+        walker = _ScopeWalker(rec, self._register_nested)
+        if isinstance(node, ast.Lambda):
+            walker.visit(node.body, (), ())
+        else:
+            walker.walk(node.body)
+        return rec
+
+    def _register_nested(self, node: ast.AST, parent: FnRec) -> None:
+        if isinstance(node, ast.Lambda):
+            qual = f"{parent.qual}.<lambda>L{node.lineno}"
+        else:
+            qual = f"{parent.qual}.<locals>.{node.name}"
+        rec = self._add_scope(node, qual, parent.path, parent.module,
+                              cls=parent.cls, parent=parent.qual)
+        parent.nested.append(qual)
+        # ``return inner`` / ``return lambda ...`` tracking: a Return whose
+        # value IS the nested node is recorded via the parents map.
+        anc = self._file_parents(parent.path).get(id(node))
+        while anc is not None and not isinstance(anc, _FUNC_TYPES):
+            if isinstance(anc, ast.Return):
+                parent.returns_nested.add(qual)
+                break
+            anc = self._file_parents(parent.path).get(id(anc))
+
+    def _file_parents(self, rel: str) -> dict:
+        ctx = self.pc.files.get(rel)
+        return ctx.parents if ctx is not None else {}
+
+    # -- sites ----------------------------------------------------------------
+
+    def collect_sites(self) -> None:
+        for qual, rec in list(self.b.fns.items()):
+            for cr in rec.calls:
+                self._maybe_site(rec, cr)
+            node = rec.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._maybe_decorator_site(rec, node)
+
+    def _tracing_kind(self, call: ast.Call) -> Optional[str]:
+        name = _base_name(call.func)
+        if name in TRACING_WRAPPERS:
+            return name
+        return None
+
+    def _maybe_site(self, rec: FnRec, cr: CallRec) -> None:
+        call = cr.node
+        name = _base_name(call.func)
+        if name in TRACING_WRAPPERS:
+            site = self._make_site(rec, call, name, call, cr)
+            entry = call.args[0] if call.args else None
+            site.entry_qual = self._resolve_entry(rec, entry)
+            self._bind(rec, cr, site)
+            self.b.sites.append(site)
+        elif name == "scan":
+            # jax.lax.scan(body, ...): the body is traced even outside jit.
+            dotted = _dotted(call.func) or ""
+            if not (dotted.endswith("lax.scan") or dotted == "scan"):
+                return
+            site = self._make_site(rec, call, "scan", None, cr)
+            entry = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "f":
+                    entry = kw.value
+            site.entry_qual = self._resolve_entry(rec, entry)
+            self.b.sites.append(site)
+
+    def _make_site(self, rec: FnRec, call: ast.Call, kind: str,
+                   statics_src: Optional[ast.Call],
+                   cr: Optional[CallRec] = None) -> JitSite:
+        site = JitSite(path=rec.path, line=call.lineno,
+                       col=call.col_offset, kind=kind,
+                       owner_qual=rec.qual,
+                       wrap_in_loop=bool(cr and cr.loop_stack))
+        if statics_src is not None:
+            self._fill_argnums(site, statics_src)
+        return site
+
+    def _fill_argnums(self, site: JitSite, call: ast.Call) -> None:
+        nums, has = _int_tuple(call, "static_argnums")
+        site.static_argnums, site.has_static = nums, has
+        names, has = _str_tuple(call, "static_argnames")
+        site.static_argnames = names
+        site.has_static = site.has_static or has
+        nums, has = _int_tuple(call, "donate_argnums")
+        site.donate_argnums, site.has_donate = nums, has
+        names, has = _str_tuple(call, "donate_argnames")
+        site.donate_argnames = names
+        site.has_donate = site.has_donate or has
+
+    def _maybe_decorator_site(self, rec: FnRec, node) -> None:
+        for dec in node.decorator_list:
+            wrap = None
+            if isinstance(dec, ast.Call):
+                name = _base_name(dec.func)
+                if name in TRACING_WRAPPERS:
+                    wrap = dec
+                elif name == "partial" and dec.args \
+                        and _base_name(dec.args[0]) in TRACING_WRAPPERS:
+                    wrap = dec
+            elif _base_name(dec) in TRACING_WRAPPERS:
+                wrap = ast.Call(func=dec, args=[], keywords=[])
+                wrap.lineno, wrap.col_offset = dec.lineno, dec.col_offset
+            if wrap is None:
+                continue
+            site = JitSite(path=rec.path, line=node.lineno,
+                           col=node.col_offset, kind="decorator",
+                           entry_qual=rec.qual,
+                           owner_qual=rec.parent or f"{rec.module}.<module>")
+            self._fill_argnums(site, wrap)
+            self.b.sites.append(site)
+            # The decorated NAME becomes a dispatching binding in its scope.
+            if rec.parent:
+                parent = self.b.fns[rec.parent]
+                parent.jit_bindings.setdefault(node.name, site)
+            elif rec.cls is None:
+                self.b.bindings.setdefault((rec.module, node.name), site)
+            else:
+                self.b.bindings.setdefault(("cls", rec.cls, node.name), site)
+
+    def _resolve_entry(self, rec: FnRec,
+                       entry: Optional[ast.expr]) -> Optional[str]:
+        """FnRec qual for the traced callable expression at a wrap site."""
+        while isinstance(entry, ast.Call) \
+                and _base_name(entry.func) == "partial" and entry.args:
+            entry = entry.args[0]
+        if entry is None:
+            return None
+        nested = self.by_node.get(id(entry))
+        if nested is not None:           # jit(lambda ...: ...)
+            return nested.qual
+        if isinstance(entry, ast.Name):
+            return self.b.resolve_callee(rec, ("name", entry.id))
+        if isinstance(entry, ast.Attribute):
+            full = _dotted(entry)
+            if full and "." in full:
+                head, _, restpath = full.partition(".")
+                qual = self.b.resolve_callee(
+                    rec, ("attr", head, restpath)) \
+                    if "." not in restpath else None
+                if qual:
+                    return qual
+                return self.b.resolve_callee(rec, ("dotted", full))
+        return None
+
+    def _bind(self, rec: FnRec, cr: CallRec, site: JitSite) -> None:
+        """Record what name the jitted callable is bound to."""
+        for t in cr.targets:
+            if isinstance(t, str):
+                if rec.node.__class__ is ast.Module:
+                    self.b.bindings[(rec.module, t)] = site
+                else:
+                    rec.jit_bindings[t] = site
+            elif isinstance(t, tuple) and t[0] == "self" and rec.cls:
+                self.b.bindings[("cls", rec.cls, t[1])] = site
+
+    # -- dispatch fixpoint ----------------------------------------------------
+
+    def dispatch_fixpoint(self) -> None:
+        b = self.b
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for qual, rec in b.fns.items():
+                disp = qual in b.dispatching
+                ret = qual in b.returns_dispatch
+                names = b.dispatch_names.setdefault(qual, set())
+                for cr in rec.calls:
+                    # Settled calls contribute nothing new: a proven
+                    # dispatch already marked its owner, and a static
+                    # never-dispatch has no callee to propagate through.
+                    cid = id(cr)
+                    if cid in b._device_true or cid in b._device_false:
+                        continue
+                    if b.is_device_call(rec, cr):
+                        if not disp:
+                            b.dispatching.add(qual)
+                            disp = changed = True
+                        callee = None
+                    else:
+                        callee = b.resolve_callee(rec, cr.ref)
+                        if callee in b.dispatching and not disp:
+                            b.dispatching.add(qual)
+                            disp = changed = True
+                    if callee and callee in b.returns_dispatch:
+                        for t in cr.targets:
+                            if isinstance(t, str) and t not in names:
+                                names.add(t)
+                                changed = True
+                    # Argument taint: passing a dispatching callable into a
+                    # known function taints that parameter.
+                    if callee:
+                        changed |= self._taint_args(rec, cr, callee)
+                # Returns.
+                if not ret:
+                    retnames = rec.returns_names
+                    if (retnames & names
+                            or retnames & set(rec.jit_bindings)
+                            or retnames & b.param_taint.get(qual, set())
+                            or any(n in b.dispatching
+                                   for n in rec.returns_nested)):
+                        b.returns_dispatch.add(qual)
+                        changed = True
+
+    def _is_dispatching_arg(self, rec: FnRec, arg: ast.expr) -> bool:
+        b = self.b
+        if isinstance(arg, ast.Name):
+            name = arg.id
+            scope = rec
+            while scope is not None:
+                if (name in scope.jit_bindings
+                        or name in b.dispatch_names.get(scope.qual, ())
+                        or name in b.param_taint.get(scope.qual, ())):
+                    return True
+                nested = f"{scope.qual}.<locals>.{name}"
+                if nested in b.dispatching:
+                    return True
+                scope = b.fns.get(scope.parent) if scope.parent else None
+            if (rec.module, name) in b.bindings:
+                return True
+            return f"{rec.module}.{name}" in b.dispatching
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)):
+            if arg.value.id == "self" and rec.cls:
+                return ("cls", rec.cls, arg.attr) in b.bindings
+        return False
+
+    def _taint_args(self, rec: FnRec, cr: CallRec, callee: str) -> bool:
+        target = self.b.fns.get(callee)
+        if target is None or not target.params:
+            return False
+        changed = False
+        taint = self.b.param_taint.setdefault(callee, set())
+        params = target.params
+        offset = 1 if (target.cls and params and params[0] == "self") else 0
+        for i, arg in enumerate(cr.node.args):
+            if self._is_dispatching_arg(rec, arg):
+                idx = i + offset
+                if idx < len(params) and params[idx] not in taint:
+                    taint.add(params[idx])
+                    changed = True
+        for kw in cr.node.keywords:
+            if kw.arg and self._is_dispatching_arg(rec, kw.value):
+                if kw.arg in params and kw.arg not in taint:
+                    taint.add(kw.arg)
+                    changed = True
+        return changed
+
+    # -- hot-loop map ---------------------------------------------------------
+
+    def _round_trip(self, rec: FnRec, calls: List[CallRec]):
+        """Loop-carried device values among ``calls``: targets of device
+        calls that feed back into device-call arguments."""
+        b = self.b
+        produced: Set = set()
+        consumed: Set = set()
+        for cr in calls:
+            if not b.is_device_call(rec, cr):
+                continue
+            produced.update(cr.targets)
+            for arg in ast.walk(cr.node):
+                if isinstance(arg, ast.Name):
+                    consumed.add(arg.id)
+                elif (isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"):
+                    consumed.add(("self", arg.attr))
+        carried = produced & consumed
+        return tuple(sorted(t if isinstance(t, str) else f"self.{t[1]}"
+                            for t in carried))
+
+    def hot_map(self) -> None:
+        b = self.b
+        # Tick functions: a device-call round trip anywhere in the body.
+        ticks: Dict[str, Tuple[str, ...]] = {}
+        for qual, rec in b.fns.items():
+            carried = self._round_trip(rec, rec.calls)
+            if carried:
+                ticks[qual] = carried
+        # leads-to-tick: calling it (transitively) runs a tick round trip.
+        leads: Dict[str, str] = {q: q for q in ticks}
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for qual, rec in b.fns.items():
+                if qual in leads:
+                    continue
+                for cr in rec.calls:
+                    callee = b.resolve_callee(rec, cr.ref)
+                    if callee in leads:
+                        leads[qual] = leads[callee]
+                        changed = True
+                        break
+        # Hot loops: carried round trip lexically inside the loop, or a
+        # call into a tick chain per iteration.
+        for qual, rec in b.fns.items():
+            for loop in rec.loops:
+                in_loop = [cr for cr in rec.calls
+                           if loop in cr.loop_stack]
+                carried = self._round_trip(rec, in_loop)
+                via = ""
+                if not carried:
+                    for cr in in_loop:
+                        callee = b.resolve_callee(rec, cr.ref)
+                        if callee in leads:
+                            tick = leads[callee]
+                            carried = ticks[tick]
+                            via = f"via {tick.rsplit('.', 1)[-1]}()"
+                            break
+                if carried:
+                    b.hot_loops.append(HotLoop(
+                        path=rec.path, line=loop.lineno, fn_qual=qual,
+                        carried=carried, via=via))
+        # Functions reachable from hot-loop bodies run once per iteration.
+        work: List[Tuple[str, HotLoop]] = []
+        for hl in b.hot_loops:
+            rec = b.fns[hl.fn_qual]
+            for cr in rec.calls:
+                if any(lp.lineno == hl.line for lp in cr.loop_stack):
+                    callee = b.resolve_callee(rec, cr.ref)
+                    if callee and callee not in b.hot_fns:
+                        b.hot_fns[callee] = hl
+                        work.append((callee, hl))
+        while work:
+            qual, hl = work.pop()
+            rec = b.fns.get(qual)
+            if rec is None:
+                continue
+            for cr in rec.calls:
+                callee = b.resolve_callee(rec, cr.ref)
+                if callee and callee not in b.hot_fns:
+                    b.hot_fns[callee] = hl
+                    work.append((callee, hl))
+
+    # -- traced closure -------------------------------------------------------
+
+    def traced_closure(self) -> None:
+        b = self.b
+        work: List[Tuple[str, JitSite]] = []
+        for site in b.sites:
+            if site.entry_qual and site.entry_qual in b.fns:
+                work.append((site.entry_qual, site))
+        while work:
+            qual, site = work.pop()
+            sites = b.closure.setdefault(qual, [])
+            if site in sites:
+                continue
+            sites.append(site)
+            rec = b.fns.get(qual)
+            if rec is None:
+                continue
+            for cr in rec.calls:
+                callee = b.resolve_callee(rec, cr.ref)
+                if callee and callee in b.fns:
+                    if site not in b.closure.get(callee, ()):
+                        work.append((callee, site))
+            # Nested defs (scan bodies, layer closures) trace with their
+            # parent -- they run inside the same staged computation.
+            for nested in rec.nested:
+                if site not in b.closure.get(nested, ()):
+                    work.append((nested, site))
+
+    # -- device-value taint (hot scope) ---------------------------------------
+
+    def taint_device_values(self) -> None:
+        """Names holding device values, per hot-scope function: targets of
+        device calls, plus params fed device values from hot call sites."""
+        b = self.b
+        hot_quals = set(b.hot_fns) | {hl.fn_qual for hl in b.hot_loops}
+        for qual in hot_quals:
+            rec = b.fns.get(qual)
+            if rec is None:
+                continue
+            taint = b.device_taint.setdefault(qual, set())
+            for cr in rec.calls:
+                if b.is_device_call(rec, cr):
+                    taint.update(cr.targets)
+        # A few propagation rounds: hot call sites passing tainted names
+        # taint the callee's parameters (the profiler-fence shape), and a
+        # callee returning tainted names taints the caller's assignment
+        # targets (``params, opt, loss, _ = run_elastic_loop(...)``).
+        for _ in range(4):
+            changed = False
+            for qual in hot_quals:
+                rec = b.fns.get(qual)
+                if rec is None:
+                    continue
+                taint = b.device_taint.get(qual, set())
+                for cr in rec.calls:
+                    callee = b.resolve_callee(rec, cr.ref)
+                    if not callee or callee not in hot_quals:
+                        continue
+                    target = b.fns[callee]
+                    ctaint = b.device_taint.setdefault(callee, set())
+                    params = target.params
+                    offset = 1 if (target.cls and params
+                                   and params[0] == "self") else 0
+                    for i, arg in enumerate(cr.node.args):
+                        if self._arg_tainted(rec, taint, arg):
+                            idx = i + offset
+                            if idx < len(params) \
+                                    and params[idx] not in ctaint:
+                                ctaint.add(params[idx])
+                                changed = True
+                    for kw in cr.node.keywords:
+                        if kw.arg and kw.arg in params \
+                                and self._arg_tainted(rec, taint, kw.value) \
+                                and kw.arg not in ctaint:
+                            ctaint.add(kw.arg)
+                            changed = True
+                    # Return taint: callee returns device values -> the
+                    # call's targets hold device values here.
+                    if target.return_name_refs & ctaint:
+                        for t in cr.targets:
+                            if t not in taint:
+                                b.device_taint.setdefault(
+                                    qual, taint).add(t)
+                                taint = b.device_taint[qual]
+                                changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _arg_tainted(rec: FnRec, taint: Set, arg: ast.expr) -> bool:
+        if isinstance(arg, ast.Name):
+            return arg.id in taint
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            return ("self", arg.attr) in taint
+        return False

@@ -141,6 +141,14 @@ def _dotted(node: ast.expr) -> Optional[str]:
     return None
 
 
+#: Node classes with no walk-relevant descendants (their only children are
+#: ctx/operator tokens); the body walker returns without recursing.
+_WALK_LEAVES = frozenset({
+    ast.Name, ast.Constant, ast.Pass, ast.Break, ast.Continue,
+    ast.Load, ast.Store, ast.Del, ast.alias,
+})
+
+
 class _BodyWalker:
     """One pass over a function body collecting the MethodSummary facts,
     tracking the stack of currently-held lock attributes."""
@@ -232,6 +240,10 @@ class _BodyWalker:
         # function body in the tree -- three isinstance tuple sieves per
         # node were a measurable slice of the lint budget.
         cls = node.__class__
+        if cls in _WALK_LEAVES:
+            # Childless (or child-irrelevant) nodes: recursing further only
+            # enumerates ctx/operator tokens.
+            return
         if cls is ast.Call:
             self._record_call(node, held)
         elif cls is ast.With or cls is ast.AsyncWith:

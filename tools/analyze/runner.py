@@ -64,10 +64,11 @@ def register_project(check_id: str, check_name: str):
 def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
-        broad_except, constant_drift, dead_reasons, env_contract,
-        event_reasons, exception_escape, finally_restore, lock_blocking,
-        lock_discipline, lock_order, metric_drift, orphaned_thread,
-        phase_transitions, py_compat, reconcile_purity, resource_leak,
+        broad_except, constant_drift, dead_reasons, donation_discipline,
+        env_contract, event_reasons, exception_escape, finally_restore,
+        host_sync_hot_loop, impure_capture, lock_blocking, lock_discipline,
+        lock_order, metric_drift, orphaned_thread, phase_transitions,
+        py_compat, recompile_hazard, reconcile_purity, resource_leak,
         retry_backoff, status_discipline, tracer_safety,
     )
 
@@ -109,10 +110,16 @@ def make_context(abs_path: str, root: str) -> FileContext:
 
 
 def run_checks(paths: Iterable[str], root: Optional[str] = None,
-               only: Optional[Iterable[str]] = None) -> List[Finding]:
+               only: Optional[Iterable[str]] = None,
+               report_only: Optional[Iterable[str]] = None) -> List[Finding]:
     """Run every registered pass (or the ``only`` subset, by name or id)
     over the .py files under ``paths``.  Waived findings are dropped here so
     every pass gets the same waiver semantics for free.
+
+    ``report_only`` (repo-relative paths) is incremental mode: file passes
+    run only on those files, and project passes -- which still build the
+    whole-program context from every file under ``paths``, since the call
+    graph spans unchanged code -- report only findings landing in them.
 
     The cyclic GC is suspended for the duration of the run: analysis
     allocates millions of AST nodes plus the walk/bucket/CFG caches over
@@ -123,14 +130,15 @@ def run_checks(paths: Iterable[str], root: Optional[str] = None,
     was_enabled = gc.isenabled()
     gc.disable()
     try:
-        return _run_checks(paths, root, only)
+        return _run_checks(paths, root, only, report_only)
     finally:
         if was_enabled:
             gc.enable()
 
 
 def _run_checks(paths: Iterable[str], root: Optional[str] = None,
-                only: Optional[Iterable[str]] = None) -> List[Finding]:
+                only: Optional[Iterable[str]] = None,
+                report_only: Optional[Iterable[str]] = None) -> List[Finding]:
     _load_checks()
     root = root or os.getcwd()
     selected = REGISTRY
@@ -151,11 +159,14 @@ def _run_checks(paths: Iterable[str], root: Optional[str] = None,
             raise ValueError(
                 f"unknown check(s): {sorted(unknown)}; "
                 f"known: {sorted(REGISTRY) + sorted(PROJECT_REGISTRY)}")
+    wanted_paths = set(report_only) if report_only is not None else None
     findings: List[Finding] = []
     contexts: Dict[str, FileContext] = {}
     for abs_path in iter_py_files(paths, root):
         ctx = make_context(abs_path, root)
         contexts[ctx.path] = ctx
+        if wanted_paths is not None and ctx.path not in wanted_paths:
+            continue
         for name, (_cid, fn) in selected.items():
             for f in fn(ctx):
                 if not ctx.waived(f.line, name):
@@ -166,6 +177,8 @@ def _run_checks(paths: Iterable[str], root: Optional[str] = None,
         project = ProjectContext.build(root, contexts)
         for name, (_cid, fn) in selected_project.items():
             for f in fn(project):
+                if wanted_paths is not None and f.path not in wanted_paths:
+                    continue
                 fctx = contexts.get(f.path)
                 if fctx is None or not fctx.waived(f.line, name):
                     findings.append(f)

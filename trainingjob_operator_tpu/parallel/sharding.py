@@ -66,7 +66,10 @@ def fit_spec(spec, shape, mesh):
         if not names:
             fitted.append(None)
             continue
-        entry = names if isinstance(entry, tuple) else names[0]
+        # Collapse singleton tuples to the bare axis name: dropping absent
+        # axes can shrink ("dp", "fsdp") to ("dp",), and PartitionSpec does
+        # not treat ("dp",) and "dp" as equal on every jax version.
+        entry = names if len(names) > 1 else names[0]
         size = math.prod(mesh.shape[n] for n in names)
         fitted.append(entry if size and shape[i] % size == 0 else None)
     return P(*fitted)

@@ -355,6 +355,9 @@ def main() -> int:
                              moved_bytes=agg["moved_bytes"]):
                 params = reshard.redistribute(params, new_mesh)
                 opt_state = reshard.redistribute(opt_state, new_mesh)
+                # analyzer: allow[host-sync-in-hot-loop] reshard-commit
+                # drain: the exchange must land before the resized loop
+                # restarts; runs once per resize, not per step.
                 jax.block_until_ready((params, opt_state))
             start_step = watcher.resume_step
         else:

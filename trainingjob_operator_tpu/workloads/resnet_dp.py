@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import sys
 import time
+from functools import partial
 
 
 def main() -> int:
@@ -63,7 +64,10 @@ def main() -> int:
     tx = optax.sgd(lr, momentum=0.9)
     opt_state = tx.init(params)
 
-    @jax.jit
+    # Donated state (TJA022): params/stats/opt_state round-trip through
+    # every step and the loop rebinds all three, so XLA reuses the input
+    # buffers for the outputs instead of double-buffering the full state.
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step_fn(p, s, o, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
             resnet.loss_fn, has_aux=True)(p, s, {"images": images,
@@ -104,14 +108,22 @@ def main() -> int:
         params, stats, opt_state, loss = step_fn(params, stats, opt_state,
                                                  images, labels)
         if i == start_step:
-            jax.block_until_ready(loss)  # exclude compile from throughput
+            # analyzer: allow[host-sync-in-hot-loop] first-step compile
+            # fence, gated to run once: excludes trace+compile from the
+            # throughput window.
+            jax.block_until_ready(loss)
             t_start = time.time()
         if (i + 1) % 10 == 0 or i == steps - 1:
+            # analyzer: allow[host-sync-in-hot-loop] periodic log read,
+            # gated to every 10th step; one bounded scalar D2H.
             print(f"step {i+1}/{steps} loss {float(loss):.4f}", flush=True)
-            state.save({"params": jax.device_get(params),
-                        "stats": jax.device_get(stats),
-                        "opt_state": jax.device_get(opt_state),
-                        "step": i + 1})
+            # Live device arrays: CheckpointState.save snapshots to host
+            # with async copies (the snapshot-donate path).  The previous
+            # jax.device_get per tree here was TJA021's canonical finding:
+            # three synchronous full-state D2H copies stalling the step
+            # loop, duplicating the copy save() does anyway.
+            state.save({"params": params, "stats": stats,
+                        "opt_state": opt_state, "step": i + 1})
     jax.block_until_ready(loss)
     state.finalize()  # commit any in-flight background save before exit
     dt = max(time.time() - (t_start or time.time()), 1e-9)

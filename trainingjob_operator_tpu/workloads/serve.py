@@ -140,13 +140,19 @@ class DecodeService:
                       "v": jnp.zeros(shape, dtype)}
         # Three executables serve the whole plane: slot/position/chunk
         # indices are traced operands, so admission order and prompt
-        # lengths never trigger a recompile.
+        # lengths never trigger a recompile.  The K/V cache operand is
+        # DONATED (TJA022): every call site immediately rebinds
+        # ``self.cache`` to the returned cache, so XLA aliases the input
+        # buffer to the output instead of holding two copies of the
+        # plane's largest array in HBM while a step runs.
         self._step_fn = jax.jit(
-            lambda p, cache, tok, ts: mod.serve_step(p, cache, tok, ts, c))
+            lambda p, cache, tok, ts: mod.serve_step(p, cache, tok, ts, c),
+            donate_argnums=(1,))
         self._prefill_fn = jax.jit(
             lambda p, cache, toks, slot, t0: mod.prefill_chunk(
-                p, cache, toks, slot, t0, c))
-        self._reset_fn = jax.jit(mod.reset_slot)
+                p, cache, toks, slot, t0, c),
+            donate_argnums=(1,))
+        self._reset_fn = jax.jit(mod.reset_slot, donate_argnums=(0,))
 
         self.queue: Deque[Request] = deque()
         self._next_rid = 0
@@ -162,20 +168,24 @@ class DecodeService:
     def warmup(self) -> None:
         """Compile the three serving executables before traffic arrives.
         Slot / position / chunk indices are traced operands, so one
-        dispatch each covers every future admission pattern; the dropped
-        functional outputs leave ``self.cache`` untouched.  Latency-
-        sensitive deployments (and the bench A/B, which must not time XLA
-        compilation) call this once at startup."""
+        dispatch each covers every future admission pattern.  The cache
+        operand is donated, so the warmup dispatches thread the cache
+        through all three calls and rebind ``self.cache`` at the end --
+        the pre-warmup buffer is dead once the first call returns.
+        Latency-sensitive deployments (and the bench A/B, which must not
+        time XLA compilation) call this once at startup."""
         import jax
         import jax.numpy as jnp
 
         n = len(self.slots)
         zeros = jnp.zeros((n,), jnp.int32)
         chunk = jnp.zeros((self.prefill_chunk,), jnp.int32)
-        _, c = self._prefill_fn(self.params, self.cache, chunk, 0, 0)
-        _, c = self._step_fn(self.params, c, zeros, zeros)
-        c = self._reset_fn(c, 0)
-        jax.block_until_ready(c["k"])
+        cache = self.cache
+        _, cache = self._prefill_fn(self.params, cache, chunk, 0, 0)
+        _, cache = self._step_fn(self.params, cache, zeros, zeros)
+        cache = self._reset_fn(cache, 0)
+        jax.block_until_ready(cache["k"])
+        self.cache = cache
 
     # -- request surface ------------------------------------------------------
 
@@ -273,6 +283,9 @@ class DecodeService:
                 # is the prompt's next-token distribution.
                 import numpy as np
 
+                # analyzer: allow[host-sync-in-hot-loop] the sampler is
+                # host-side by design (docs/SERVING.md): one first-token
+                # argmax per completed prefill, a bounded D2H.
                 first = int(np.argmax(np.asarray(logits[valid - 1])))
                 sl.state = DECODE
                 sl.t = len(req.prompt)
@@ -309,6 +322,9 @@ class DecodeService:
         logits, self.cache = self._step_fn(
             self.params, self.cache, jnp.asarray(toks, jnp.int32),
             jnp.asarray(ts, jnp.int32))
+        # analyzer: allow[host-sync-in-hot-loop] the per-tick sampler is
+        # host-side by design: exactly one batched logits D2H + argmax per
+        # decode tick, the documented serving cost (docs/SERVING.md).
         picks = np.argmax(np.asarray(logits), axis=-1)
         done: List[Request] = []
         for i in active:
