@@ -66,11 +66,15 @@ incident-demo:
 # Seeded ~200-job churn run against the sim cluster (docs/FLEET.md); exits
 # non-zero unless the fleet converges with zero invariant violations.
 # TRAININGJOB_FLEET_SEED / TRAININGJOB_FLEET_JOBS override the defaults.
+# The wall ceiling is 2x the event-kernel baseline (~12 s on the one-core
+# CI box): a run past it files a violation -- the tripwire for a sim-kernel
+# (or control-plane) throughput regression.
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m trainingjob_operator_tpu.fleet.harness \
 		--jobs $${TRAININGJOB_FLEET_JOBS:-200} \
 		--seed $${TRAININGJOB_FLEET_SEED:-0} \
-		--duration 3 --replicas-min 1 --replicas-max 4 --workers 4 --quiet
+		--duration 3 --replicas-min 1 --replicas-max 4 --workers 4 \
+		--max-wall-seconds 24 --quiet
 
 # Cold run -> serial warm resume -> overlapped warm resume at tiny shapes
 # (docs/RECOVERY.md); exits non-zero unless both resume paths work and

@@ -18,6 +18,7 @@ BASELINE.json's <90 s north star.
 
 import functools
 import json
+import math
 import os
 import re
 import statistics
@@ -594,6 +595,119 @@ def bench_control_plane(jobs=120, api_latency=0.005):
 
 
 # ---------------------------------------------------------------------------
+# Part 2c: fleet sim kernel -- scan-vs-event A/B at 1k jobs
+# ---------------------------------------------------------------------------
+
+def _bench_sim_steady(pods=2000, tick=0.001, window=5.0):
+    """Steady-state kubelet A/B, no controller: ``pods`` Running pods with
+    far-future exits, then a fixed measurement window of nothing happening
+    -- the regime a long-lived fleet spends nearly all its time in.  The
+    scan kernel walks every live pod every tick (O(pods x ticks)); the
+    event kernel sleeps to the next armed deadline (O(events)).  Loop CPU
+    over the window is the whole difference, measured directly."""
+    from trainingjob_operator_tpu.core.objects import (
+        Container, ObjectMeta, Pod, PodPhase, PodSpec)
+    from trainingjob_operator_tpu.client.clientset import Clientset
+    from trainingjob_operator_tpu.runtime.sim import (
+        RUN_SECONDS_ANNOTATION, SimRuntime)
+
+    out = {}
+    for kernel in ("scan", "event"):
+        cs = Clientset()
+        sim = SimRuntime(cs, tick=tick, pods_per_node=256, kernel=kernel)
+        for i in range(math.ceil(pods / 256)):
+            sim.add_node(f"steady-n{i:03d}")
+        for i in range(pods):
+            pod = Pod(metadata=ObjectMeta(
+                          name=f"steady-{i:05d}", namespace="default",
+                          annotations={RUN_SECONDS_ANNOTATION: "3600"}),
+                      spec=PodSpec(containers=[Container(name="aitj-main")]))
+            pod.spec.node_name = f"steady-n{i // 256:03d}"
+            cs.pods.create(pod)
+        sim.start()
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                running = sum(p.status.phase == PodPhase.RUNNING
+                              for p in cs.pods.list("default"))
+                if running == pods:
+                    break
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(f"{kernel}: steady fleet never started")
+            # All Running, every exit ~1 h out: reset the loop meters and
+            # let the kernel idle through the window.
+            sim.loop_passes = 0
+            sim.loop_cpu_seconds = 0.0
+            time.sleep(window)
+            out[kernel] = {"cpu_seconds": sim.loop_cpu_seconds,
+                           "loop_passes": sim.loop_passes}
+        finally:
+            sim.stop()
+    return out
+
+
+def bench_fleet_sim(jobs=1000):
+    """Scan-vs-event sim kernel A/B at 1k jobs (docs/FLEET.md), two legs.
+
+    Leg 1 -- the full fleet: one seeded churn schedule through the real
+    controller + sim cluster, once per kernel, pacing off (backlog
+    saturation), sim tick 1 ms (the event kernel fires at exact deadlines
+    regardless of tick, so matching its timing fidelity charges the scan
+    kernel its honest price).  Reports reconciles/s, sim events/s, and
+    convergence wall per kernel; the same seed must converge to
+    byte-identical phase counts under both (the determinism contract of
+    the discrete-event refactor).
+
+    Leg 2 -- steady state: the same replica count parked Running with
+    far-future exits, no controller, measuring kubelet loop CPU over a
+    fixed window.  This is where the 5x gate lives: a converged fleet is
+    almost always in this regime, and the scan kernel still pays the full
+    per-tick walk for it while the event kernel sleeps.  Gate:
+    scan-kernel steady-state loop CPU >= 5x the event kernel's (i.e. the
+    event kernel reconciles the same steady fleet on <= 1/5 the CPU).
+    ``TRAININGJOB_SIM_KERNEL=scan`` remains the CLI escape hatch for
+    one-off A/Bs outside bench.
+    """
+    from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+    from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+    profile = ChurnProfile(jobs=jobs, duration=6.0, seed=0, replicas=(1, 3),
+                           run_seconds=(0.05, 0.25))
+    runs = {}
+    for kernel in ("scan", "event"):
+        harness = FleetHarness(
+            profile, workers=4, pace=False, resync_period=30.0,
+            gc_interval=30.0, converge_timeout=1200.0, sim_tick=0.001,
+            sim_kernel=kernel)
+        runs[kernel] = harness.run()
+    scan, event = runs["scan"], runs["event"]
+
+    steady = _bench_sim_steady(pods=event.replicas_total)
+    cpu_speedup = (round(steady["scan"]["cpu_seconds"]
+                         / steady["event"]["cpu_seconds"], 1)
+                   if steady["event"]["cpu_seconds"] > 0 else None)
+    return {
+        "jobs": jobs,
+        "replicas_total": event.replicas_total,
+        "event_reconciles_per_s": round(event.reconciles_per_s, 2),
+        "scan_reconciles_per_s": round(scan.reconciles_per_s, 2),
+        "event_sim_events_per_s": round(event.sim_events_per_s, 2),
+        "event_wall_seconds": round(event.wall_seconds, 3),
+        "scan_wall_seconds": round(scan.wall_seconds, 3),
+        "wall_speedup": (round(scan.wall_seconds / event.wall_seconds, 2)
+                         if event.wall_seconds > 0 else None),
+        "phase_counts": event.phase_counts,
+        "phase_counts_identical": event.phase_counts == scan.phase_counts,
+        "converged": scan.converged and event.converged,
+        "steady_scan_cpu_seconds": round(steady["scan"]["cpu_seconds"], 3),
+        "steady_event_cpu_seconds": round(steady["event"]["cpu_seconds"], 3),
+        "steady_cpu_speedup": cpu_speedup,
+        "gate_speedup_ge_5x": cpu_speedup is not None and cpu_speedup >= 5.0,
+    }
+
+
+# ---------------------------------------------------------------------------
 # Part 3: FULL-workload recovery (VERDICT round 1, item 4): preempt a worker
 # of a real JAX job and time preempt -> a training step completes at the new
 # width -- includes process restart, JAX re-init, mesh rebuild, orbax restore.
@@ -947,25 +1061,32 @@ def bench_elastic_resize():
              "trainingjob_operator_tpu.workloads.llama_elastic"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
-        killer = threading.Timer(timeout, proc.kill)
-        killer.start()
-        lines = []
-        wrote = False
         try:
-            for raw in proc.stdout:
-                lines.append((time.perf_counter(), raw.rstrip("\n")))
-                if (write_gen and not wrote
-                        and re.match(r"step \d+/", lines[-1][1])):
-                    rdv = env["TRAININGJOB_RESIZE_DIR"]
-                    os.makedirs(rdv, exist_ok=True)
-                    tmp = os.path.join(rdv, ".generation.tmp")
-                    with open(tmp, "w") as fh:
-                        json.dump({"generation": 1, "world": [0, 1]}, fh)
-                    os.replace(tmp, os.path.join(rdv, "generation.json"))
-                    wrote = True
-            rc = proc.wait()
+            killer = threading.Timer(timeout, proc.kill)
+            killer.start()
+            lines = []
+            wrote = False
+            try:
+                for raw in proc.stdout:
+                    lines.append((time.perf_counter(), raw.rstrip("\n")))
+                    if (write_gen and not wrote
+                            and re.match(r"step \d+/", lines[-1][1])):
+                        rdv = env["TRAININGJOB_RESIZE_DIR"]
+                        os.makedirs(rdv, exist_ok=True)
+                        tmp = os.path.join(rdv, ".generation.tmp")
+                        with open(tmp, "w") as fh:
+                            json.dump({"generation": 1, "world": [0, 1]}, fh)
+                        os.replace(tmp, os.path.join(rdv, "generation.json"))
+                        wrote = True
+                rc = proc.wait()
+            finally:
+                killer.cancel()
         finally:
-            killer.cancel()
+            # Exception path (broken pipe, interrupt): never leak the child
+            # -- kill and reap it so repeated trials can't pile up orphans.
+            # kill() no-ops once wait() has reaped the child.
+            proc.kill()
+            proc.wait()
         if rc not in ok_rc:
             tail = "\n".join(line for _, line in lines[-8:])
             raise RuntimeError(f"llama_elastic rc={rc}: {tail[-400:]}")
@@ -1117,6 +1238,11 @@ def main() -> int:
     except Exception as exc:
         out["control_plane"] = {"error": f"{type(exc).__name__}: "
                                          f"{str(exc)[:300]}"}
+    try:
+        out["fleet_sim"] = bench_fleet_sim()
+    except Exception as exc:
+        out["fleet_sim"] = {"error": f"{type(exc).__name__}: "
+                                     f"{str(exc)[:300]}"}
     out["recovery_full"] = bench_recovery_full()
     try:
         out["time_to_resume_training"] = bench_time_to_resume_training(

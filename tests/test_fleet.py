@@ -226,9 +226,10 @@ class TestFleetAtScale:
         """The tentpole acceptance run: 10k jobs / ~100k replicas of seeded
         churn must converge with zero invariant violations.  Tier-1 excludes
         it (-m 'not slow').  Calibration: 1000 jobs / ~10k replicas converges
-        in ~13 min on one core (sim-bound at ~140 reconciles/s), so the
-        timeout scales with the job count -- at the full 10k this is a
-        multi-hour soak on a single core, proportionally faster with real
+        in ~15 min on one core under either sim kernel (controller-bound at
+        ~150 reconciles/s / ~135k syncs; see docs/FLEET.md "Sim kernels"),
+        so the timeout scales with the job count -- at the full 10k this is
+        a multi-hour soak on a single core, proportionally faster with real
         parallelism.  TRAININGJOB_FLEET_JOBS downsizes the run."""
         jobs = int(os.environ.get(constants.FLEET_JOBS_ENV, "10000"))
         seed = int(os.environ.get(constants.FLEET_SEED_ENV, "1"))

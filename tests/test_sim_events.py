@@ -1,0 +1,227 @@
+"""Discrete-event sim kernel tests: TimerQueue ordering/cancel semantics,
+watch-event timer re-arming, the created-then-deleted-in-window reap
+regression (PR 6), and scan-vs-event phase-count parity on a seeded fleet.
+
+The lifecycle tests drive ``SimRuntime(kernel="event")`` directly with raw
+pods (node preset, no controller): the point is the kernel's timer plumbing,
+not job semantics -- ``test_e2e_sim.py`` covers those end to end.
+"""
+
+import json
+
+import pytest
+
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.tracker import NotFoundError
+from trainingjob_operator_tpu.core.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+from trainingjob_operator_tpu.fleet.harness import FleetHarness
+from trainingjob_operator_tpu.runtime.events import TimerQueue
+from trainingjob_operator_tpu.runtime.sim import (
+    EXIT_CODE_ANNOTATION,
+    RUN_SECONDS_ANNOTATION,
+    START_DELAY_ANNOTATION,
+    SimRuntime,
+    resolve_kernel,
+)
+
+from conftest import wait_for  # noqa: E402
+
+
+class TestTimerQueue:
+    def test_pops_in_deadline_order(self):
+        q = TimerQueue()
+        q.arm("c", "exit", 3.0)
+        q.arm("a", "exit", 1.0)
+        q.arm("b", "exit", 2.0)
+        assert q.next_deadline() == 1.0
+        assert [k for k, _, _ in q.pop_due(10.0)] == ["a", "b", "c"]
+
+    def test_equal_deadlines_pop_in_arm_order(self):
+        """(deadline, seq) tie-break: same instant -> arm order, every run.
+
+        Seeded fleet determinism rides on this; a dict-order or id()-order
+        fallback would shuffle same-tick placements between runs."""
+        def build():
+            q = TimerQueue()
+            for key in ("x", "m", "a", "z", "b"):
+                q.arm(key, "start", 5.0)
+            return [k for k, _, _ in q.pop_due(5.0)]
+
+        first = build()
+        assert first == ["x", "m", "a", "z", "b"]  # arm order, not sorted
+        assert all(build() == first for _ in range(5))
+
+    def test_rearm_supersedes_old_deadline(self):
+        q = TimerQueue()
+        q.arm("p", "exit", 1.0)
+        q.arm("p", "exit", 9.0)  # deadline moved (preempt cleared, say)
+        assert q.depth() == 1
+        assert q.pop_due(5.0) == []  # old entry is a tombstone
+        assert q.next_deadline() == 9.0
+        assert q.pop_due(9.0) == [("p", "exit", 9.0)]
+
+    def test_arm_reports_new_earliest(self):
+        q = TimerQueue()
+        assert q.arm("a", "exit", 5.0)       # first is always earliest
+        assert not q.arm("b", "exit", 7.0)   # behind a: no wake needed
+        assert q.arm("c", "exit", 1.0)       # new front: wake the kernel
+
+    def test_cancel_and_cancel_all(self):
+        q = TimerQueue()
+        q.arm("p", "start", 1.0)
+        q.arm("p", "exit", 2.0)
+        q.arm("r", "exit", 3.0)
+        q.cancel("p", "start")
+        assert not q.armed("p", "start")
+        assert q.armed("p", "exit")
+        q.cancel_all("p")
+        assert q.depth() == 1
+        assert [k for k, _, _ in q.pop_due(10.0)] == ["r"]
+
+    def test_next_deadline_skips_tombstones(self):
+        q = TimerQueue()
+        q.arm("a", "exit", 1.0)
+        q.arm("b", "exit", 2.0)
+        q.cancel("a", "exit")
+        assert q.next_deadline() == 2.0
+        q.cancel("b", "exit")
+        assert q.next_deadline() is None
+
+    def test_pop_due_respects_limit_and_now(self):
+        q = TimerQueue()
+        for i in range(6):
+            q.arm(f"p{i}", "step", float(i))
+        assert len(q.pop_due(10.0, limit=4)) == 4
+        assert len(q.pop_due(4.5)) == 1  # p4; p5 due at 5.0 stays armed
+        assert q.depth() == 1
+
+    def test_compaction_keeps_live_timers(self):
+        """Re-arm storms leave tombstones; compaction must drop only those."""
+        q = TimerQueue()
+        q.arm("keep", "exit", 5000.0)
+        for i in range(1000):
+            q.arm("storm", "step", float(i))  # 999 tombstones
+        assert q.depth() == 2
+        assert len(q._heap) < 1000  # compaction actually ran
+        assert q.pop_due(999.0) == [("storm", "step", 999.0)]
+        assert q.next_deadline() == 5000.0
+
+
+def raw_pod(name, node="n0", run_seconds="0.1", exit_code="0",
+            start_delay=None):
+    annotations = {RUN_SECONDS_ANNOTATION: run_seconds,
+                   EXIT_CODE_ANNOTATION: exit_code}
+    if start_delay is not None:
+        annotations[START_DELAY_ANNOTATION] = start_delay
+    pod = Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                  annotations=annotations),
+              spec=PodSpec(containers=[Container(name="aitj-main")]))
+    pod.spec.node_name = node
+    return pod
+
+
+@pytest.fixture
+def event_sim():
+    cs = Clientset()
+    sim = SimRuntime(cs, kernel="event")
+    sim.add_node("n0")
+    sim.start()
+    yield cs, sim
+    sim.stop()
+
+
+def pod_phase(cs, name):
+    try:
+        return cs.pods.get("default", name).status.phase
+    except NotFoundError:
+        return None
+
+
+def pod_gone(cs, name):
+    return pod_phase(cs, name) is None
+
+
+class TestEventKernelLifecycle:
+    def test_pod_runs_and_exits_on_timers(self, event_sim):
+        """Create -> start timer -> self-echo arms the exit timer -> done."""
+        cs, sim = event_sim
+        cs.pods.create(raw_pod("p0", run_seconds="0.1"))
+        assert wait_for(lambda: pod_phase(cs, "p0") == PodPhase.RUNNING, 5)
+        assert wait_for(lambda: pod_phase(cs, "p0") == PodPhase.SUCCEEDED, 5)
+        assert sim.events_total > 0
+
+    def test_preempt_rearms_exit_timer(self, event_sim):
+        """An API event must retarget a pending deadline: the pod's exit
+        timer sits 30 s out; the preempt re-arms it to fire now."""
+        cs, sim = event_sim
+        cs.pods.create(raw_pod("victim", run_seconds="30"))
+        assert wait_for(lambda: pod_phase(cs, "victim") == PodPhase.RUNNING, 5)
+        sim.preempt_pod("default", "victim", exit_code=137)
+        assert wait_for(lambda: pod_phase(cs, "victim") == PodPhase.FAILED, 5)
+
+    def test_delete_cancels_timers_and_finalizes(self, event_sim):
+        """DELETED watch event cancels the (far-future) exit timer and the
+        grace timer finalizes the pod -- nothing waits on the 30 s exit."""
+        cs, sim = event_sim
+        cs.pods.create(raw_pod("doomed", run_seconds="30"))
+        assert wait_for(lambda: pod_phase(cs, "doomed") == PodPhase.RUNNING, 5)
+        cs.pods.delete("default", "doomed")
+        assert wait_for(lambda: pod_gone(cs, "doomed"), 5)
+
+    def test_created_then_deleted_in_window_never_wedges(self, event_sim):
+        """PR 6 reap regression: a pod created and deleted before its start
+        timer fires must still be finalized, and the kernel must keep
+        serving later pods (no wedged state entry, no leaked timers)."""
+        cs, sim = event_sim
+        cs.pods.create(raw_pod("blink", run_seconds="30", start_delay="0.5"))
+        cs.pods.delete("default", "blink")  # still Pending, start unfired
+        assert wait_for(lambda: pod_gone(cs, "blink"), 5)
+        # The kernel is still live: a follow-up pod runs to completion...
+        cs.pods.create(raw_pod("after", run_seconds="0.05"))
+        assert wait_for(lambda: pod_phase(cs, "after") == PodPhase.SUCCEEDED, 5)
+        # ...and the queue drains back to the watchdog heartbeat alone.
+        assert wait_for(lambda: sim._timers.depth() == 1, 5), sim._timers.depth()
+
+
+class TestKernelSelection:
+    def test_explicit_arg_wins(self, monkeypatch):
+        monkeypatch.setenv("TRAININGJOB_SIM_KERNEL", "event")
+        assert resolve_kernel("scan") == "scan"
+
+    def test_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("TRAININGJOB_SIM_KERNEL", "scan")
+        assert resolve_kernel() == "scan"
+        monkeypatch.delenv("TRAININGJOB_SIM_KERNEL")
+        assert resolve_kernel() == "event"
+
+    def test_unknown_kernel_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_kernel("quantum")
+
+
+class TestKernelParity:
+    def test_seeded_200_job_phase_counts_identical(self):
+        """The tentpole's determinism contract: the same seeded 200-job
+        churn run lands byte-identical per-fate phase counts under both
+        kernels (same profile as ``make fleet-smoke``).  ~2 x 11 s on the
+        single-core CI box -- the one deliberately slow tier-1 test here."""
+        profile = ChurnProfile(jobs=200, duration=3.0, seed=0,
+                               replicas=(1, 4))
+        counts = {}
+        for kernel in ("scan", "event"):
+            harness = FleetHarness(profile, workers=4, resync_period=30.0,
+                                   gc_interval=30.0, converge_timeout=120.0,
+                                   sim_kernel=kernel)
+            report = harness.run()
+            assert report.converged, (kernel, report.violations[:10])
+            assert report.violations == [], (kernel, report.violations[:10])
+            assert report.sim_kernel == kernel
+            counts[kernel] = json.dumps(report.phase_counts, sort_keys=True)
+        assert counts["scan"] == counts["event"]

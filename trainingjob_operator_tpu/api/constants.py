@@ -137,6 +137,10 @@ VIRTUAL_DEVICES_PER_SLICE_ENV = "TRAININGJOB_VIRTUAL_DEVICES_PER_SLICE"
 # driven.  User-set, never injected into containers.
 FLEET_SEED_ENV = "TRAININGJOB_FLEET_SEED"
 FLEET_JOBS_ENV = "TRAININGJOB_FLEET_JOBS"
+# Sim kubelet kernel (runtime/sim.py): "event" (default; discrete-event
+# timer queue, O(events)) or "scan" (the original fixed-cadence pod walk,
+# kept as the A/B baseline and escape hatch).  User-set, never injected.
+SIM_KERNEL_ENV = "TRAININGJOB_SIM_KERNEL"
 PALLAS_ENV = "TRAININGJOB_PALLAS"
 FA_BLOCK_Q_ENV = "TRAININGJOB_FA_BLOCK_Q"
 FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
@@ -217,6 +221,7 @@ USER_ENV_KNOBS = frozenset((
     PREFETCH_STALL_ENV,
     FLEET_SEED_ENV,
     FLEET_JOBS_ENV,
+    SIM_KERNEL_ENV,
     INCIDENT_RING_ENV,
     INCIDENT_BUNDLES_ENV,
     HBM_SAMPLE_STEPS_ENV,

@@ -89,6 +89,7 @@ from trainingjob_operator_tpu.runtime.sim import (
     EXIT_CODE_ANNOTATION,
     RUN_SECONDS_ANNOTATION,
     SimRuntime,
+    resolve_kernel,
 )
 from trainingjob_operator_tpu.obs.incident import INCIDENTS
 from trainingjob_operator_tpu.utils.metrics import METRICS
@@ -262,6 +263,21 @@ class FleetReport:
     wall_seconds: float
     sync_count: int
     reconciles_per_s: float
+    #: Which sim kubelet kernel ran (docs/FLEET.md): "event" or "scan".
+    sim_kernel: str
+    #: Timer events the event kernel dispatched (0 under scan) and the same
+    #: per wall second -- the O(events) cost the kernel actually paid,
+    #: reported beside reconciles/s for the scan-vs-event A/B.
+    sim_events_total: int
+    sim_events_per_s: float
+    #: Sim kubelet loop cost: passes through the kernel loop and the CPU
+    #: seconds they burned (thread time).  The scan kernel pays one pass per
+    #: tick whether or not anything happened -- O(pods x ticks); the event
+    #: kernel pays only for armed deadlines -- O(events).  Both kernels
+    #: deliver the same pod transitions on a seeded run, so cpu_scan /
+    #: cpu_event is the kernel's reconcile-throughput speedup.
+    sim_loop_passes: int
+    sim_cpu_seconds: float
     event_to_visible_ms: Dict[str, Any]
     workqueue_depth_high_water: int
     workqueue_retries_total: int
@@ -286,6 +302,11 @@ class FleetReport:
             "wall_seconds": round(self.wall_seconds, 3),
             "sync_count": self.sync_count,
             "reconciles_per_s": round(self.reconciles_per_s, 2),
+            "sim_kernel": self.sim_kernel,
+            "sim_events_total": self.sim_events_total,
+            "sim_events_per_s": round(self.sim_events_per_s, 2),
+            "sim_loop_passes": self.sim_loop_passes,
+            "sim_cpu_seconds": round(self.sim_cpu_seconds, 3),
             "event_to_visible_ms": self.event_to_visible_ms,
             "workqueue_depth_high_water": self.workqueue_depth_high_water,
             "workqueue_retries_total": self.workqueue_retries_total,
@@ -330,7 +351,8 @@ class FleetHarness:
                  resync_period: float = 2.0, resync_shards: int = 8,
                  gc_interval: float = 5.0, pods_per_node: int = 64,
                  converge_timeout: float = 60.0, with_ports: bool = False,
-                 sim_tick: float = 0.02,
+                 sim_tick: float = 0.02, sim_kernel: Optional[str] = None,
+                 max_wall_seconds: float = 0.0,
                  progress: Optional[Callable[[str], None]] = None):
         self.profile = profile
         self.workers = workers
@@ -342,9 +364,16 @@ class FleetHarness:
         self.pods_per_node = pods_per_node
         self.converge_timeout = converge_timeout
         self.with_ports = with_ports
-        # Sim kubelet tick: the per-tick lifecycle walk is O(live pods), so a
-        # fleet-sized run wants a coarser tick than the 5 ms test default.
+        # Sim kubelet tick: under the scan kernel the per-tick lifecycle
+        # walk is O(live pods), so a fleet-sized run wants a coarser tick
+        # than the 5 ms test default; the event kernel only uses it as the
+        # watchdog/serve-snapshot cadence.
         self.sim_tick = sim_tick
+        self.sim_kernel = resolve_kernel(sim_kernel)
+        # Wall-clock ceiling: 0 disables; otherwise a run past it files a
+        # violation (CI's regression tripwire for the event kernel -- see
+        # `make fleet-smoke`).
+        self.max_wall_seconds = max_wall_seconds
         self._progress = progress or (lambda _msg: None)
         self.violations: List[str] = []
 
@@ -363,7 +392,8 @@ class FleetHarness:
             thread_num=self.workers,
         ))
         sim = SimRuntime(cs, tick=self.sim_tick,
-                         pods_per_node=self.pods_per_node)
+                         pods_per_node=self.pods_per_node,
+                         kernel=self.sim_kernel)
         for i in range(max(1, math.ceil(total_replicas / self.pods_per_node))):
             sim.add_node(f"fleet-n{i:04d}")
         recorder = _LatencyRecorder(cs)
@@ -390,6 +420,11 @@ class FleetHarness:
             self.violations.append(
                 f"incident recorder left {unattributed:.1f} ms of downtime "
                 f"unattributed (phase 'unknown')")
+        if 0.0 < self.max_wall_seconds < wall:
+            self.violations.append(
+                f"wall clock {wall:.1f}s exceeded the "
+                f"{self.max_wall_seconds:.1f}s ceiling (sim kernel "
+                f"{self.sim_kernel!r} regressed?)")
 
         sync_count = self._sync_count() - sync_count_before
         phase_counts = self._phase_counts(cs)
@@ -403,6 +438,11 @@ class FleetHarness:
             wall_seconds=wall,
             sync_count=sync_count,
             reconciles_per_s=(sync_count / wall) if wall > 0 else 0.0,
+            sim_kernel=self.sim_kernel,
+            sim_events_total=sim.events_total,
+            sim_events_per_s=(sim.events_total / wall) if wall > 0 else 0.0,
+            sim_loop_passes=sim.loop_passes,
+            sim_cpu_seconds=sim.loop_cpu_seconds,
             event_to_visible_ms=recorder.percentiles(),
             workqueue_depth_high_water=tc.work_queue.depth_high_water,
             workqueue_retries_total=tc.work_queue.retries_total,
@@ -661,6 +701,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--resync-period", type=float, default=10.0)
     ap.add_argument("--gc-interval", type=float, default=10.0)
     ap.add_argument("--pods-per-node", type=int, default=64)
+    ap.add_argument("--sim-kernel", choices=("event", "scan"), default=None,
+                    help="Sim kubelet kernel (default: TRAININGJOB_SIM_KERNEL "
+                         "or 'event').")
+    ap.add_argument("--max-wall-seconds", type=float, default=0.0,
+                    help="Fail the run (violation + nonzero exit) if wall "
+                         "clock exceeds this; 0 disables.")
     ap.add_argument("--with-ports", action="store_true",
                     help="Give containers a port so per-index headless "
                          "Services are reconciled too.")
@@ -678,6 +724,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         api_latency=args.api_latency, converge_timeout=args.converge_timeout,
         resync_period=args.resync_period, gc_interval=args.gc_interval,
         pods_per_node=args.pods_per_node, with_ports=args.with_ports,
+        sim_kernel=args.sim_kernel, max_wall_seconds=args.max_wall_seconds,
         progress=progress)
     report = harness.run()
     print(json.dumps(report.to_dict(), indent=2))

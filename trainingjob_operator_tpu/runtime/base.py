@@ -38,7 +38,13 @@ class PodStateRuntime:
         self._missing: set = set()  # keys absent from exactly one walk
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: Loop cost accounting, read by the fleet harness's kernel A/B:
+        #: passes through _reconcile_once and the CPU seconds they burned
+        #: (thread time, so sleeps and lock waits don't count).
+        self.loop_passes = 0
+        self.loop_cpu_seconds = 0.0
         clientset.tracker.register_finalizer(Pod.KIND, self._on_terminating)
 
     # -- lifecycle -----------------------------------------------------------
@@ -50,15 +56,37 @@ class PodStateRuntime:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=3)
 
+    def kick(self) -> None:
+        """Wake the loop before its current sleep expires.  Watch handlers
+        call this when they arm a deadline earlier than the one the loop
+        went to sleep on; a spurious kick just costs one empty reconcile."""
+        self._wake.set()
+
+    def _next_wait(self) -> Optional[float]:
+        """Seconds to sleep before the next reconcile; None blocks until
+        ``kick()``.  The default is the fixed tick cadence every scanning
+        runtime (localproc, the sim's scan kernel) was built around; the
+        event kernel overrides this with time-to-earliest-deadline."""
+        return self._tick
+
     def _loop(self) -> None:
-        while not self._stop.wait(self._tick):
+        while True:
+            self._wake.wait(self._next_wait())
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            t0 = time.thread_time()
             try:
                 self._reconcile_once()
             except Exception:
                 log.exception("%s loop error", self.thread_name)
+            finally:
+                self.loop_cpu_seconds += time.thread_time() - t0
+                self.loop_passes += 1
 
     # -- per-pod state map ----------------------------------------------------
 

@@ -1,0 +1,127 @@
+"""Deterministic timer queue for the event-driven sim kernel.
+
+The discrete-event kernel (runtime/sim.py) replaces the per-tick pod walk
+with "every pod schedules its *next* transition": start delay, exit-at,
+graceful-delete expiry, step-synthesis cadence, serve-snapshot emission,
+scheduler retry, watchdog probe.  This module is the queue those deadlines
+live in -- a binary heap with two properties the kernel depends on:
+
+- **Deterministic ordering.**  Entries pop in ``(deadline, seq)`` order,
+  where ``seq`` is a monotonic arm counter: two timers due at the same
+  instant fire in the order they were armed, every run.  Seeded fleet runs
+  must produce byte-identical phase counts across kernels, so tie-breaking
+  can never fall back on dict order or thread timing.
+
+- **O(log n) cancel / re-arm by key.**  Watch events (delete, preempt,
+  node fail) retarget a pod's pending timers constantly.  Each logical
+  timer is addressed by ``(key, kind)``; arming again simply supersedes
+  the old deadline and cancellation is a dict pop.  Superseded/cancelled
+  heap entries are dropped lazily on pop ("tombstones"), with a compaction
+  pass when tombstones outnumber live entries.
+
+Thread-safety: all methods take the internal lock and touch nothing else,
+so TimerQueue sits at the *bottom* of any lock order -- callers may hold
+their own locks (the runtime's state lock, the tracker's dispatch lock)
+when arming or cancelling, and the queue never calls back out.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class TimerQueue:
+    """Keyed one-shot timers with deterministic (deadline, seq) ordering."""
+
+    #: Compact when dead heap entries exceed this many *and* outnumber the
+    #: live ones -- amortized O(1) per arm, bounded memory under re-arm storms.
+    _COMPACT_SLACK = 64
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[float, int, str, str]] = []
+        #: (key, kind) -> (deadline, seq) of the *live* entry; a heap entry
+        #: whose (deadline, seq) no longer matches is a tombstone.
+        self._armed: Dict[Tuple[str, str], Tuple[float, int]] = {}
+        self._seq = 0
+
+    # -- arming ---------------------------------------------------------------
+
+    def arm(self, key: str, kind: str, deadline: float) -> bool:
+        """Schedule (or reschedule) the ``(key, kind)`` timer for
+        ``deadline``.  Returns True when this became the queue's earliest
+        deadline -- the caller should wake the sleeping kernel thread."""
+        with self._lock:
+            self._seq += 1
+            entry = (deadline, self._seq)
+            self._armed[(key, kind)] = entry
+            heapq.heappush(self._heap, (deadline, self._seq, key, kind))
+            self._maybe_compact_locked()
+            return self._heap[0][1] == self._seq
+
+    def cancel(self, key: str, kind: str) -> None:
+        """Forget the ``(key, kind)`` timer if armed (tombstones the heap
+        entry; it is skipped on pop)."""
+        with self._lock:
+            self._armed.pop((key, kind), None)
+
+    def cancel_all(self, key: str) -> None:
+        """Forget every timer armed under ``key`` (pod deleted)."""
+        with self._lock:
+            dead = [k for k in self._armed if k[0] == key]
+            for k in dead:
+                del self._armed[k]
+
+    def armed(self, key: str, kind: str) -> bool:
+        """Whether a live ``(key, kind)`` timer is pending.  Lets callers
+        keep a relative-cadence timer (serve snapshots every tick) from
+        being pushed ever later by unrelated re-arms."""
+        with self._lock:
+            return (key, kind) in self._armed
+
+    # -- draining -------------------------------------------------------------
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest live deadline, or None when nothing is armed."""
+        with self._lock:
+            while self._heap:
+                deadline, seq, key, kind = self._heap[0]
+                if self._armed.get((key, kind)) == (deadline, seq):
+                    return deadline
+                heapq.heappop(self._heap)  # tombstone
+            return None
+
+    def pop_due(self, now: float,
+                limit: Optional[int] = None) -> List[Tuple[str, str, float]]:
+        """Remove and return every timer with ``deadline <= now`` as
+        ``(key, kind, deadline)`` tuples in deterministic (deadline, seq)
+        order.  ``limit`` bounds one drain so a storm cannot starve the
+        kernel loop's wake/stop checks."""
+        due: List[Tuple[str, str, float]] = []
+        with self._lock:
+            while self._heap and (limit is None or len(due) < limit):
+                deadline, seq, key, kind = self._heap[0]
+                if deadline > now:
+                    break
+                heapq.heappop(self._heap)
+                if self._armed.get((key, kind)) == (deadline, seq):
+                    del self._armed[(key, kind)]
+                    due.append((key, kind, deadline))
+        return due
+
+    def depth(self) -> int:
+        """Live (armed) timer count -- the queue-depth gauge."""
+        with self._lock:
+            return len(self._armed)
+
+    # -- internals ------------------------------------------------------------
+
+    def _maybe_compact_locked(self) -> None:
+        dead = len(self._heap) - len(self._armed)
+        if dead > self._COMPACT_SLACK and dead > len(self._armed):
+            live = {(ds[0], ds[1], k[0], k[1])
+                    for k, ds in self._armed.items()}
+            self._heap = [e for e in self._heap if e in live]
+            heapq.heapify(self._heap)

@@ -121,6 +121,10 @@ class LocalProcRuntime(PodStateRuntime):
                 proc.popen.send_signal(signal.SIGTERM)
             except ProcessLookupError:
                 pass
+        # Shared tick contract (base.py): wake the loop so the grace clock
+        # and exit reporting for this pod start on the next pass, not up to
+        # a full tick later.
+        self.kick()
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -13,15 +13,33 @@ that substrate for the in-memory backend: a background loop that
 - exposes fault injection: fail/recover nodes, preempt pods -- the knobs
   SURVEY.md §4 says the reference exercises operationally (delete pods /
   mark nodes NotReady / set the Preempted annotation).
+
+Two kernels drive the same semantics (docs/FLEET.md):
+
+- **event** (default): a discrete-event kernel.  Every pod arms its *next*
+  transition -- start delay, exit-at, graceful-delete expiry, step-synthesis
+  cadence, serve-snapshot emission -- as a deadline in a deterministic
+  ``TimerQueue`` (runtime/events.py), and the sim thread sleeps until the
+  earliest one.  Watch events cancel-or-re-arm a pod's timers instead of
+  waiting for a scan, and pending-gang placement is an event re-armed on
+  node/capacity changes rather than an every-tick retry.  Cost is
+  O(events), not O(pods x ticks): a parked fleet of settled or steady pods
+  costs nothing.
+- **scan** (``TRAININGJOB_SIM_KERNEL=scan``): the original fixed-cadence
+  walk over the active pod set, kept as the A/B baseline and escape hatch.
+
+Both kernels converge seeded runs to byte-identical phase counts; the
+``fleet_sim`` bench leg (bench.py) gates the event kernel's throughput win.
 """
 
 from __future__ import annotations
 
 import copy
 import logging
+import os
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.client.clientset import Clientset
@@ -41,6 +59,8 @@ from trainingjob_operator_tpu.core.objects import (
 from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
 from trainingjob_operator_tpu.obs.trace import TRACER
 from trainingjob_operator_tpu.runtime.base import PodStateRuntime
+from trainingjob_operator_tpu.runtime.events import TimerQueue
+from trainingjob_operator_tpu.utils.metrics import METRICS
 
 log = logging.getLogger("trainingjob.sim")
 
@@ -85,9 +105,30 @@ SERVE_ACTIVE_ANNOTATION = "sim.tpu.trainingjob.dev/serve-active-slots"
 SERVE_P99_ANNOTATION = "sim.tpu.trainingjob.dev/serve-p99-ms"
 SERVE_TPS_ANNOTATION = "sim.tpu.trainingjob.dev/serve-tokens-per-sec"
 
-#: Step records synthesized per pod per tick, at most (a pod "catching up"
-#: after a long scheduler pause must not flood the aggregator's window).
+#: Step records synthesized per pod per tick/step-event batch, at most (a
+#: pod "catching up" after a long scheduler pause must not flood the
+#: aggregator's window).
 _MAX_STEPS_PER_TICK = 200
+
+#: One event-kernel drain pops at most this many due timers, so a deadline
+#: storm cannot starve the loop's stop/wake checks.
+_MAX_EVENTS_PER_DRAIN = 4096
+
+#: Cluster-singleton timer key (scheduler retry + stall watchdog).
+_CLUSTER_KEY = "@cluster"
+
+#: Per-pod timer kinds a lifecycle change must retarget together.
+_POD_TIMER_KINDS = ("start", "exit", "grace", "step", "serve")
+
+
+def resolve_kernel(kernel: Optional[str] = None) -> str:
+    """Kernel choice: explicit argument wins, then the
+    ``TRAININGJOB_SIM_KERNEL`` escape hatch, then the event kernel."""
+    choice = kernel or os.environ.get(constants.SIM_KERNEL_ENV) or "event"
+    if choice not in ("event", "scan"):
+        raise ValueError(f"unknown sim kernel {choice!r} "
+                         "(expected 'event' or 'scan')")
+    return choice
 
 
 @dataclass
@@ -111,11 +152,20 @@ class SimRuntime(PodStateRuntime):
                  start_delay: float = 0.0,
                  tick: float = 0.005,
                  termination_grace: float = 0.05,
-                 pods_per_node: int = 64):
+                 pods_per_node: int = 64,
+                 kernel: Optional[str] = None):
         super().__init__(clientset, tick)
         self._start_delay = start_delay
         self._termination_grace = termination_grace
         self._pods_per_node = pods_per_node
+        self._kernel = resolve_kernel(kernel)
+        # Discrete-event state: the deadline queue, the set of pending-
+        # unscheduled pod keys (feeds the "sched" event), and a plain event
+        # counter the fleet harness reports as events/s.  All are inert
+        # under the scan kernel.
+        self._timers = TimerQueue()
+        self._pending: set = set()
+        self.events_total = 0
         # Watch-fed pod/node caches: at fleet scale a per-tick
         # ``pods.list()`` deepcopies the whole store (100k pods x 200 Hz is
         # the difference between a working sim and one that never catches
@@ -151,11 +201,22 @@ class SimRuntime(PodStateRuntime):
             clientset.tracker.watch(Pod.KIND, self._on_pod_event),
             clientset.tracker.watch(Node.KIND, self._on_node_event),
         ]
+        now = time.time()
         with self._lock:
             for pod in clientset.tracker.list(Pod.KIND):
-                self._on_pod_cached(f"{pod.namespace}/{pod.name}", pod)
+                key = f"{pod.namespace}/{pod.name}"
+                self._on_pod_cached(key, pod)
+                if self._kernel == "event":
+                    self._arm_for_pod_locked(key, pod, now)
             for node in clientset.tracker.list(Node.KIND):
                 self._nodes_cache[node.name] = node
+        if self._kernel == "event":
+            # The kubelet tick doubles as the step-progress watchdog tick
+            # under the scan kernel; the event kernel keeps that cadence as
+            # a self-re-arming cluster event (cheap: O(tracked replicas)).
+            self._timers.arm(_CLUSTER_KEY, "watchdog", now + self._tick)
+            METRICS.gauge("trainingjob_sim_event_queue_depth",
+                          self._timers.depth)
 
     @staticmethod
     def _settled(pod: Pod) -> bool:
@@ -215,8 +276,17 @@ class SimRuntime(PodStateRuntime):
                 self._pods_cache.pop(key, None)
                 self._active_cache.pop(key, None)
                 self._account_pod_locked(key, None)
+                if self._kernel == "event":
+                    self._state.pop(key, None)
+                    self._pending.discard(key)
+                    self._timers.cancel_all(key)
+                    if self._pending:
+                        # Freed capacity: a waiting gang may fit now.
+                        self._arm_now_locked(_CLUSTER_KEY, "sched")
             else:
                 self._on_pod_cached(key, pod)
+                if self._kernel == "event":
+                    self._arm_for_pod_locked(key, pod, time.time())
 
     def _on_node_event(self, event: WatchEvent) -> None:
         node = event.obj
@@ -225,12 +295,27 @@ class SimRuntime(PodStateRuntime):
                 self._nodes_cache.pop(node.name, None)
             else:
                 self._nodes_cache[node.name] = node
+            if self._kernel == "event":
+                # Capacity/readiness moved: re-arm everything on the node
+                # (a recovered node resumes its pods' paused deadlines) and
+                # give waiting gangs another placement attempt.  Node
+                # events are rare -- cluster setup and fault injection --
+                # so the O(active) re-arm walk stays off every hot path.
+                if event.type != DELETED:
+                    now = time.time()
+                    for key, pod in self._active_cache.items():
+                        if pod.spec.node_name == node.name:
+                            self._arm_for_pod_locked(key, pod, now)
+                if self._pending:
+                    self._arm_now_locked(_CLUSTER_KEY, "sched")
 
     def stop(self) -> None:
         super().stop()
         for unsub in self._unsubs:
             unsub()
         self._unsubs = []
+        if self._kernel == "event":
+            METRICS.remove_gauge("trainingjob_sim_event_queue_depth")
 
     def _new_state(self, uid: str) -> _PodRuntime:
         return _PodRuntime(uid=uid)
@@ -257,6 +342,10 @@ class SimRuntime(PodStateRuntime):
                     if pod is not None and pod.spec.node_name == name:
                         rt.will_exit_at = None  # frozen: no further reports
                         rt.frozen_on = name
+                        if self._kernel == "event":
+                            self._timers.cancel(key, "exit")
+                            self._timers.cancel(key, "step")
+                            self._timers.cancel(key, "serve")
 
     def recover_node(self, name: str) -> None:
         """Node comes back Ready.  Pods whose processes were frozen by
@@ -264,11 +353,13 @@ class SimRuntime(PodStateRuntime):
         reporting its containers gone."""
         self.set_node_ready(name, True)
         with self._lock:
-            for rt in self._state.values():
+            for key, rt in self._state.items():
                 if rt.frozen_on == name:
                     rt.will_exit_at = time.time()
                     rt.exit_code = 137
                     rt.frozen_on = ""
+                    if self._kernel == "event":
+                        self._arm_now_locked(key, "exit")
 
     def preempt_pod(self, namespace: str, name: str, exit_code: int = 137) -> None:
         """SIGKILL analogue: container dies with the given code now."""
@@ -277,10 +368,339 @@ class SimRuntime(PodStateRuntime):
             if rt is not None:
                 rt.will_exit_at = time.time()
                 rt.exit_code = exit_code
+                if self._kernel == "event":
+                    self._arm_now_locked(f"{namespace}/{name}", "exit")
 
-    # -- the kubelet/scheduler tick ------------------------------------------
+    # -- the discrete-event kernel --------------------------------------------
+
+    def _arm(self, key: str, kind: str, deadline: float) -> None:
+        if self._timers.arm(key, kind, deadline):
+            self.kick()  # new earliest deadline: wake the sleeping loop
+
+    def _arm_now_locked(self, key: str, kind: str) -> None:
+        self._arm(key, kind, time.time())
+
+    def _rt_locked(self, key: str, uid: str) -> _PodRuntime:
+        rt = self._state.get(key)
+        if rt is None or (rt.uid and uid and rt.uid != uid):
+            rt = self._new_state(uid)
+            self._state[key] = rt
+        return rt
+
+    def _cancel_lifecycle_locked(self, key: str,
+                                 keep: Tuple[str, ...] = ()) -> None:
+        for kind in _POD_TIMER_KINDS:
+            if kind not in keep:
+                self._timers.cancel(key, kind)
+
+    def _arm_for_pod_locked(self, key: str, pod: Pod, now: float) -> None:
+        """Retarget ``key``'s timers from its freshly observed object: each
+        watch event re-derives which single transition is next and arms
+        exactly that.  Idempotent -- deadlines are derived from recorded
+        state (scheduled_at, will_exit_at, terminating_since), so a re-arm
+        from a no-op MODIFIED supersedes with the same instant."""
+        rt = self._rt_locked(key, pod.metadata.uid)
+        if pod.metadata.deletion_timestamp is not None:
+            # Terminating: the grace clock is the only live deadline.  The
+            # finalizer stamps terminating_since right after this event
+            # drains; stamp first-observation time here so a created-then-
+            # deleted-in-one-window pod can never wedge un-finalized.
+            self._pending.discard(key)
+            if rt.terminating_since is None:
+                rt.terminating_since = now
+            self._cancel_lifecycle_locked(key, keep=("grace",))
+            self._arm(key, "grace",
+                      rt.terminating_since + self._termination_grace)
+            return
+        phase = pod.status.phase
+        if phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            self._pending.discard(key)
+            self._cancel_lifecycle_locked(key)
+            return
+        if phase == PodPhase.PENDING and not pod.spec.node_name:
+            # Newly pending feeds the scheduler event; an already-pending
+            # pod's MODIFIED (e.g. our own Unschedulable condition echo)
+            # must NOT re-arm it, or a never-fitting gang would spin.
+            self._cancel_lifecycle_locked(key)
+            if key not in self._pending:
+                self._pending.add(key)
+                self._arm_now_locked(_CLUSTER_KEY, "sched")
+            return
+        if phase == PodPhase.PENDING:
+            # Scheduled: the start delay counts from first observation,
+            # exactly like the scan kernel's walk.
+            self._pending.discard(key)
+            if rt.scheduled_at == 0.0:
+                rt.scheduled_at = now
+            try:
+                delay = float(pod.metadata.annotations.get(
+                    START_DELAY_ANNOTATION, self._start_delay))
+            except ValueError:
+                delay = self._start_delay
+            self._arm(key, "start", rt.scheduled_at + delay)
+            return
+        if phase == PodPhase.RUNNING:
+            self._pending.discard(key)
+            self._timers.cancel(key, "start")
+            if rt.frozen_on:
+                # Dead host: no reports until recover_node re-arms "exit".
+                self._cancel_lifecycle_locked(key)
+                return
+            if rt.will_exit_at is not None:
+                self._arm(key, "exit", rt.will_exit_at)
+            self._arm_step_locked(key, pod, rt)
+            if (pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION)
+                    and not self._timers.armed(key, "serve")):
+                self._arm(key, "serve", now + self._tick)
+
+    def _arm_step_locked(self, key: str, pod: Pod, rt: _PodRuntime) -> None:
+        """Arm the next step-synthesis deadline: the instant step
+        ``steps_reported + 1`` becomes due at the pod's effective step
+        time.  A deliberately stalled rank stops re-arming at its cap (the
+        watchdog's job starts where synthesis ends)."""
+        interval = self._step_interval(pod)
+        if interval is None or rt.started_at == 0.0:
+            return
+        cap = self._stall_cap(pod)
+        if cap is not None and rt.steps_reported >= cap:
+            return
+        self._arm(key, "step",
+                  rt.started_at + (rt.steps_reported + 1) * interval)
+
+    @staticmethod
+    def _step_interval(pod: Pod) -> Optional[float]:
+        """Effective seconds per synthesized step, or None when the pod
+        does not train (no/zero step-ms, malformed script, no owning job)."""
+        ann = pod.metadata.annotations
+        step_ms_raw = ann.get(STEP_MS_ANNOTATION)
+        if not step_ms_raw:
+            return None
+        if not pod.metadata.labels.get(constants.JOB_NAME_LABEL):
+            return None
+        try:
+            step_ms = float(step_ms_raw)
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+            if rank == int(ann.get(STRAGGLER_RANK_ANNOTATION, "-1")):
+                step_ms *= float(ann.get(STRAGGLER_FACTOR_ANNOTATION, "3.0"))
+        except ValueError:
+            return None
+        if step_ms <= 0.0:
+            return None
+        return step_ms / 1000.0
+
+    @staticmethod
+    def _stall_cap(pod: Pod) -> Optional[int]:
+        """Step number past which this rank stops advancing, or None."""
+        ann = pod.metadata.annotations
+        try:
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+            if rank == int(ann.get(STALL_RANK_ANNOTATION, "-1")):
+                return int(ann.get(STALL_AT_STEP_ANNOTATION, "0"))
+        except ValueError:
+            return None
+        return None
+
+    def _next_wait(self) -> Optional[float]:
+        if self._kernel != "event":
+            return self._tick
+        deadline = self._timers.next_deadline()
+        if deadline is None:
+            return None  # nothing armed: sleep until a watch event kicks
+        return max(0.0, deadline - time.time())
 
     def _reconcile_once(self) -> None:
+        if self._kernel == "event":
+            self._drain_events()
+        else:
+            self._scan_tick()
+
+    def _drain_events(self) -> None:
+        now = time.time()
+        due = self._timers.pop_due(now, limit=_MAX_EVENTS_PER_DRAIN)
+        if not due:
+            return
+        self.events_total += len(due)
+        per_kind: Dict[str, int] = {}
+        for _, kind, _ in due:
+            per_kind[kind] = per_kind.get(kind, 0) + 1
+        for kind, n in per_kind.items():
+            METRICS.inc("trainingjob_sim_events_total", n, kind=kind)
+        if set(per_kind) - {"watchdog"}:
+            # One span per dispatched batch (the event kernel's analogue of
+            # a scan pass); watchdog-only wakeups are heartbeat noise and
+            # must not flood the trace ring.
+            with TRACER.span("sim.event", events=len(due)):
+                self._dispatch_batch(due, now)
+        else:
+            self._dispatch_batch(due, now)
+
+    def _dispatch_batch(self, due: List[Tuple[str, str, float]],
+                        now: float) -> None:
+        for key, kind, deadline in due:
+            try:
+                if kind == "start":
+                    self._fire_start(key, now)
+                elif kind == "exit":
+                    self._fire_exit(key, now)
+                elif kind == "grace":
+                    self._fire_grace(key, now)
+                elif kind == "step":
+                    self._fire_step(key, now)
+                elif kind == "serve":
+                    self._fire_serve(key, deadline, now)
+                elif kind == "sched":
+                    self._fire_sched()
+                elif kind == "watchdog":
+                    TELEMETRY.check_stalls(now)
+                    nxt = deadline + self._tick
+                    self._arm(_CLUSTER_KEY, "watchdog",
+                              nxt if nxt > now else now + self._tick)
+            except Exception:
+                log.exception("sim event %s for %s failed", kind, key)
+
+    def _pod_rt_locked(self, key: str) -> Tuple[Optional[Pod],
+                                                Optional[_PodRuntime]]:
+        return self._pods_cache.get(key), self._state.get(key)
+
+    def _node_ready_locked(self, pod: Pod) -> bool:
+        node = (self._nodes_cache.get(pod.spec.node_name)
+                if pod.spec.node_name else None)
+        return node is not None and node.is_ready()
+
+    def _fire_start(self, key: str, now: float) -> None:
+        with self._lock:
+            pod, rt = self._pod_rt_locked(key)
+            if (pod is None or rt is None
+                    or pod.metadata.deletion_timestamp is not None
+                    or pod.status.phase != PodPhase.PENDING
+                    or not pod.spec.node_name
+                    or rt.frozen_on
+                    or not self._node_ready_locked(pod)):
+                return  # superseded; a later watch/node event re-arms
+            pod = copy.deepcopy(pod)  # never mutate the cache
+        with TRACER.span("sim.start", pod=key, node=pod.spec.node_name):
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.start_time = now
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name,
+                                state=ContainerState(running_started_at=now))
+                for c in pod.spec.containers]
+            run_s = pod.metadata.annotations.get(RUN_SECONDS_ANNOTATION)
+            if not self._try_update_pod(pod):
+                self._arm(key, "start", now + self._tick)  # conflict: retry
+                return
+        with self._lock:
+            rt = self._state.get(key)
+            if rt is None:
+                return  # deleted during the write
+            rt.started_at = now
+            if run_s is not None and rt.will_exit_at is None:
+                rt.will_exit_at = now + float(run_s)
+                rt.exit_code = int(pod.metadata.annotations.get(
+                    EXIT_CODE_ANNOTATION, "0"))
+            cached = self._pods_cache.get(key)
+            if cached is not None:
+                self._arm_for_pod_locked(key, cached, now)
+
+    def _fire_exit(self, key: str, now: float) -> None:
+        with self._lock:
+            pod, rt = self._pod_rt_locked(key)
+            if (pod is None or rt is None
+                    or pod.metadata.deletion_timestamp is not None
+                    or pod.status.phase != PodPhase.RUNNING
+                    or rt.frozen_on
+                    or rt.will_exit_at is None
+                    or not self._node_ready_locked(pod)):
+                return
+            if now < rt.will_exit_at:
+                self._arm(key, "exit", rt.will_exit_at)  # deadline moved
+                return
+            code = rt.exit_code
+            pod = copy.deepcopy(pod)  # never mutate the cache
+        with TRACER.span("sim.exit", pod=key, exit_code=code) as sp:
+            if code != 0:
+                sp.set_status("error")
+            pod.status.phase = (PodPhase.SUCCEEDED if code == 0
+                                else PodPhase.FAILED)
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name,
+                                state=ContainerState(
+                                    terminated_exit_code=code,
+                                    terminated_reason="Completed" if code == 0 else "Error"))
+                for c in pod.spec.containers]
+            if self._try_update_pod(pod):
+                with self._lock:
+                    rt = self._state.get(key)
+                    if rt is not None:
+                        rt.will_exit_at = None
+            else:
+                self._arm(key, "exit", now + self._tick)  # conflict: retry
+
+    def _fire_grace(self, key: str, now: float) -> None:
+        with self._lock:
+            pod, rt = self._pod_rt_locked(key)
+            if pod is None or pod.metadata.deletion_timestamp is None:
+                return
+            if rt is None:
+                rt = self._rt_locked(key, pod.metadata.uid)
+            if rt.terminating_since is None:
+                rt.terminating_since = now
+            remaining = (rt.terminating_since + self._termination_grace) - now
+            if remaining > 0:
+                # The finalizer stamped a fresher clock than our first
+                # observation; honor the full grace from its stamp.
+                self._arm(key, "grace", now + remaining)
+                return
+            namespace, _, name = key.partition("/")
+        self._cs.tracker.finalize_delete(Pod.KIND, namespace, name)
+        self._drop_state(namespace, name)
+        self._timers.cancel_all(key)
+
+    def _fire_step(self, key: str, now: float) -> None:
+        with self._lock:
+            pod, rt = self._pod_rt_locked(key)
+            if (pod is None or rt is None
+                    or pod.metadata.deletion_timestamp is not None
+                    or pod.status.phase != PodPhase.RUNNING
+                    or rt.frozen_on
+                    or not self._node_ready_locked(pod)):
+                return
+        self._synthesize_steps(pod, rt, now)
+        with self._lock:
+            if self._state.get(key) is rt:
+                self._arm_step_locked(key, pod, rt)
+
+    def _fire_serve(self, key: str, deadline: float, now: float) -> None:
+        with self._lock:
+            pod, rt = self._pod_rt_locked(key)
+            if (pod is None
+                    or pod.metadata.deletion_timestamp is not None
+                    or pod.status.phase != PodPhase.RUNNING
+                    or (rt is not None and rt.frozen_on)
+                    or not self._node_ready_locked(pod)):
+                return
+        self._synthesize_serve(pod, now)
+        if pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION):
+            nxt = deadline + self._tick
+            self._arm(key, "serve", nxt if nxt > now else now + self._tick)
+
+    def _fire_sched(self) -> None:
+        """One placement round over the pending set -- the event analogue
+        of the scan kernel's per-tick scheduling branch, re-armed by watch
+        events whenever a pod joins the pending set or node capacity
+        changes (never by our own Unschedulable condition echoes)."""
+        with self._lock:
+            if not self._pending:
+                return
+            nodes = dict(self._nodes_cache)
+            active = list(self._active_cache.values())
+        self._schedule_pending(nodes, active)
+
+    # -- the scan kernel (TRAININGJOB_SIM_KERNEL=scan) ------------------------
+
+    def _scan_tick(self) -> None:
         now = time.time()
         with self._lock:
             # Watch-fed snapshots: dict/list copies of privately-owned cached
@@ -289,40 +709,7 @@ class SimRuntime(PodStateRuntime):
             nodes = dict(self._nodes_cache)
             active = list(self._active_cache.values())
 
-        # Gang-aware scheduling: group pending pods by (namespace, gang); a
-        # gang is placed only if every member fits simultaneously.  The
-        # usage/gang maps are maintained incrementally from watch events
-        # (``_account_pod_locked``) -- settled pods still occupy capacity
-        # but cost nothing per tick; a pending burst copies O(nodes +
-        # gangs), never O(pods).
-        pending = [p for p in active
-                   if p.status.phase == PodPhase.PENDING and not p.spec.node_name
-                   and p.metadata.deletion_timestamp is None]
-        if pending:
-            with self._lock:
-                # node -> usage (copies: _schedule_gang mutates them as it
-                # places, and a failed write must not poison the live maps)
-                pod_count = {n: u[0] for n, u in self._usage.items()}
-                tpu_used = {n: u[1] for n, u in self._usage.items()}
-                # Gang membership counts ALL live pods carrying the label,
-                # not just pending ones: a gap-filled single member of an
-                # otherwise-running gang must still be placeable (its
-                # siblings already hold nodes).
-                gang_totals = dict(self._gang_totals)
-            gangs: Dict[tuple, list] = {}
-            for pod in pending:
-                gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
-                gangs.setdefault((pod.namespace, gang), []).append(pod)
-            for key, gang_pods in gangs.items():
-                # Never place a partially OBSERVED gang: the controller creates
-                # a slice's pods over several API calls, and placing the
-                # visible subset would steal capacity the full gang needs.
-                declared = gang_pods[0].metadata.labels.get(
-                    constants.GANG_SIZE_LABEL)
-                if (declared and declared.isdigit()
-                        and gang_totals.get(key, len(gang_pods)) < int(declared)):
-                    continue
-                self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
+        self._schedule_pending(nodes, active)
 
         # Walk ACTIVE pods through their lifecycle.  Settled pods are absent
         # by construction (and their _state entries age out via the two-walk
@@ -403,6 +790,46 @@ class SimRuntime(PodStateRuntime):
         # The kubelet tick doubles as the step-progress watchdog tick, same
         # as the localproc runtime: a stalled pod above is still Running.
         TELEMETRY.check_stalls(now)
+
+    # -- shared kernel pieces -------------------------------------------------
+
+    def _schedule_pending(self, nodes: Dict[str, Node],
+                          active: List[Pod]) -> None:
+        """Gang-aware scheduling: group pending pods by (namespace, gang); a
+        gang is placed only if every member fits simultaneously.  The
+        usage/gang maps are maintained incrementally from watch events
+        (``_account_pod_locked``) -- settled pods still occupy capacity
+        but cost nothing per pass; a pending burst copies O(nodes +
+        gangs), never O(pods)."""
+        pending = [p for p in active
+                   if p.status.phase == PodPhase.PENDING and not p.spec.node_name
+                   and p.metadata.deletion_timestamp is None]
+        if not pending:
+            return
+        with self._lock:
+            # node -> usage (copies: _schedule_gang mutates them as it
+            # places, and a failed write must not poison the live maps)
+            pod_count = {n: u[0] for n, u in self._usage.items()}
+            tpu_used = {n: u[1] for n, u in self._usage.items()}
+            # Gang membership counts ALL live pods carrying the label,
+            # not just pending ones: a gap-filled single member of an
+            # otherwise-running gang must still be placeable (its
+            # siblings already hold nodes).
+            gang_totals = dict(self._gang_totals)
+        gangs: Dict[tuple, list] = {}
+        for pod in pending:
+            gang = pod.metadata.labels.get(constants.GANG_LABEL, f"_solo_{pod.name}")
+            gangs.setdefault((pod.namespace, gang), []).append(pod)
+        for key, gang_pods in gangs.items():
+            # Never place a partially OBSERVED gang: the controller creates
+            # a slice's pods over several API calls, and placing the
+            # visible subset would steal capacity the full gang needs.
+            declared = gang_pods[0].metadata.labels.get(
+                constants.GANG_SIZE_LABEL)
+            if (declared and declared.isdigit()
+                    and gang_totals.get(key, len(gang_pods)) < int(declared)):
+                continue
+            self._schedule_gang(gang_pods, nodes, pod_count, tpu_used)
 
     def _synthesize_steps(self, pod: Pod, rt: _PodRuntime, now: float) -> None:
         """Advance the pod's simulated step counter and push the records a
