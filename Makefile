@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke
+.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -98,7 +98,15 @@ elastic-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.serve_smoke
 
+# The re-rendezvous fallback ladder end to end (docs/ELASTIC.md): real
+# llama_elastic survivors shrunk mid-run, one injected fault per rung
+# (TRAININGJOB_RESIZE_FAULT), asserting live -> checkpoint -> restart_all
+# degrade IN ORDER and a degraded resize still attributes every ms of
+# downtime (rung stamped on the incident bundle, zero unknown).
+resize-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.resize_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke
+ci: lint lint-sarif test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke

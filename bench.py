@@ -1123,6 +1123,7 @@ def bench_elastic_resize():
         fast, lambda l: l.startswith("recovery_timing"), after=t_sig) - t_sig
     fast_text = "\n".join(line for _, line in fast)
     m = re.search(r"resize_timing generation=\d+ width=\d+ "
+                  r"rendezvous_s=([0-9.]+) "
                   r"requod_s=([0-9.]+) reshard_s=([0-9.]+) "
                   r"moved_mb=([0-9.]+) fallback=(\d) "
                   r"compile_s=([0-9.]+)", fast_text)
@@ -1155,16 +1156,172 @@ def bench_elastic_resize():
         "downtime_restart_all_s": round(downtime_restart, 2),
         "speedup": round(speedup, 2) if speedup else None,
         "win_2x": bool(speedup and speedup >= 2.0),
-        "requod_s": float(m.group(1)) if m else None,
-        "reshard_s": float(m.group(2)) if m else None,
-        "moved_mb": float(m.group(3)) if m else None,
-        "fell_back": bool(int(m.group(4))) if m else None,
-        "resize_compile_s": float(m.group(5)) if m else None,
+        "rendezvous_s": float(m.group(1)) if m else None,
+        "requod_s": float(m.group(2)) if m else None,
+        "reshard_s": float(m.group(3)) if m else None,
+        "moved_mb": float(m.group(4)) if m else None,
+        "fell_back": bool(int(m.group(5))) if m else None,
+        "resize_compile_s": float(m.group(6)) if m else None,
         "reshard_span": resharded,
         "no_checkpoint_io": resharded and not restores_after,
+        "multiprocess": bench_elastic_live_rebootstrap(),
         "note": "in-place scope=Resize shrink 4->2 vs checkpoint+restart "
                 "at 124M (CPU); restart arm excludes operator "
                 "detect+reschedule, so the speedup is a lower bound",
+    }
+
+
+def bench_elastic_live_rebootstrap():
+    """Two-PROCESS live-vs-checkpoint A/B for the re-rendezvous ladder
+    (ISSUE 13 tentpole, docs/ELASTIC.md "Live re-rendezvous").
+
+    Two real llama_elastic processes form a distributed client; the parent
+    shrinks the world to one process through the generation channel.
+
+    - LIVE arm: defaults.  The survivor (rank 0) tears down only the
+      distributed client, re-inits against the bumped-generation
+      coordinator, and rides the in-place resize -- downtime is its
+      resize signal to its next ``recovery_timing`` line, all in ONE
+      process lifetime.
+    - CHECKPOINT arm: ``TRAININGJOB_RESIZE_LIVE=0`` forces the checkpoint
+      rung -- both processes commit and exit 143 and the survivor is
+      relaunched single-process against the same checkpoint dir.
+
+    ``jax.distributed.shutdown`` + re-``initialize`` in one process needs
+    jax >= 0.5; on older builds the arms cannot run and the bench reports
+    itself skipped rather than measuring a restart in disguise.
+    """
+    import jax
+
+    if jax.__version_info__ < (0, 5, 0):
+        return {"skipped": True,
+                "note": f"jax {jax.__version__} < 0.5: distributed client "
+                        "teardown/re-init (shutdown + second initialize) "
+                        "is not supported in-process; live rung is "
+                        "exercised single-process by make resize-smoke"}
+
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    root = tempfile.mkdtemp(prefix="bench-live-rdv-")
+    base_xla = os.environ.get("XLA_FLAGS", "")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def proc_env(tag, rank, num, live, birth_generation=0):
+        d = os.path.join(root, tag)
+        xla = base_xla + " --xla_force_host_platform_device_count=4"
+        return dict(os.environ, LLAMA_STEPS="6", LLAMA_CKPT_EVERY="2",
+                    LLAMA_BATCH="8", LLAMA_SEQ="32",
+                    XLA_FLAGS=xla.strip(),
+                    TRAININGJOB_JAX_PLATFORM="cpu",
+                    TRAININGJOB_CHECKPOINT_DIR=os.path.join(d, "ckpt"),
+                    TRAININGJOB_ELASTIC_REPLICAS=str(num),
+                    TRAININGJOB_NUM_PROCESSES=str(num),
+                    TRAININGJOB_PROCESS_ID=str(rank),
+                    TRAININGJOB_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                    TRAININGJOB_RESIZE_DIR=os.path.join(d, "rdv"),
+                    TRAININGJOB_RESIZE_POLL_S="0.05",
+                    TRAININGJOB_RESIZE_LIVE="1" if live else "0",
+                    TRAININGJOB_RENDEZVOUS_GENERATION=str(birth_generation))
+
+    def run_pair(tag, live, ok_rc=(0,)):
+        """Launch ranks 0+1, publish the shrink-to-one doc after rank 0's
+        first step, return rank 0's timestamped lines."""
+        envs = [proc_env(tag, r, 2, live) for r in (0, 1)]
+        rdv = envs[0]["TRAININGJOB_RESIZE_DIR"]
+        procs = [subprocess.Popen(
+            [sys.executable, "-m",
+             "trainingjob_operator_tpu.workloads.llama_elastic"],
+            env=e, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for e in envs]
+        killers = [threading.Timer(900, p.kill) for p in procs]
+        lines = []
+        try:
+            for k in killers:
+                k.start()
+            drain = threading.Thread(
+                target=lambda: [None for _ in procs[1].stdout], daemon=True)
+            drain.start()
+            wrote = False
+            for raw in procs[0].stdout:
+                lines.append((time.perf_counter(), raw.rstrip("\n")))
+                if not wrote and re.match(r"step \d+/", lines[-1][1]):
+                    os.makedirs(rdv, exist_ok=True)
+                    tmp = os.path.join(rdv, ".generation.tmp")
+                    with open(tmp, "w") as fh:
+                        json.dump({"generation": 1, "world": [0],
+                                   "num_processes": 1}, fh)
+                    os.replace(tmp, os.path.join(rdv, "generation.json"))
+                    wrote = True
+            rcs = [p.wait() for p in procs]
+        finally:
+            for k in killers:
+                k.cancel()
+            for p in procs:
+                p.kill()
+                p.wait()
+        if rcs[0] not in ok_rc:
+            tail = "\n".join(line for _, line in lines[-8:])
+            raise RuntimeError(f"rank0 rc={rcs[0]}: {tail[-400:]}")
+        return lines
+
+    sig = re.compile(r"resize: generation \d+ .*observed at step")
+
+    def t_of(lines, pred, after=0.0):
+        for t, line in lines:
+            if t > after and pred(line):
+                return t
+        raise RuntimeError("expected line not found: "
+                           + "\n".join(l for _, l in lines[-8:]))
+
+    # LIVE: rank 0 survives in place.
+    live = run_pair("live", live=True)
+    t_sig = t_of(live, sig.match)
+    down_live = t_of(live, lambda l: l.startswith("recovery_timing"),
+                     after=t_sig) - t_sig
+    took_live = any(l.startswith("resize_rung") and "rung=live" in l
+                    for _, l in live)
+
+    # CHECKPOINT: forced degrade, both exit 143, relaunch rank 0 alone.
+    ck = run_pair("ckpt", live=False, ok_rc=(143,))
+    t_sig_c = t_of(ck, sig.match)
+    relaunch = proc_env("ckpt", 0, 1, live=False, birth_generation=1)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "trainingjob_operator_tpu.workloads.llama_elastic"],
+        env=relaunch, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    lines2 = []
+    try:
+        killer = threading.Timer(900, proc.kill)
+        killer.start()
+        try:
+            for raw in proc.stdout:
+                lines2.append((time.perf_counter(), raw.rstrip("\n")))
+            proc.wait()
+        finally:
+            killer.cancel()
+    finally:
+        proc.kill()
+        proc.wait()
+    down_ck = t_of(lines2,
+                   lambda l: l.startswith("recovery_timing")) - t_sig_c
+
+    speedup = down_ck / down_live if down_live else None
+    return {
+        "downtime_live_s": round(down_live, 2),
+        "downtime_checkpoint_s": round(down_ck, 2),
+        "speedup": round(speedup, 2) if speedup else None,
+        "win_2x": bool(speedup and speedup >= 2.0),
+        "live_rung_taken": took_live,
+        "note": "2-process shrink to 1: live coordinator rebootstrap vs "
+                "TRAININGJOB_RESIZE_LIVE=0 checkpoint rung (relaunch "
+                "excludes operator detect+reschedule)",
     }
 
 

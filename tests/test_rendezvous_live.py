@@ -1,0 +1,398 @@
+"""Live re-rendezvous unit layer (docs/ELASTIC.md "Live re-rendezvous").
+
+The coordinator-rebootstrap machinery in workloads/rendezvous.py -- fault
+knob parsing, the barrier probe, the GenerationWatcher's re-entry
+lifecycle, rebootstrap_jax_distributed's phase errors -- plus the
+fallback ladder's observability contract: the rendezvous wire record
+through telemetry ingest and the incident recorder's rung stamp / phase
+split.  The end-to-end ladder (real llama_elastic survivors, one injected
+fault per rung) is driven by ``make resize-smoke``.
+"""
+
+import socket
+import threading
+
+import pytest
+
+jax = pytest.importorskip("jax")
+from conftest import apply_jax_platform_override
+
+apply_jax_platform_override()
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.goodput import GoodputTracker
+from trainingjob_operator_tpu.obs.incident import IncidentRecorder
+from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+from trainingjob_operator_tpu.utils.metrics import MetricsRegistry
+from trainingjob_operator_tpu.workloads import rendezvous
+from trainingjob_operator_tpu.workloads.rendezvous import (
+    GenerationWatcher,
+    RebootstrapError,
+    Rendezvous,
+)
+
+JOB = "default/rdvjob"
+
+
+def free_port():
+    """A port nothing listens on (bound briefly, then released)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- fault knob ---------------------------------------------------------------
+
+class TestResizeFaults:
+    def test_empty_and_absent(self):
+        assert rendezvous.resize_faults({}) == {}
+        assert rendezvous.resize_faults(
+            {constants.RESIZE_FAULT_ENV: ""}) == {}
+
+    def test_unpinned_and_pinned(self):
+        spec = rendezvous.resize_faults(
+            {constants.RESIZE_FAULT_ENV: "barrier@3, persist"})
+        assert spec == {"barrier": 3, "persist": None}
+
+    def test_unknown_phase_ignored(self):
+        spec = rendezvous.resize_faults(
+            {constants.RESIZE_FAULT_ENV: "warpcore,barrier"})
+        assert spec == {"barrier": None}
+
+    def test_garbled_pin_ignored(self):
+        spec = rendezvous.resize_faults(
+            {constants.RESIZE_FAULT_ENV: "barrier@soon,reinit@2"})
+        assert spec == {"reinit": 2}
+
+    def test_check_fault_unpinned_fires_every_generation(self):
+        for gen in (1, 7):
+            with pytest.raises(RebootstrapError) as ei:
+                rendezvous.check_fault("barrier", gen,
+                                       faults={"barrier": None})
+            assert ei.value.phase == "barrier"
+            assert ei.value.injected is True
+
+    def test_check_fault_pinned_fires_only_at_its_generation(self):
+        rendezvous.check_fault("barrier", 1, faults={"barrier": 2})
+        with pytest.raises(RebootstrapError):
+            rendezvous.check_fault("barrier", 2, faults={"barrier": 2})
+
+    def test_check_fault_unarmed_phase_is_silent(self):
+        rendezvous.check_fault("reinit", 1, faults={"barrier": None})
+        rendezvous.check_fault("reinit", 1, faults={})
+
+
+# -- coordinator barrier ------------------------------------------------------
+
+class TestCoordinatorBarrier:
+    def test_timeout_default_floor_and_garbage(self):
+        assert rendezvous.barrier_timeout_s({}) == 30.0
+        assert rendezvous.barrier_timeout_s(
+            {constants.RESIZE_BARRIER_ENV: "5.5"}) == 5.5
+        assert rendezvous.barrier_timeout_s(
+            {constants.RESIZE_BARRIER_ENV: "0.0001"}) == 0.1
+        assert rendezvous.barrier_timeout_s(
+            {constants.RESIZE_BARRIER_ENV: "soon"}) == 30.0
+
+    def test_unreachable_coordinator_is_a_barrier_error(self):
+        with pytest.raises(RebootstrapError) as ei:
+            rendezvous._await_coordinator(f"127.0.0.1:{free_port()}",
+                                          timeout=0.2,
+                                          sleep=lambda _d: None)
+        assert ei.value.phase == "barrier"
+
+    def test_unparseable_address_is_a_barrier_error(self):
+        with pytest.raises(RebootstrapError) as ei:
+            rendezvous._await_coordinator("not-an-address", timeout=0.2)
+        assert ei.value.phase == "barrier"
+
+    def test_live_coordinator_passes(self):
+        with socket.socket() as srv:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            addr = "127.0.0.1:%d" % srv.getsockname()[1]
+            rendezvous._await_coordinator(addr, timeout=2.0)
+
+    def test_late_coordinator_caught_by_backoff(self):
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            addr = "127.0.0.1:%d" % srv.getsockname()[1]
+            t = threading.Timer(0.1, srv.listen, args=(1,))
+            t.start()
+            try:
+                rendezvous._await_coordinator(addr, timeout=5.0)
+            finally:
+                t.cancel()
+        finally:
+            srv.close()
+
+
+# -- GenerationWatcher re-entry lifecycle -------------------------------------
+
+class TestWatcherReentry:
+    def _write(self, path, generation, world, mtime):
+        path.write_text('{"generation": %d, "world": %s}'
+                        % (generation, list(world)))
+        import os
+        os.utime(path, (mtime, mtime))
+
+    def test_second_bump_in_same_lifetime_surfaces(self, tmp_path):
+        p = tmp_path / "generation.json"
+        w = GenerationWatcher(path=str(p), birth=0, interval=0.0)
+        self._write(p, 1, [0, 1, 2], mtime=100.0)
+        doc = w.poll(now=1.0)
+        assert doc is not None and doc["generation"] == 1
+
+        w.reenter(1)
+        assert w.pending is None
+        self._write(p, 2, [0, 1], mtime=200.0)
+        doc = w.poll(now=2.0)
+        assert doc is not None and doc["generation"] == 2
+        assert doc["world"] == [0, 1]
+
+    def test_replayed_doc_at_or_below_reentered_epoch_is_stale(self,
+                                                               tmp_path):
+        p = tmp_path / "generation.json"
+        w = GenerationWatcher(path=str(p), birth=0, interval=0.0)
+        self._write(p, 1, [0, 1], mtime=100.0)
+        assert w.poll(now=1.0)["generation"] == 1
+        w.reenter(1)
+        # A slow-NFS replay rewrites the SAME doc with a fresh mtime: the
+        # rebootstrap it triggered already happened, it must not re-fire.
+        self._write(p, 1, [0, 1], mtime=300.0)
+        assert w.poll(now=2.0) is None
+        self._write(p, 0, [0], mtime=400.0)  # garbage epoch
+        assert w.poll(now=3.0) is None
+
+    def test_reenter_never_rewinds_the_epoch(self, tmp_path):
+        p = tmp_path / "generation.json"
+        w = GenerationWatcher(path=str(p), birth=5, interval=0.0)
+        w.reenter(2)
+        assert w.seen == 5
+        self._write(p, 4, [0], mtime=100.0)
+        assert w.poll(now=1.0) is None
+
+
+# -- rebootstrap phases -------------------------------------------------------
+
+class TestRebootstrap:
+    def test_single_process_passthrough(self):
+        rdv = Rendezvous(num_processes=1, process_id=0,
+                         rendezvous_generation=0, elastic_replicas=4)
+        doc = {"generation": 1, "world": [0, 1]}
+        new, timings = rendezvous.rebootstrap_jax_distributed(rdv, doc)
+        assert new.rendezvous_generation == 1
+        assert new.elastic_replicas == 2
+        assert new.num_processes == 1 and new.process_id == 0
+        assert set(timings) == {"shutdown_ms", "barrier_ms", "reinit_ms"}
+
+    def test_survivor_absent_from_world_degrades_at_reinit(self):
+        rdv = Rendezvous(num_processes=2, process_id=1,
+                         coordinator_address="127.0.0.1:1")
+        doc = {"generation": 1, "world": [0]}
+        with pytest.raises(RebootstrapError) as ei:
+            rendezvous.rebootstrap_jax_distributed(rdv, doc,
+                                                   old_world=[0, 1])
+        assert ei.value.phase == "reinit"
+
+    def test_dead_coordinator_degrades_at_barrier(self, monkeypatch):
+        monkeypatch.setenv(constants.RESIZE_BARRIER_ENV, "0.3")
+        rdv = Rendezvous(num_processes=2, process_id=1,
+                         coordinator_address=f"127.0.0.1:{free_port()}")
+        doc = {"generation": 1, "world": [0, 1]}
+        with pytest.raises(RebootstrapError) as ei:
+            rendezvous.rebootstrap_jax_distributed(
+                rdv, doc, old_world=[0, 1], sleep=lambda _d: None)
+        assert ei.value.phase == "barrier"
+        assert ei.value.injected is False
+
+    @pytest.mark.parametrize("phase", ["shutdown", "barrier", "reinit"])
+    def test_injected_fault_fires_even_single_process(self, monkeypatch,
+                                                      phase):
+        monkeypatch.setenv(constants.RESIZE_FAULT_ENV, phase)
+        rdv = Rendezvous(num_processes=1)
+        with pytest.raises(RebootstrapError) as ei:
+            rendezvous.rebootstrap_jax_distributed(
+                rdv, {"generation": 1, "world": [0]})
+        assert ei.value.phase == phase
+        assert ei.value.injected is True
+
+    def test_fault_pinned_to_other_generation_passes(self, monkeypatch):
+        monkeypatch.setenv(constants.RESIZE_FAULT_ENV, "barrier@7")
+        rdv = Rendezvous(num_processes=1)
+        new, _ = rendezvous.rebootstrap_jax_distributed(
+            rdv, {"generation": 1, "world": [0]})
+        assert new.rendezvous_generation == 1
+
+
+# -- the fallback ladder through the real workload ----------------------------
+
+class TestFallbackLadder:
+    """llama_elastic's resize cycle end to end, in process: an injected
+    fault must land on the documented rung (and only degrade one rung per
+    fault).  The subprocess counterpart -- including the live rung's rc 0
+    -- is ``make resize-smoke``."""
+
+    def _run(self, monkeypatch, tmp_path, fault):
+        import json as _json
+        import os as _os
+
+        from trainingjob_operator_tpu.workloads import llama_elastic
+
+        rdv_dir = tmp_path / "rdv"
+        rdv_dir.mkdir()
+        (rdv_dir / "generation.json").write_text(
+            _json.dumps({"generation": 1, "world": [0, 1]}))
+        monkeypatch.setenv("LLAMA_STEPS", "6")
+        monkeypatch.setenv("LLAMA_CKPT_EVERY", "2")
+        monkeypatch.setenv("LLAMA_BATCH", "8")
+        monkeypatch.setenv("LLAMA_SEQ", "32")
+        monkeypatch.setenv(constants.CHECKPOINT_DIR_ENV,
+                           str(tmp_path / "ckpt"))
+        monkeypatch.setenv(constants.ELASTIC_REPLICAS_ENV, "4")
+        monkeypatch.setenv(constants.RESIZE_DIR_ENV, str(rdv_dir))
+        monkeypatch.setenv(constants.RESIZE_POLL_ENV, "0")
+        monkeypatch.setenv(constants.RESIZE_FAULT_ENV, fault)
+        _os.environ.pop(constants.RENDEZVOUS_GENERATION_ENV, None)
+        return llama_elastic.main()
+
+    def test_barrier_fault_forces_checkpoint_rung(self, monkeypatch,
+                                                  tmp_path, capsys):
+        rc = self._run(monkeypatch, tmp_path, fault="barrier")
+        out = capsys.readouterr().out
+        assert rc == 143
+        assert ("resize_rung generation=1 rung=checkpoint phase=barrier "
+                "injected=1") in out
+        assert "rung=restart_all" not in out  # degraded exactly one rung
+
+    def test_persist_fault_degrades_to_restart_all_in_order(self,
+                                                            monkeypatch,
+                                                            tmp_path,
+                                                            capsys):
+        rc = self._run(monkeypatch, tmp_path, fault="barrier,persist")
+        out = capsys.readouterr().out
+        assert rc == 143
+        assert out.index("rung=checkpoint phase=barrier") < out.index(
+            "rung=restart_all phase=persist")
+
+
+# -- incident attribution of the rung -----------------------------------------
+
+def _resize_window(rec, t0=100.0):
+    rec.on_interruption(JOB, "Resize", constants.RESIZE_STARTED_REASON,
+                        now=t0)
+    rec.record_event(JOB, constants.RESIZE_STARTED_REASON, "shrink",
+                     ts=t0 + 0.2)
+    rec.on_running(JOB, now=t0 + 1.0)
+
+
+class TestRungAttribution:
+    def _rec(self):
+        return IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+
+    def test_live_rung_splits_rendezvous_and_reshard(self):
+        rec = self._rec()
+        _resize_window(rec, t0=100.0)
+        rec.record_rendezvous(JOB, total_ms=600.0, rung="live",
+                              phases={"shutdown": 100.0, "barrier": 450.0,
+                                      "reinit": 50.0}, now=100.6)
+        rec.record_step(JOB, step=7, ms=100.0, now=101.8)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["kind"] == "resize"
+        assert bundle["rung"] == "live"
+        # detect runs to the corrective ResizeStarted event (+0.2), the
+        # rendezvous segment from there to the record's timestamp (+0.6).
+        assert bundle["phases"]["detect"] == pytest.approx(200.0)
+        assert bundle["phases"]["rendezvous"] == pytest.approx(400.0)
+        assert bundle["phases"]["reshard"] == pytest.approx(1100.0)
+        assert bundle["phases"]["first_step"] == pytest.approx(100.0)
+        assert bundle["phases"]["teardown"] == 0.0
+        assert bundle["phases"]["unknown"] == 0.0
+        (entry,) = [t for t in bundle["timeline"]
+                    if t["kind"] == "rendezvous"]
+        assert entry["rung"] == "live"
+        assert dict(entry["phase_ms"])["barrier"] == pytest.approx(450.0)
+
+    def test_degraded_rung_falls_through_to_generic_attribution(self):
+        rec = self._rec()
+        _resize_window(rec, t0=200.0)
+        rec.record_rendezvous(JOB, total_ms=900.0, rung="checkpoint",
+                              reason="barrier: injected", now=200.6)
+        rec.record_step(JOB, step=7, ms=100.0, now=201.8)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["rung"] == "checkpoint"
+        # Pods really restarted: no reshard segment, the generic
+        # teardown/reschedule/rendezvous split owns the window.
+        assert bundle["phases"]["reshard"] == 0.0
+        assert bundle["phases"]["unknown"] == 0.0
+        assert sum(bundle["phases"].values()) == pytest.approx(
+            bundle["downtime_ms"])
+
+    def test_latest_record_in_window_wins(self):
+        rec = self._rec()
+        _resize_window(rec, t0=300.0)
+        rec.record_rendezvous(JOB, total_ms=100.0, rung="live", now=300.4)
+        rec.record_rendezvous(JOB, total_ms=900.0, rung="checkpoint",
+                              reason="reshard: non-divisible", now=300.8)
+        rec.record_step(JOB, step=7, ms=100.0, now=301.8)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["rung"] == "checkpoint"
+
+    def test_rung_stamped_before_first_step(self):
+        rec = self._rec()
+        _resize_window(rec, t0=400.0)
+        rec.record_rendezvous(JOB, total_ms=50.0, rung="live", now=400.6)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["rung"] == "live"
+        assert bundle["phases"]["rendezvous"] > 0.0
+
+    def test_reassembly_is_deterministic(self):
+        rec = self._rec()
+        _resize_window(rec, t0=500.0)
+        rec.record_rendezvous(JOB, total_ms=600.0, rung="live",
+                              phases={"barrier": 450.0}, now=500.6)
+        rec.record_step(JOB, step=7, ms=100.0, now=501.8)
+        first = rec.bundle_json(JOB)
+        assert first is not None
+        assert rec.reassemble(JOB) == first
+        assert rec.reassemble(JOB) == first
+
+
+# -- telemetry wire record ----------------------------------------------------
+
+class TestRendezvousIngest:
+    def _agg(self, **kw):
+        kw.setdefault("metrics", MetricsRegistry())
+        kw.setdefault("goodput", GoodputTracker(metrics=kw["metrics"]))
+        return TelemetryAggregator(**kw)
+
+    def test_rendezvous_record_routes_to_incidents(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        agg = self._agg(incidents=rec)
+        _resize_window(rec, t0=100.0)
+        assert agg.ingest({"v": 1, "job": JOB, "rtype": "trainer",
+                           "rank": 0, "rendezvous_ms": 600.0,
+                           "rendezvous_rung": "live",
+                           "rendezvous_phase_ms": {"barrier": 450.0}},
+                          now=100.6)
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["rung"] == "live"
+
+    def test_malformed_rendezvous_records_counted(self):
+        reg = MetricsRegistry()
+        agg = self._agg(metrics=reg)
+        bad = [
+            {"job": JOB, "rendezvous_ms": -1.0,
+             "rendezvous_rung": "live"},            # negative duration
+            {"job": JOB, "rendezvous_ms": 5.0,
+             "rendezvous_rung": "sideways"},        # unknown rung
+            {"job": "noslash", "rendezvous_ms": 5.0,
+             "rendezvous_rung": "live"},            # job not ns/name
+            {"job": JOB, "rendezvous_ms": "soon",
+             "rendezvous_rung": "live"},            # non-numeric
+        ]
+        for record in bad:
+            assert agg.ingest(record, now=1.0) is False
+        snap = reg.snapshot()
+        assert snap["trainingjob_telemetry_malformed_total"] == len(bad)

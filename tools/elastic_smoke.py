@@ -11,8 +11,9 @@ one replica (exit 137) and checks the whole contract:
   bumped rendezvous generation (new world + surviving hosts) atomically
   republished into the resize dir for the survivors to pick up;
 - the incident flight recorder attributes the window to the resize
-  phases (``detect``/``reshard``/``first_step``) with zero ``teardown``
-  and zero unattributed residue -- printed as the same phase table
+  phases (``detect``/``rendezvous``/``reshard``/``first_step``) with the
+  live-rebootstrap rung stamped on the bundle, zero ``teardown`` and zero
+  unattributed residue -- printed as the same phase table
   ``/debug/incidents?job=...`` serves.
 
 Usage::
@@ -58,6 +59,7 @@ def main(argv=None) -> int:
     )
     from trainingjob_operator_tpu.obs.incident import INCIDENTS, PHASES
     from trainingjob_operator_tpu.runtime.sim import (
+        RENDEZVOUS_MS_ANNOTATION,
         RUN_SECONDS_ANNOTATION,
         STEP_MS_ANNOTATION,
         TOKENS_PER_STEP_ANNOTATION,
@@ -94,6 +96,10 @@ def main(argv=None) -> int:
                     # the resize amends the bundle with the workload tail.
                     STEP_MS_ANNOTATION: "20",
                     TOKENS_PER_STEP_ANNOTATION: "8192",
+                    # ... and a live-rebootstrap record once the bumped
+                    # generation lands, so the bundle gets a rendezvous
+                    # slice and a rung stamp.
+                    RENDEZVOUS_MS_ANNOTATION: "15",
                 }),
             spec=PodSpec(containers=[
                 Container(name="aitj-main",
@@ -158,12 +164,13 @@ def main(argv=None) -> int:
             bundles = INCIDENTS.bundles(key) or []
             for b in reversed(bundles):
                 if (b["running_at"] is not None
-                        and b["ended"] > b["running_at"]):
+                        and b["ended"] > b["running_at"]
+                        and b.get("rung") is not None):
                     return b
             return None
 
         if not wait_for(lambda: amended_bundle() is not None, args.timeout):
-            print(f"no amended incident bundle; "
+            print(f"no amended incident bundle with a rendezvous rung; "
                   f"have: {INCIDENTS.bundles(key)}", file=sys.stderr)
             return 1
         bundle = amended_bundle()
@@ -179,6 +186,15 @@ def main(argv=None) -> int:
 
         if bundle["kind"] != "resize":
             print(f"bundle kind {bundle['kind']!r} != 'resize'",
+                  file=sys.stderr)
+            return 1
+        if bundle["rung"] != "live":
+            print(f"bundle rung {bundle['rung']!r} != 'live'",
+                  file=sys.stderr)
+            return 1
+        if bundle["phases"]["rendezvous"] <= 0.0:
+            print("rendezvous phase not attributed: "
+                  f"{bundle['phases']['rendezvous']:.1f} ms",
                   file=sys.stderr)
             return 1
         if bundle["phases"]["teardown"] != 0.0:

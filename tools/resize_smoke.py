@@ -1,0 +1,264 @@
+"""Drive one injected fault per fallback-ladder rung through a REAL
+llama_elastic survivor and assert the ladder degraded in order.
+
+The ``make resize-smoke`` driver for the live re-rendezvous path
+(docs/ELASTIC.md).  Three subprocess runs of the real workload, each
+shrunk mid-run by the parent playing the controller (atomic
+``generation.json`` publish, same bytes as ``publish_generation``):
+
+1. no fault          -> rung ``live``: the SAME process resizes in place
+                        and finishes rc 0;
+2. ``barrier`` fault -> rung ``checkpoint``: degrades exactly one rung,
+                        commits a checkpoint, exits 143;
+3. ``barrier,persist`` faults -> rung ``restart_all``: the checkpoint
+                        rung itself fails, the survivor still exits 143
+                        (never wedges) -- and the ``resize_rung`` lines
+                        prove checkpoint was attempted BEFORE restart_all
+                        (ladder order).
+
+A fourth, in-process scenario replays a degraded resize through the sim
+runtime + real controller and asserts the incident bundle stamps the
+``checkpoint`` rung with zero unattributed downtime -- the degraded
+counterpart of ``tools/elastic_smoke.py``'s live-rung attribution check.
+
+Usage::
+
+    python -m tools.resize_smoke [--timeout 240]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+
+def run_child(env, timeout, rdv_dir):
+    """Run llama_elastic, publishing the shrink generation after the first
+    completed-step line (the parent is the controller here)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "trainingjob_operator_tpu.workloads.llama_elastic"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        killer = threading.Timer(timeout, proc.kill)
+        killer.start()
+        lines = []
+        wrote = False
+        try:
+            for raw in proc.stdout:
+                lines.append(raw.rstrip("\n"))
+                if not wrote and re.match(r"step \d+/", lines[-1]):
+                    os.makedirs(rdv_dir, exist_ok=True)
+                    tmp = os.path.join(rdv_dir, ".generation.tmp")
+                    with open(tmp, "w", encoding="utf-8") as fh:
+                        json.dump({"generation": 1, "world": [0, 1]}, fh)
+                    os.replace(tmp, os.path.join(rdv_dir, "generation.json"))
+                    wrote = True
+            rc = proc.wait()
+        finally:
+            killer.cancel()
+    finally:
+        proc.kill()
+        proc.wait()
+    return rc, lines
+
+
+def rung_lines(lines):
+    out = []
+    for line in lines:
+        m = re.match(r"resize_rung generation=(\d+) rung=(\w+) "
+                     r"phase=([\w-]+)(?: injected=(\d))?", line)
+        if m:
+            out.append((int(m.group(1)), m.group(2), m.group(3),
+                        m.group(4)))
+    return out
+
+
+def ladder_case(name, root, fault, want_rc, want_rungs, timeout):
+    d = os.path.join(root, name)
+    rdv_dir = os.path.join(d, "rdv")
+    xla = (os.environ.get("XLA_FLAGS", "")
+           + " --xla_force_host_platform_device_count=8")
+    env = dict(os.environ, LLAMA_STEPS="6", LLAMA_CKPT_EVERY="2",
+               LLAMA_BATCH="8", LLAMA_SEQ="32",
+               XLA_FLAGS=xla.strip(), JAX_PLATFORMS="cpu",
+               TRAININGJOB_JAX_PLATFORM="cpu",
+               TRAININGJOB_CHECKPOINT_DIR=os.path.join(d, "ckpt"),
+               TRAININGJOB_ELASTIC_REPLICAS="4",
+               TRAININGJOB_RESIZE_DIR=rdv_dir,
+               TRAININGJOB_RESIZE_POLL_S="0.05",
+               TRAININGJOB_RESIZE_FAULT=fault)
+    rc, lines = run_child(env, timeout, rdv_dir)
+    rungs = [(r, p) for _, r, p, _ in rung_lines(lines)]
+    print(f"[{name}] fault={fault!r} rc={rc} rungs={rungs}")
+    if rc != want_rc:
+        tail = "\n".join(lines[-8:])
+        print(f"[{name}] expected rc {want_rc}, got {rc}:\n{tail}",
+              file=sys.stderr)
+        return False
+    if [r for r, _ in rungs] != want_rungs:
+        print(f"[{name}] expected ladder {want_rungs}, got {rungs}",
+              file=sys.stderr)
+        return False
+    if fault and any(inj is not None and inj != "1"
+                     for _gen, _rung, _phase, inj in rung_lines(lines)):
+        print(f"[{name}] injected fault not marked injected=1",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def degraded_attribution(timeout):
+    """Sim + real controller: a resize whose survivor reports the
+    ``checkpoint`` rung must stamp it on the incident bundle and still
+    attribute every millisecond (zero ``unknown``)."""
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.api.types import (
+        ReplicaSpec,
+        RestartPolicy,
+        RestartScope,
+        TPUTrainingJob,
+        TrainingJobPhase,
+    )
+    from trainingjob_operator_tpu.client.clientset import Clientset
+    from trainingjob_operator_tpu.cmd.options import OperatorOptions
+    from trainingjob_operator_tpu.controller.controller import (
+        TrainingJobController,
+    )
+    from trainingjob_operator_tpu.core.objects import (
+        Container,
+        ContainerPort,
+        EnvVar,
+        ObjectMeta,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from trainingjob_operator_tpu.obs.incident import INCIDENTS
+    from trainingjob_operator_tpu.runtime.sim import (
+        RENDEZVOUS_MS_ANNOTATION,
+        RENDEZVOUS_RUNG_ANNOTATION,
+        RUN_SECONDS_ANNOTATION,
+        STEP_MS_ANNOTATION,
+        SimRuntime,
+    )
+
+    def wait_for(pred, budget):
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    cs = Clientset()
+    tc = TrainingJobController(cs, options=OperatorOptions(resync_period=0.05))
+    sim = SimRuntime(cs)
+    sim.add_node("sim-0")
+    sim.start()
+    tc.run(workers=2)
+    name = "resize-smoke"
+    key = f"default/{name}"
+    rdv_dir = tempfile.mkdtemp(prefix="resize-smoke-rdv-")
+    try:
+        INCIDENTS.forget(key)
+        job = TPUTrainingJob(metadata=ObjectMeta(name=name,
+                                                 namespace="default"))
+        template = PodTemplateSpec(
+            metadata=ObjectMeta(
+                annotations={
+                    RUN_SECONDS_ANNOTATION: str(timeout * 2),
+                    STEP_MS_ANNOTATION: "20",
+                    # The survivors report a DEGRADED rebootstrap: the
+                    # bundle must fall through to the generic restart
+                    # attribution and still account for every ms.
+                    RENDEZVOUS_MS_ANNOTATION: "10",
+                    RENDEZVOUS_RUNG_ANNOTATION: "checkpoint",
+                }),
+            spec=PodSpec(containers=[
+                Container(name="aitj-main",
+                          env=[EnvVar(name=constants.RESIZE_DIR_ENV,
+                                      value=rdv_dir)],
+                          ports=[ContainerPort(name="aitj-7777",
+                                               container_port=7777)])]))
+        job.spec.replica_specs["trainer"] = ReplicaSpec(
+            replicas=3, min_replicas=1, template=template,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            restart_scope=RestartScope.RESIZE)
+        job.spec.restarting_exit_code = "137,143"
+        cs.trainingjobs.create(job)
+
+        def phase():
+            return cs.trainingjobs.get("default", name).status.phase
+
+        if not wait_for(lambda: phase() == TrainingJobPhase.RUNNING,
+                        timeout):
+            print(f"[sim] job never reached Running (phase {phase()})",
+                  file=sys.stderr)
+            return False
+        sim.preempt_pod("default", f"{name}-trainer-1", exit_code=137)
+
+        def stamped_bundle():
+            for b in reversed(INCIDENTS.bundles(key) or []):
+                if (b["running_at"] is not None
+                        and b["ended"] > b["running_at"]
+                        and b.get("rung") is not None):
+                    return b
+            return None
+
+        if not wait_for(lambda: stamped_bundle() is not None, timeout):
+            print(f"[sim] no bundle with a rung stamp; have: "
+                  f"{INCIDENTS.bundles(key)}", file=sys.stderr)
+            return False
+        bundle = stamped_bundle()
+        print(f"[sim] rung={bundle['rung']} "
+              f"downtime_ms={bundle['downtime_ms']:.1f} "
+              f"unknown_ms={bundle['phases']['unknown']:.1f}")
+        if bundle["rung"] != "checkpoint":
+            print(f"[sim] rung {bundle['rung']!r} != 'checkpoint'",
+                  file=sys.stderr)
+            return False
+        if bundle["phases"]["unknown"] != 0.0:
+            print(f"[sim] unattributed residue "
+                  f"{bundle['phases']['unknown']:.1f} ms", file=sys.stderr)
+            return False
+        return True
+    finally:
+        tc.stop()
+        sim.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("resize-smoke")
+    parser.add_argument("--timeout", type=float, default=240.0,
+                        help="Per-subprocess budget.")
+    args = parser.parse_args(argv)
+
+    root = tempfile.mkdtemp(prefix="resize-smoke-")
+    ok = True
+    # One injected fault per rung, in ladder order.  The live case proves
+    # the default path stays live; the barrier case proves one failure
+    # degrades exactly one rung; the double-fault case proves even a
+    # failing checkpoint rung exits instead of wedging, and that the rungs
+    # were attempted in order.
+    ok &= ladder_case("live", root, fault="", want_rc=0,
+                      want_rungs=["live"], timeout=args.timeout)
+    ok &= ladder_case("checkpoint", root, fault="barrier", want_rc=143,
+                      want_rungs=["checkpoint"], timeout=args.timeout)
+    ok &= ladder_case("restart-all", root, fault="barrier,persist",
+                      want_rc=143,
+                      want_rungs=["checkpoint", "restart_all"],
+                      timeout=args.timeout)
+    ok &= degraded_attribution(min(args.timeout, 30.0))
+    print("resize-smoke: " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

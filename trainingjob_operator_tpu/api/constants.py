@@ -174,6 +174,23 @@ RESIZE_POLL_ENV = "TRAININGJOB_RESIZE_POLL_S"
 # checkpoints and exits 143 (the restart-the-world A/B baseline that
 # bench.py's elastic_resize leg measures against).
 RESIZE_FASTPATH_ENV = "TRAININGJOB_RESIZE_FASTPATH"
+# Live multi-host re-rendezvous (docs/ELASTIC.md "Live re-rendezvous").
+# "0" disables the coordinator-rebootstrap path for multi-process jobs: a
+# resize signal then degrades straight to the checkpoint rung -- the
+# live-vs-checkpoint A/B baseline bench.py's elastic_resize leg measures.
+RESIZE_LIVE_ENV = "TRAININGJOB_RESIZE_LIVE"
+# Seconds a survivor waits for the bumped-generation coordinator to accept
+# connections before the barrier phase times out and the rebootstrap
+# ladder degrades one rung (checkpoint+restart).  Probes back off
+# exponentially inside this budget.
+RESIZE_BARRIER_ENV = "TRAININGJOB_RESIZE_BARRIER_S"
+# Deterministic fault injection for the rebootstrap ladder
+# (workloads/rendezvous.py): a comma-separated list of phase names
+# (shutdown|barrier|reinit|reshard|persist), each optionally pinned to one
+# generation as ``phase@N``.  A listed phase raises an injected fault at
+# that point, forcing the documented fallback rung -- tests and
+# ``make resize-smoke`` drive every rung this way.
+RESIZE_FAULT_ENV = "TRAININGJOB_RESIZE_FAULT"
 # Serving plane (workloads/serve.py, docs/SERVING.md).  Decode-batch slot
 # count (the continuous-batching batch axis), cache length override, prompt
 # prefill chunk size, bounded admission-queue capacity (QueueFull past it),
@@ -227,6 +244,9 @@ USER_ENV_KNOBS = frozenset((
     HBM_SAMPLE_STEPS_ENV,
     RESIZE_POLL_ENV,
     RESIZE_FASTPATH_ENV,
+    RESIZE_LIVE_ENV,
+    RESIZE_BARRIER_ENV,
+    RESIZE_FAULT_ENV,
     SERVE_SLOTS_ENV,
     SERVE_MAX_LEN_ENV,
     SERVE_PREFILL_CHUNK_ENV,
@@ -292,6 +312,10 @@ SCALING_REASON = "TrainingJobScaling"  # TPU extension: elastic resize
 RESIZE_STARTED_REASON = "ResizeStarted"
 RESHARD_COMPLETED_REASON = "ReshardCompleted"
 RESHARD_FELL_BACK_REASON = "ReshardFellBack"
+# ResizePublishFailed: the atomic generation publish exhausted its retry
+# budget -- survivors are polling for a doc that never arrived, so the
+# resize is wedged on the channel, not on the workload.
+RESIZE_PUBLISH_FAILED_REASON = "ResizePublishFailed"
 
 # Telemetry-plane reasons (obs/telemetry.py watchdog): a replica's step
 # counter stopped advancing for N x its median step time / started moving
@@ -332,6 +356,7 @@ EVENT_REASONS = frozenset((
     RESIZE_STARTED_REASON,
     RESHARD_COMPLETED_REASON,
     RESHARD_FELL_BACK_REASON,
+    RESIZE_PUBLISH_FAILED_REASON,
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
     INCIDENT_RECORDED_REASON,

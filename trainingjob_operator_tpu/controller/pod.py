@@ -852,15 +852,39 @@ class PodReconciler:
             "coordinator": f"{instances[0]}:{coord_port}" if instances else "",
         }
         base = resize_dir(job)
-        try:
-            os.makedirs(base, exist_ok=True)
-            tmp = os.path.join(base, ".generation.tmp")
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(doc, fh)
-            os.replace(tmp, os.path.join(base, "generation.json"))
-        except OSError:
-            log.warning("failed to publish generation for %s/%s under %s",
-                        job.namespace, job.name, base, exc_info=True)
+        # Bounded retry: survivors poll this file from the step loop, so a
+        # swallowed write failure leaves them waiting on a generation that
+        # never arrives.  Three attempts with short backoff ride out a
+        # transient filer hiccup without stalling the reconcile worker; on
+        # exhaustion the failure becomes a visible job event
+        # (ResizePublishFailed) instead of a log line nobody watches.
+        last_err = ""
+        for attempt, pause in enumerate((0.05, 0.2, None)):
+            try:
+                os.makedirs(base, exist_ok=True)
+                tmp = os.path.join(base, ".generation.tmp")
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(doc, fh)
+                os.replace(tmp, os.path.join(base, "generation.json"))
+                return doc
+            except OSError as err:
+                last_err = f"{type(err).__name__}: {err}"
+                log.warning(
+                    "failed to publish generation for %s/%s under %s "
+                    "(attempt %d)", job.namespace, job.name, base,
+                    attempt + 1, exc_info=True)
+                if pause is not None:
+                    # analyzer: allow[reconcile-purity]: bounded 0.25 s
+                    # worst case, only while the resize dir is failing --
+                    # re-enqueueing would delay the doc a whole resync
+                    # while survivors spin at the old generation.
+                    time.sleep(pause)
+        self.recorder.event(
+            job, EventRecorder.WARNING, constants.RESIZE_PUBLISH_FAILED_REASON,
+            f"failed to publish rendezvous generation "
+            f"{job.status.rendezvous_generation} under {base} after 3 "
+            f"attempts ({last_err}); survivors cannot re-rendezvous until "
+            "the next reconcile republish")
         return doc
 
     # -- container inspection (reference: pod.go:328-437) --------------------

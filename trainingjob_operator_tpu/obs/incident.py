@@ -119,8 +119,8 @@ class _OpenIncident:
 
 
 class _JobIncidents:
-    __slots__ = ("events", "steps", "resumes", "open", "bundles", "seq",
-                 "completed", "last_end", "gauges")
+    __slots__ = ("events", "steps", "resumes", "rendezvous", "open",
+                 "bundles", "seq", "completed", "last_end", "gauges")
 
     def __init__(self, ring: int, keep: int) -> None:
         #: (ts, reason, message), newest last -- the control-plane ring.
@@ -132,6 +132,14 @@ class _JobIncidents:
                                 Optional[float]]] = deque(maxlen=ring)
         #: (ts, restore_ms, compile_ms, overlapped) resume-span records.
         self.resumes: Deque[Tuple[float, float, float, bool]] = deque(maxlen=8)
+        #: (ts, total_ms, rung, reason, phases) live-rebootstrap records
+        #: (docs/ELASTIC.md): the survivor reporting which fallback rung its
+        #: re-rendezvous took and how long it spent there.  ``phases`` is a
+        #: sorted ((name, ms), ...) tuple so the frozen snapshot stays
+        #: hashable and serializes deterministically.
+        self.rendezvous: Deque[Tuple[float, float, str, str,
+                                     Tuple[Tuple[str, float], ...]]] = \
+            deque(maxlen=8)
         self.open: Optional[_OpenIncident] = None
         #: Retained bundles, oldest first: {"bundle", "json", "inputs"}.
         self.bundles: Deque[Dict[str, Any]] = deque(maxlen=keep)
@@ -146,6 +154,9 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
                steps: Tuple[Tuple[float, int, float, Optional[float],
                                   Optional[float]], ...],
                resumes: Tuple[Tuple[float, float, float, bool], ...],
+               rendezvous: Tuple[Tuple[float, float, str, str,
+                                       Tuple[Tuple[str, float], ...]], ...]
+               = (),
                ) -> List[Tuple[str, float, float]]:
     """Partition [t0, t_end] into phase segments from the ring markers.
 
@@ -171,13 +182,32 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
     corrective = [ts for ts, reason in window
                   if reason in _CORRECTIVE_REASONS]
     b_detect = _clamp(min(corrective), t0, t1c) if corrective else t0
-    if kind == "resize":
+    rdv_records = [r for r in rendezvous if t0 <= r[0] <= t_end]
+    rung = rdv_records[-1][2] if rdv_records else ""
+    if kind == "resize" and rung not in ("checkpoint", "restart_all"):
         # Survivor-keepalive resize: nothing is torn down or rescheduled.
-        # Everything between the controller acting and the first survivor
-        # step is the peer-to-peer reshard (mesh re-form + shard exchange);
-        # the first step's own duration is first_step, as in the generic
-        # path.
+        # A live-rebootstrap record splits the window at its completion
+        # timestamp -- before it is the coordinator re-rendezvous
+        # (shutdown/barrier/reinit, docs/ELASTIC.md), after it the
+        # peer-to-peer reshard; the first step's own duration is
+        # first_step, as in the generic path.  A degraded rung
+        # (checkpoint/restart_all) means pods really restarted, so it
+        # falls through to the generic teardown/reschedule attribution.
         first_steps = [s for s in steps if t1c < s[0] <= t_end]
+        if rdv_records:
+            # The record's timestamp is a direct observation of when the
+            # rebootstrap finished, so it outranks the inferred step-start
+            # boundary: reshard/first_step split whatever remains after it.
+            b_rdv = _clamp(rdv_records[-1][0], b_detect, t_end)
+            if first_steps:
+                b_reshard = _clamp(t_end - first_steps[0][2] / 1e3,
+                                   b_rdv, t_end)
+            else:
+                b_reshard = _clamp(t1c, b_rdv, t_end)
+            return [("detect", t0, b_detect),
+                    ("rendezvous", b_detect, b_rdv),
+                    ("reshard", b_rdv, b_reshard),
+                    ("first_step", b_reshard, t_end)]
         if first_steps:
             b_reshard = _clamp(t_end - first_steps[0][2] / 1e3,
                                b_detect, t_end)
@@ -230,6 +260,9 @@ def _assemble(inc: Dict[str, Any],
               steps: Tuple[Tuple[float, int, float, Optional[float],
                                  Optional[float]], ...],
               resumes: Tuple[Tuple[float, float, float, bool], ...],
+              rendezvous: Tuple[Tuple[float, float, str, str,
+                                      Tuple[Tuple[str, float], ...]], ...]
+              = (),
               ) -> Dict[str, Any]:
     """Ring snapshot -> incident bundle.  Pure and deterministic: the same
     inputs serialize to the same bytes (``reassemble`` asserts this in
@@ -237,7 +270,8 @@ def _assemble(inc: Dict[str, Any],
     t0 = inc["started"]
     t_end = inc["ended"]
     t1c = inc["running_at"] if inc["running_at"] is not None else t_end
-    segments = _attribute(inc["kind"], t0, t1c, t_end, events, steps, resumes)
+    segments = _attribute(inc["kind"], t0, t1c, t_end, events, steps, resumes,
+                          rendezvous)
     phases = {p: 0.0 for p in PHASES}
     for phase, a, b in segments:
         phases[phase] += max(b - a, 0.0) * 1e3
@@ -258,8 +292,17 @@ def _assemble(inc: Dict[str, Any],
                          "restore_ms": round(restore_ms, 3),
                          "compile_ms": round(compile_ms, 3),
                          "overlapped": overlapped})
+    for ts, total_ms, rung, why, rdv_phases in rendezvous:
+        entry = {"ts": round(ts, 6), "kind": "rendezvous",
+                 "total_ms": round(total_ms, 3), "rung": rung}
+        if why:
+            entry["reason"] = why
+        if rdv_phases:
+            entry["phase_ms"] = {p: round(v, 3) for p, v in rdv_phases}
+        timeline.append(entry)
     timeline.sort(key=lambda e: (e["ts"], e["kind"],
                                  json.dumps(e, sort_keys=True)))
+    window_rdv = [r for r in rendezvous if t0 <= r[0] <= t_end]
     return {
         "id": inc["id"],
         "job": inc["job"],
@@ -274,6 +317,7 @@ def _assemble(inc: Dict[str, Any],
         "downtime_ms": round(max(t_end - t0, 0.0) * 1e3, 3),
         "control_downtime_ms": (round(max(t1c - t0, 0.0) * 1e3, 3)
                                 if inc["running_at"] is not None else None),
+        "rung": window_rdv[-1][2] if window_rdv else None,
         "phases": {p: round(v, 3) for p, v in phases.items()},
         "segments": [{"phase": p, "start": round(a, 6), "end": round(b, 6)}
                      for p, a, b in segments if b > a],
@@ -408,6 +452,34 @@ class IncidentRecorder:
             st.resumes.append((now, float(restore_ms), float(compile_ms),
                                bool(overlapped)))
 
+    def record_rendezvous(self, job: str, total_ms: float, rung: str,
+                          reason: str = "",
+                          phases: Optional[Dict[str, float]] = None,
+                          now: Optional[float] = None) -> None:
+        """A survivor finished (or degraded out of) a live re-rendezvous
+        (docs/ELASTIC.md fallback ladder).  ``rung`` is which ladder rung
+        the resize ultimately took -- the latest record inside an incident
+        window wins, so a survivor that reported ``live`` and then degraded
+        re-reports with the rung it fell to.  The record both splits the
+        resize window's rendezvous phase and stamps ``rung`` on the bundle."""
+        now = time.time() if now is None else now
+        emit: List[Tuple[str, str, str]] = []
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or st.completed:
+                return
+            st.rendezvous.append((
+                now, float(total_ms), str(rung), str(reason),
+                tuple(sorted((str(p), float(v))
+                             for p, v in (phases or {}).items()))))
+            inc = st.open
+            if inc is not None and inc.running_at is not None:
+                # Amend the provisional bundle in place so the rung is
+                # visible before (or without) a first-step record.
+                emit = self._finalize_locked(
+                    job, st, ended=max(now, inc.running_at), close=False)
+        self._emit(emit)
+
     # -- lifecycle hooks (controller/status machine) --------------------------
 
     def on_interruption(self, job: str, scope: str, reason: str,
@@ -525,7 +597,8 @@ class IncidentRecorder:
         events = tuple(e for e in st.events if t0 <= e[0] <= ended)
         steps = tuple(s for s in st.steps if t0 <= s[0] <= ended)
         resumes = tuple(r for r in st.resumes if t0 <= r[0] <= ended)
-        inputs = (inc_dict, events, steps, resumes)
+        rendezvous = tuple(r for r in st.rendezvous if t0 <= r[0] <= ended)
+        inputs = (inc_dict, events, steps, resumes, rendezvous)
         bundle = _assemble(*inputs)
         encoded = _canonical(bundle)
         if st.bundles and st.bundles[-1]["bundle"]["id"] == inc.id:

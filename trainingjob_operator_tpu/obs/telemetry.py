@@ -254,6 +254,32 @@ class TelemetryAggregator:
                 self._incidents.record_resume(job, restore_ms, compile_ms,
                                               overlapped, now=now)
             return True
+        if isinstance(record, dict) and "rendezvous_ms" in record:
+            # Live re-rendezvous record (workloads/train.py
+            # push_rendezvous_record): which fallback-ladder rung the resize
+            # took and the per-phase wall spent (docs/ELASTIC.md).  No
+            # step/ms fields -- detect it BEFORE step validation, like
+            # resume spans.  Feeds the incident recorder's rendezvous
+            # attribution and the bundle's ``rung`` stamp.
+            try:
+                job = str(record["job"])
+                total_ms = float(record["rendezvous_ms"])
+                rung = str(record.get("rendezvous_rung", ""))
+                why = str(record.get("rendezvous_reason", ""))
+                raw = record.get("rendezvous_phase_ms") or {}
+                phase_ms = {str(p): float(v) for p, v in raw.items()}
+            except (TypeError, KeyError, ValueError, AttributeError):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            if ("/" not in job or total_ms < 0.0
+                    or rung not in ("live", "checkpoint", "restart_all")):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            if self._incidents is not None:
+                self._incidents.record_rendezvous(job, total_ms, rung,
+                                                  reason=why,
+                                                  phases=phase_ms, now=now)
+            return True
         if isinstance(record, dict) and "serve_queue_depth" in record:
             # Serving-plane snapshot (workloads/serve.py): queue depth,
             # occupancy, latency percentiles -- no step/ms fields, so
@@ -866,6 +892,27 @@ class TelemetryEmitter:
             "resume_compile_ms": round(compile_ms, 3),
             "resume_overlapped": overlapped, "ts": time.time(),
         })
+
+    def emit_rendezvous(self, total_ms: float, rung: str, reason: str = "",
+                        phase_ms: Optional[Dict[str, float]] = None) -> None:
+        """One live re-rendezvous finished or degraded (llama_elastic's
+        fallback ladder): push the rung taken and per-phase wall so the
+        incident bundle attributes the rendezvous slice of the resize
+        window.  Emitted once on success (rung=live) and re-emitted with
+        the rung fallen to on degrade -- the latest record wins."""
+        if not self.enabled or time.monotonic() < self._down_until:
+            return
+        record: Dict[str, Any] = {
+            "v": 1, "job": self.job, "rtype": self.rtype, "rank": self.rank,
+            "rendezvous_ms": round(total_ms, 3), "rendezvous_rung": rung,
+            "ts": time.time(),
+        }
+        if reason:
+            record["rendezvous_reason"] = reason
+        if phase_ms:
+            record["rendezvous_phase_ms"] = {p: round(v, 3)
+                                             for p, v in phase_ms.items()}
+        self._send(record)
 
     def emit_serve(self, queue_depth: int, active_slots: int, slots: int,
                    p50_ms: float, p99_ms: float, tokens_per_sec: float,

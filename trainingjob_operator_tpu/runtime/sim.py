@@ -35,6 +35,7 @@ Both kernels converge seeded runs to byte-identical phase counts; the
 from __future__ import annotations
 
 import copy
+import json
 import logging
 import os
 import time
@@ -93,6 +94,15 @@ CKPT_MS_ANNOTATION = "sim.tpu.trainingjob.dev/ckpt-ms"
 HBM_BYTES_ANNOTATION = "sim.tpu.trainingjob.dev/hbm-bytes"
 RESTORE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/restore-ms"
 COMPILE_MS_ANNOTATION = "sim.tpu.trainingjob.dev/compile-ms"
+#: Live re-rendezvous synthesis (docs/ELASTIC.md): a Running pod with
+#: rendezvous-ms set watches the SAME generation.json the controller
+#: publishes (its container's TRAININGJOB_RESIZE_DIR env) and, once per
+#: new generation, pushes the rendezvous record a real survivor's
+#: fallback ladder would -- rendezvous-rung scripts which rung it reports
+#: (default live).  This drives the incident bundle's rendezvous phase
+#: and rung stamp end-to-end without a model.
+RENDEZVOUS_MS_ANNOTATION = "sim.tpu.trainingjob.dev/rendezvous-ms"
+RENDEZVOUS_RUNG_ANNOTATION = "sim.tpu.trainingjob.dev/rendezvous-rung"
 #: Serving-plane synthesis: a Running pod with serve-queue-depth set
 #: "serves", pushing one serve snapshot per kubelet tick (the records a
 #: real workloads/serve.py DecodeService emits).  Queue depth is the
@@ -141,6 +151,7 @@ class _PodRuntime:
     terminating_since: Optional[float] = None
     frozen_on: str = ""  # node whose failure froze this pod's reports
     steps_reported: int = 0
+    generation_reported: int = 0  # newest rendezvous generation synthesized
 
 
 class SimRuntime(PodStateRuntime):
@@ -667,6 +678,11 @@ class SimRuntime(PodStateRuntime):
                     or rt.frozen_on
                     or not self._node_ready_locked(pod)):
                 return
+        # Rendezvous BEFORE steps: a real survivor reports the rebootstrap
+        # outcome before its first post-resize optimizer step, and the step
+        # record is what closes the incident window -- reversed, the rung
+        # stamp would race the close on the same tick.
+        self._synthesize_rendezvous(pod, rt, now)
         self._synthesize_steps(pod, rt, now)
         with self._lock:
             if self._state.get(key) is rt:
@@ -762,6 +778,7 @@ class SimRuntime(PodStateRuntime):
                                     EXIT_CODE_ANNOTATION, "0"))
 
             elif pod.status.phase == PodPhase.RUNNING and rt.frozen_on == "":
+                self._synthesize_rendezvous(pod, rt, now)
                 self._synthesize_steps(pod, rt, now)
                 self._synthesize_serve(pod, now)
 
@@ -895,6 +912,56 @@ class SimRuntime(PodStateRuntime):
             TELEMETRY.ingest(record, now=now)
             rt.steps_reported += 1
             budget -= 1
+
+    def _synthesize_rendezvous(self, pod: Pod, rt: _PodRuntime,
+                               now: float) -> None:
+        """Watch the controller-published generation.json the way a real
+        survivor's GenerationWatcher does, and push one rendezvous record
+        per NEW generation -- the record a real fallback ladder emits after
+        its rebootstrap (obs/telemetry.py ``rendezvous_ms``).  The resize
+        dir and baseline generation come from the pod's own container env
+        (the controller injects both; a script can also set them on the
+        template), so the sim reads exactly the channel the controller
+        writes."""
+        ann = pod.metadata.annotations
+        rdv_ms_raw = ann.get(RENDEZVOUS_MS_ANNOTATION)
+        if not rdv_ms_raw or rt.started_at == 0.0:
+            return
+        env: Dict[str, str] = {}
+        for container in pod.spec.containers:
+            for e in container.env:
+                if e.value is not None:
+                    env[e.name] = e.value
+        base = env.get(constants.RESIZE_DIR_ENV, "")
+        if not base:
+            return
+        try:
+            rdv_ms = float(rdv_ms_raw)
+            baseline = int(env.get(constants.RENDEZVOUS_GENERATION_ENV, "0")
+                           or "0")
+        except ValueError:
+            return  # malformed script annotations: no telemetry
+        try:
+            with open(os.path.join(base, "generation.json"), "r",
+                      encoding="utf-8") as fh:
+                gen = int(json.load(fh).get("generation", 0))
+        except (OSError, ValueError, TypeError, AttributeError):
+            return  # unpublished or mid-write: try again next tick
+        if gen <= max(rt.generation_reported, baseline):
+            return
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL, "")
+        if not job_name:
+            return
+        rt.generation_reported = gen
+        rung = ann.get(RENDEZVOUS_RUNG_ANNOTATION, "") or "live"
+        TELEMETRY.ingest({
+            "v": 1, "job": f"{pod.namespace}/{job_name}",
+            "rtype": pod.metadata.labels.get(constants.REPLICA_NAME_LABEL,
+                                             "worker"),
+            "rank": int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0"),
+            "rendezvous_ms": rdv_ms, "rendezvous_rung": rung, "ts": now,
+        }, now=now)
 
     def _synthesize_serve(self, pod: Pod, now: float) -> None:
         """Push the serve snapshot a real DecodeService would have emitted

@@ -47,6 +47,7 @@ import time
 
 
 def main() -> int:
+    from trainingjob_operator_tpu.api import constants
     from trainingjob_operator_tpu.workloads import rendezvous, train
 
     t_main = time.time()
@@ -308,117 +309,201 @@ def main() -> int:
             break
         doc = watcher.pending
         watcher.pending = None
-        if jax.process_count() > 1:
-            # jax.distributed cannot re-form with fewer processes inside a
-            # live runtime today: multi-host jobs take the checkpoint
-            # baseline and let the operator restart them at the new width.
-            print("resize: multi-process fast path unavailable; "
-                  "checkpointing and exiting 143 for operator restart",
-                  flush=True)
-            return persist_and_exit(watcher.resume_step)
+        generation = int(doc.get("generation", 0))
         t_r0 = time.time()
-        new_world = [int(r) for r in doc["world"]]
-        lost_ranks = [i for i, r in enumerate(world)
-                      if r not in set(new_world)]
-        n_dev = int(doc.get("devices") or per_replica_dev * len(new_world))
-        if n_dev <= 0 or n_dev % inner != 0:
-            print(f"resize: {n_dev} devices not divisible by tp*sp*pp="
-                  f"{inner}; checkpointing and exiting 143", flush=True)
-            return persist_and_exit(watcher.resume_step)
-        # Host-level shard-exchange plan: the traffic estimate for the log
-        # line, and the fast-path gate -- a lost rank whose shards have no
-        # surviving copy forces the checkpoint fallback.  In the
-        # single-process sim every leaf is fully addressable, so the live
-        # arrays themselves cover everything the plan marks missing.
-        shapes = {jax.tree_util.keystr(kp): tuple(x.shape)
-                  for kp, x in jax.tree_util.tree_leaves_with_path(params)
-                  if hasattr(x, "shape") and x.shape}
-        agg = reshard.plan_pytree_exchange(shapes, len(world),
-                                           len(new_world), lost=lost_ranks)
-        addressable = all(getattr(x, "is_fully_addressable", True)
-                          for x in jax.tree_util.tree_leaves(params)
-                          if isinstance(x, jax.Array))
-        with tracer.span("resize.requod", parent=trace_parent,
-                         generation=doc["generation"],
-                         world=len(new_world), devices=n_dev):
-            data = n_dev // inner
-            dp = max(rdv.num_slices, 1)
-            if data % dp != 0:
-                dp = 1
-            new_mesh = make_mesh(
-                MeshSpec.of(dp=dp, pp=pp, fsdp=data // dp, tp=tp, sp=sp),
-                devices=jax.devices()[:n_dev])
-        t_r1 = time.time()
-        fellback = 0
-        if agg["covered"] or addressable:
-            with tracer.span("resize.reshard", parent=trace_parent,
-                             moved_bytes=agg["moved_bytes"]):
-                params = reshard.redistribute(params, new_mesh)
-                opt_state = reshard.redistribute(opt_state, new_mesh)
-                # analyzer: allow[host-sync-in-hot-loop] reshard-commit
-                # drain: the exchange must land before the resized loop
-                # restarts; runs once per resize, not per step.
-                jax.block_until_ready((params, opt_state))
-            start_step = watcher.resume_step
-        else:
-            # Survivors cannot cover a lost shard: orbax fallback -- restore
-            # the last checkpoint onto the new mesh (still no process
-            # restart, but the downtime win shrinks to restore time).
-            fellback = 1
-            with tracer.span("resize.reshard", parent=trace_parent,
-                             fallback=True):
-                # The loop skipped its exit finalize on the resize path;
-                # this rung re-reads the checkpoint dir, so commit any
-                # in-flight save first (restoring mid-write would hand
-                # back the previous committed step under orbax's feet).
-                state.finalize()
-                params = shard_pytree(
-                    llama.init_params(cfg, jax.random.PRNGKey(0)), rules,
-                    new_mesh)
-                opt_state = tx.init(params)
-                rep = NamedSharding(new_mesh, PartitionSpec())
-                opt_state = jax.tree.map(
-                    lambda x: (jax.device_put(x, rep)
-                               if isinstance(x, jax.Array)
-                               and not isinstance(x.sharding, NamedSharding)
-                               else x),
-                    opt_state)
-                state = train.CheckpointState.restore_or_init(
-                    rdv, {"params": params, "opt_state": opt_state,
-                          "step": watcher.resume_step},
-                    subdir="llama", mesh=new_mesh)
-                params = state.value["params"]
-                opt_state = state.value["opt_state"]
-                start_step = int(state.value["step"])
-        t_r2 = time.time()
-        mesh = new_mesh
-        world = new_world
-        (global_batch, accum, batch_sharding, step_fn, batch_at,
-         eval_fn, eval_every) = width_build(mesh)
-        # Re-AOT at the new width through the same executable-snapshot
-        # machinery as the startup resume: a topology this cache has seen
-        # (an earlier resize cycle, or a prior job on the shared filer)
-        # deserializes the compiled step and skips trace+lower+compile;
-        # a first-seen width pays the compile once and seeds the snapshot
-        # for the next resize.
-        with tracer.span("resize.compile", parent=trace_parent,
-                         devices=n_dev):
-            snap = snap_path(mesh, global_batch, accum)
-            loaded = train.load_executable_snapshot(snap)
-            if loaded is None:
-                tok_abs2 = jax.ShapeDtypeStruct(
-                    (global_batch, seq + 1), jax.numpy.int32,
-                    sharding=batch_sharding)
-                loaded = step_fn.lower(abstract_like(params),
-                                       abstract_like(opt_state),
-                                       tok_abs2).compile()
-                train.store_executable_snapshot(snap, loaded)
-            loop_step = train.aot_or_jit(loaded, step_fn)
-        t_r3 = time.time()
+        was_multi = rdv.num_processes > 1
+        ladder_phase = "shutdown"
+        try:
+            if (jax.process_count() > 1
+                    and os.environ.get(constants.RESIZE_LIVE_ENV, "1")
+                    == "0"):
+                # The bench A/B baseline arm: measure the old
+                # checkpoint+restart path against the live ladder.
+                raise rendezvous.RebootstrapError(
+                    "shutdown", f"{constants.RESIZE_LIVE_ENV}=0 forces the "
+                                "checkpoint rung")
+            # Live rung: tear down only the distributed client, barrier on
+            # the bumped-generation coordinator the controller published,
+            # re-init at the new rank (docs/ELASTIC.md).  Single-process
+            # runtimes pass through (fault injection still fires).  The
+            # process -- and with it the executable-snapshot/compile
+            # caches -- stays up either way.
+            with tracer.span("resize.rendezvous", parent=trace_parent,
+                             generation=generation,
+                             processes=rdv.num_processes):
+                rdv, rdv_times = rendezvous.rebootstrap_jax_distributed(
+                    rdv, doc, old_world=world)
+            t_rdv = time.time()
+            new_world = [int(r) for r in doc["world"]]
+            lost_ranks = [i for i, r in enumerate(world)
+                          if r not in set(new_world)]
+            n_dev = int(doc.get("devices")
+                        or per_replica_dev * len(new_world))
+            if n_dev <= 0 or n_dev % inner != 0:
+                raise rendezvous.RebootstrapError(
+                    "reshard", f"{n_dev} devices not divisible by "
+                               f"tp*sp*pp={inner}")
+            ladder_phase = "reshard"
+            rendezvous.check_fault("reshard", generation)
+            # Report the rung as soon as the rendezvous lands: the record's
+            # timestamp is where the incident bundle splits rendezvous from
+            # reshard, and a later degrade re-reports with the rung fallen
+            # to (latest record wins).
+            train.push_rendezvous_record(
+                sum(rdv_times.values()), rendezvous.RUNG_LIVE,
+                phase_ms=rdv_times)
+            # Host-level shard-exchange plan: the traffic estimate for the
+            # log line, and the fast-path gate -- a lost rank whose shards
+            # have no surviving copy forces the checkpoint fallback.  In
+            # the single-process sim every leaf is fully addressable, so
+            # the live arrays themselves cover everything the plan marks
+            # missing.
+            shapes = {jax.tree_util.keystr(kp): tuple(x.shape)
+                      for kp, x in jax.tree_util.tree_leaves_with_path(
+                          params)
+                      if hasattr(x, "shape") and x.shape}
+            agg = reshard.plan_pytree_exchange(
+                shapes, len(world), len(new_world), lost=lost_ranks)
+            addressable = all(getattr(x, "is_fully_addressable", True)
+                              for x in jax.tree_util.tree_leaves(params)
+                              if isinstance(x, jax.Array))
+            with tracer.span("resize.requod", parent=trace_parent,
+                             generation=generation,
+                             world=len(new_world), devices=n_dev):
+                data = n_dev // inner
+                dp = max(rdv.num_slices, 1)
+                if data % dp != 0:
+                    dp = 1
+                new_mesh = make_mesh(
+                    MeshSpec.of(dp=dp, pp=pp, fsdp=data // dp, tp=tp,
+                                sp=sp),
+                    devices=jax.devices()[:n_dev])
+            t_r1 = time.time()
+            fellback = 0
+            # A true multi-process rebootstrap cleared the old backend, so
+            # the live arrays are gone with it: those survivors always
+            # re-materialize from the last checkpoint (the orbax rung) --
+            # still no process restart, and the compile caches stay warm.
+            if not was_multi and (agg["covered"] or addressable):
+                with tracer.span("resize.reshard", parent=trace_parent,
+                                 moved_bytes=agg["moved_bytes"]):
+                    params = reshard.redistribute(params, new_mesh)
+                    opt_state = reshard.redistribute(opt_state, new_mesh)
+                    # analyzer: allow[host-sync-in-hot-loop] reshard-commit
+                    # drain: the exchange must land before the resized loop
+                    # restarts; runs once per resize, not per step.
+                    jax.block_until_ready((params, opt_state))
+                start_step = watcher.resume_step
+            else:
+                # Survivors cannot cover a lost shard: orbax fallback --
+                # restore the last checkpoint onto the new mesh (still no
+                # process restart, but the downtime win shrinks to restore
+                # time).
+                fellback = 1
+                with tracer.span("resize.reshard", parent=trace_parent,
+                                 fallback=True):
+                    # The loop skipped its exit finalize on the resize
+                    # path; this rung re-reads the checkpoint dir, so
+                    # commit any in-flight save first (restoring mid-write
+                    # would hand back the previous committed step under
+                    # orbax's feet).
+                    state.finalize()
+                    params = shard_pytree(
+                        llama.init_params(cfg, jax.random.PRNGKey(0)),
+                        rules, new_mesh)
+                    opt_state = tx.init(params)
+                    rep = NamedSharding(new_mesh, PartitionSpec())
+                    opt_state = jax.tree.map(
+                        lambda x: (jax.device_put(x, rep)
+                                   if isinstance(x, jax.Array)
+                                   and not isinstance(x.sharding,
+                                                      NamedSharding)
+                                   else x),
+                        opt_state)
+                    state = train.CheckpointState.restore_or_init(
+                        rdv, {"params": params, "opt_state": opt_state,
+                              "step": watcher.resume_step},
+                        subdir="llama", mesh=new_mesh)
+                    params = state.value["params"]
+                    opt_state = state.value["opt_state"]
+                    start_step = int(state.value["step"])
+            t_r2 = time.time()
+            mesh = new_mesh
+            world = new_world
+            (global_batch, accum, batch_sharding, step_fn, batch_at,
+             eval_fn, eval_every) = width_build(mesh)
+            # Re-AOT at the new width through the same executable-snapshot
+            # machinery as the startup resume: a topology this cache has
+            # seen (an earlier resize cycle, or a prior job on the shared
+            # filer) deserializes the compiled step and skips
+            # trace+lower+compile; a first-seen width pays the compile once
+            # and seeds the snapshot for the next resize.
+            with tracer.span("resize.compile", parent=trace_parent,
+                             devices=n_dev):
+                snap = snap_path(mesh, global_batch, accum)
+                loaded = train.load_executable_snapshot(snap)
+                if loaded is None:
+                    tok_abs2 = jax.ShapeDtypeStruct(
+                        (global_batch, seq + 1), jax.numpy.int32,
+                        sharding=batch_sharding)
+                    loaded = step_fn.lower(abstract_like(params),
+                                           abstract_like(opt_state),
+                                           tok_abs2).compile()
+                    train.store_executable_snapshot(snap, loaded)
+                loop_step = train.aot_or_jit(loaded, step_fn)
+            t_r3 = time.time()
+        # analyzer: allow[broad-except]: the ladder guard.  Any failure in
+        # the guarded region -- injected, a jax/distributed error, or a
+        # plain bug mid-reshard -- must degrade one rung, never wedge a
+        # survivor holding devices.
+        except Exception as exc:
+            # The ladder degrades exactly one rung per failure:
+            # live -> checkpoint (park state, operator restarts at the new
+            # width) -> restart-all (exit without a fresh checkpoint; the
+            # operator's restart recovers from the last committed step).
+            phase = getattr(exc, "phase", ladder_phase)
+            injected = bool(getattr(exc, "injected", False))
+            print(f"resize_rung generation={generation} "
+                  f"rung={rendezvous.RUNG_CHECKPOINT} phase={phase} "
+                  f"injected={int(injected)}", flush=True)
+            print(f"resize: live rebootstrap degraded at phase "
+                  f"{phase} ({type(exc).__name__}: {exc}); checkpointing "
+                  "and exiting 143 for operator restart", flush=True)
+            train.push_rendezvous_record(
+                (time.time() - t_r0) * 1e3, rendezvous.RUNG_CHECKPOINT,
+                reason=f"{phase}: {exc}")
+            try:
+                rendezvous.check_fault("persist", generation)
+                return persist_and_exit(watcher.resume_step)
+            # analyzer: allow[broad-except]: the checkpoint rung must
+            # degrade to restart-all on ANY persist failure (orbax I/O,
+            # injected fault, a collective on the torn-down client) --
+            # wedging a survivor here is the exact failure mode the
+            # ladder exists to prevent.
+            except Exception as exc2:
+                print(f"resize_rung generation={generation} "
+                      f"rung={rendezvous.RUNG_RESTART_ALL} phase=persist "
+                      f"injected="
+                      f"{int(getattr(exc2, 'injected', False))}",
+                      flush=True)
+                print(f"resize: checkpoint rung failed "
+                      f"({type(exc2).__name__}: {exc2}); exiting 143 "
+                      "without a fresh checkpoint -- restart-all recovers "
+                      "from the last committed step", flush=True)
+                train.push_rendezvous_record(
+                    (time.time() - t_r0) * 1e3,
+                    rendezvous.RUNG_RESTART_ALL,
+                    reason=f"persist: {exc2}")
+                return train.GracefulShutdown.EXIT_CODE
+        watcher.reenter(generation)
+        print(f"resize_rung generation={generation} "
+              f"rung={rendezvous.RUNG_LIVE} phase=-", flush=True)
         # The resize counterpart of recovery_timing, parsed by
         # bench_elastic_resize and tools/elastic_smoke.py.
-        print(f"resize_timing generation={doc['generation']} "
-              f"width={len(new_world)} requod_s={t_r1 - t_r0:.2f} "
+        print(f"resize_timing generation={generation} "
+              f"width={len(new_world)} "
+              f"rendezvous_s={t_rdv - t_r0:.2f} "
+              f"requod_s={t_r1 - t_rdv:.2f} "
               f"reshard_s={t_r2 - t_r1:.2f} "
               f"moved_mb={agg['moved_bytes'] / 2**20:.1f} "
               f"fallback={fellback} compile_s={t_r3 - t_r2:.2f}",

@@ -9,13 +9,32 @@ JAX bootstrap set (coordinator address, process count/id, TPU topology).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import socket
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
+
+#: Rebootstrap ladder phases, in execution order (docs/ELASTIC.md "Live
+#: re-rendezvous").  ``shutdown``/``barrier``/``reinit`` live here;
+#: ``reshard`` and ``persist`` are guarded at the workload's ladder driver
+#: (llama_elastic) but share the same fault-injection knob.
+REBOOTSTRAP_PHASES = ("shutdown", "barrier", "reinit", "reshard", "persist")
+
+#: Fallback ladder rungs, best first.  ``live``: the survivors re-formed
+#: the distributed world in place.  ``checkpoint``: a phase failed, the
+#: survivors committed a checkpoint at the interrupted step and exited 143
+#: for the operator to restart at the published width.  ``restart_all``:
+#: even the checkpoint failed -- exit anyway and let recovery replay from
+#: the last committed step.
+RUNG_LIVE = "live"
+RUNG_CHECKPOINT = "checkpoint"
+RUNG_RESTART_ALL = "restart_all"
+REBOOTSTRAP_RUNGS = (RUNG_LIVE, RUNG_CHECKPOINT, RUNG_RESTART_ALL)
 
 
 @dataclass
@@ -159,6 +178,14 @@ class GenerationWatcher:
             return doc
         return None
 
+    def reenter(self, generation: int) -> None:
+        """Mark a completed rebootstrap at ``generation``: the watcher keeps
+        polling for LATER bumps in the same process lifetime, but docs at or
+        below this epoch are now stale -- a slow NFS replay of the doc that
+        triggered the rendezvous must not trigger it twice."""
+        self.seen = max(self.seen, generation)
+        self.pending = None
+
 
 def from_env(env: Optional[Dict[str, str]] = None) -> Rendezvous:
     e = dict(os.environ if env is None else env)
@@ -215,6 +242,254 @@ def initialize_jax_distributed(rdv: Optional[Rendezvous] = None) -> Rendezvous:
             process_id=rdv.process_id,
         )
     return rdv
+
+
+# -- live re-rendezvous: coordinator rebootstrap (docs/ELASTIC.md) -----------
+
+class RebootstrapError(RuntimeError):
+    """A guarded rebootstrap phase failed.  Carries the phase name for
+    incident attribution and whether the failure was injected
+    (``TRAININGJOB_RESIZE_FAULT``) -- the ladder driver degrades one rung
+    either way; tests tell the two apart."""
+
+    def __init__(self, phase: str, message: str,
+                 injected: bool = False) -> None:
+        super().__init__(message)
+        self.phase = phase
+        self.injected = injected
+
+
+def resize_faults(env: Optional[Dict[str, str]] = None
+                  ) -> Dict[str, Optional[int]]:
+    """Parse ``TRAININGJOB_RESIZE_FAULT`` into {phase: generation-or-None}.
+
+    The knob is a comma-separated list of ladder phase names, each
+    optionally pinned to a single generation as ``phase@N`` (unpinned
+    phases fire at every generation).  Unknown phase names and garbled
+    pins are ignored -- a typo'd injection knob must never change what a
+    production resize does."""
+    e = os.environ if env is None else env
+    spec: Dict[str, Optional[int]] = {}
+    for token in (e.get(constants.RESIZE_FAULT_ENV, "") or "").split(","):
+        token = token.strip()
+        if not token:
+            continue
+        phase, _, pin = token.partition("@")
+        if phase not in REBOOTSTRAP_PHASES:
+            continue
+        if pin:
+            try:
+                spec[phase] = int(pin)
+            except ValueError:
+                continue
+        else:
+            spec[phase] = None
+    return spec
+
+
+def check_fault(phase: str, generation: int,
+                faults: Optional[Dict[str, Optional[int]]] = None) -> None:
+    """Raise the injected fault when the knob arms ``phase`` (for this
+    generation, or unpinned).  Deterministic: same env + same generation
+    always fails at the same point -- the property ``make resize-smoke``
+    and the rung tests rely on."""
+    faults = resize_faults() if faults is None else faults
+    if phase in faults and faults[phase] in (None, generation):
+        raise RebootstrapError(
+            phase, f"injected fault ({constants.RESIZE_FAULT_ENV}) at "
+                   f"phase {phase}, generation {generation}", injected=True)
+
+
+def shutdown_jax_distributed() -> bool:
+    """Tear down only the distributed client -- the process, its host
+    state, and the compile/executable caches stay warm.  Version-probed:
+    returns True when a live client was shut down, False when this jax has
+    no ``distributed.shutdown`` or no client was initialized."""
+    import jax
+
+    shutdown = getattr(getattr(jax, "distributed", None), "shutdown", None)
+    if shutdown is None:
+        return False
+    try:
+        shutdown()
+    except RuntimeError:
+        return False  # not initialized: nothing to tear down
+    return True
+
+
+def _clear_jax_backends() -> bool:
+    """Drop the cached XLA backends so the next jax use re-initializes
+    against the re-formed world -- ``jax.distributed.initialize`` only
+    takes effect for backends created after it.  Version-probed across the
+    locations jax has kept this; False when none exists (the rebootstrap
+    then degrades a rung rather than continuing on a stale topology)."""
+    import jax
+
+    for probe in (
+            lambda: getattr(getattr(jax, "extend", None), "backend", None),
+            lambda: jax,
+            lambda: getattr(jax, "_src", None) and jax._src.api):
+        try:
+            mod = probe()
+        # analyzer: allow[broad-except]: version probing across jax
+        # releases; any import/attr surprise just means "try the next".
+        except Exception:
+            continue
+        clear = getattr(mod, "clear_backends", None) if mod else None
+        if clear is None:
+            continue
+        try:
+            clear()
+            return True
+        # analyzer: allow[broad-except]: a failed clear leaves the old
+        # backend live; the caller treats that as "cannot rebootstrap".
+        except Exception:
+            return False
+    return False
+
+
+def barrier_timeout_s(env: Optional[Dict[str, str]] = None) -> float:
+    """The coordinator-barrier budget (``TRAININGJOB_RESIZE_BARRIER_S``,
+    default 30 s; floored at 0.1 s so a typo cannot spin-fail)."""
+    e = os.environ if env is None else env
+    try:
+        return max(float(e.get(constants.RESIZE_BARRIER_ENV, "") or 30.0),
+                   0.1)
+    except ValueError:
+        return 30.0
+
+
+def _await_coordinator(address: str, timeout: float,
+                       sleep: Callable[[float], None] = time.sleep) -> None:
+    """Block until ``address`` accepts a TCP connection, with exponential
+    backoff inside ``timeout`` seconds.  The bumped-generation coordinator
+    (new rank 0) restarts its service inside ``jax.distributed.initialize``;
+    the other survivors probe here first so their own initialize does not
+    burn its whole internal timeout against a coordinator that is still
+    tearing down."""
+    host, _, port_s = address.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise RebootstrapError(
+            "barrier", f"unparseable coordinator address {address!r}")
+    deadline = time.monotonic() + timeout
+    delay = 0.05
+    while True:
+        try:
+            probe_budget = max(min(1.0, deadline - time.monotonic()), 0.05)
+            socket.create_connection((host or "127.0.0.1", port),
+                                     timeout=probe_budget).close()
+            return
+        except OSError:
+            if time.monotonic() + delay >= deadline:
+                raise RebootstrapError(
+                    "barrier", f"coordinator {address} unreachable after "
+                               f"{timeout:.1f}s")
+            sleep(delay)
+            delay = min(delay * 2.0, 1.0)
+
+
+def rebootstrap_jax_distributed(
+        rdv: Rendezvous, doc: Dict[str, Any],
+        old_world: Optional[List[int]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+) -> Tuple[Rendezvous, Dict[str, float]]:
+    """Re-enter the distributed runtime at a published generation, live.
+
+    The re-entrant counterpart of ``initialize_jax_distributed``: survivors
+    tear down only the distributed client (``shutdown`` phase), wait for
+    the bumped-generation coordinator the controller published
+    (``barrier``, with timeout + backoff), and re-init at their new rank in
+    the published world (``reinit``).  Single-process runtimes pass through
+    with every phase a no-op -- except fault injection, which fires
+    everywhere so every rung is drivable on one process.
+
+    ``old_world`` is the replica-index list of the PREVIOUS generation
+    (llama_elastic's ``world``); a multi-process survivor's stable identity
+    is its entry there, and its new process id is that entry's position in
+    the published world.  Raises ``RebootstrapError`` with the failing
+    phase; returns the updated Rendezvous plus per-phase wall timings (ms).
+    """
+    generation = int(doc.get("generation", 0))
+    world = [int(r) for r in (doc.get("world") or [])]
+    faults = resize_faults()
+    timings: Dict[str, float] = {}
+    multi = rdv.num_processes > 1
+
+    t0 = time.perf_counter()
+    check_fault("shutdown", generation, faults)
+    if multi:
+        torn_down = shutdown_jax_distributed()
+        if torn_down and not _clear_jax_backends():
+            # The old topology would silently survive re-init: that is a
+            # wedge waiting for the first collective, not a fast path.
+            raise RebootstrapError(
+                "shutdown", "distributed client shut down but this jax "
+                            "cannot clear cached backends; cannot re-form "
+                            "the world live")
+    timings["shutdown_ms"] = (time.perf_counter() - t0) * 1e3
+
+    if multi:
+        ident = (old_world[rdv.process_id]
+                 if old_world and 0 <= rdv.process_id < len(old_world)
+                 else rdv.process_id)
+        if ident not in world:
+            # This survivor is not part of the published world: the
+            # controller meant to drain it and the delete is in flight.
+            # Degrading to the checkpoint rung parks its shards safely
+            # instead of wedging the barrier for everyone else.
+            raise RebootstrapError(
+                "reinit", f"replica {ident} absent from published world "
+                          f"{world} (generation {generation})")
+        new_pid = world.index(ident)
+        new_num = int(doc.get("num_processes") or len(world) or 1)
+        coordinator = (str(doc.get("coordinator") or "")
+                       or rdv.coordinator_address)
+    else:
+        # Single-process runtime: the published world is logical (the
+        # sim's flat device pool); there is no client to re-form.
+        new_pid, new_num, coordinator = 0, 1, rdv.coordinator_address
+
+    t1 = time.perf_counter()
+    check_fault("barrier", generation, faults)
+    if multi and new_num > 1 and coordinator and new_pid != 0:
+        _await_coordinator(coordinator, barrier_timeout_s(), sleep=sleep)
+    timings["barrier_ms"] = (time.perf_counter() - t1) * 1e3
+
+    t2 = time.perf_counter()
+    check_fault("reinit", generation, faults)
+    if multi and new_num > 1:
+        if not coordinator:
+            raise RebootstrapError(
+                "reinit", f"generation {generation} doc published no "
+                          "coordinator address")
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=new_num,
+                process_id=new_pid,
+            )
+        # analyzer: allow[broad-except]: jax raises RuntimeError,
+        # ValueError, or backend-specific errors here depending on
+        # version; every one of them means "degrade a rung".
+        except Exception as exc:
+            raise RebootstrapError(
+                "reinit", f"jax.distributed.initialize at generation "
+                          f"{generation} failed: {exc}")
+    timings["reinit_ms"] = (time.perf_counter() - t2) * 1e3
+
+    new_rdv = dataclasses.replace(
+        rdv,
+        num_processes=new_num,
+        process_id=new_pid,
+        coordinator_address=coordinator,
+        rendezvous_generation=max(generation, rdv.rendezvous_generation),
+        elastic_replicas=len(world) or rdv.elastic_replicas,
+    )
+    return new_rdv, timings
 
 
 def compile_cache_dir(rdv: Rendezvous) -> str:

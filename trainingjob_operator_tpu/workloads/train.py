@@ -529,6 +529,25 @@ def _push_resume_record(timings: Dict[str, Any]) -> None:
         emitter.close()
 
 
+def push_rendezvous_record(total_ms: float, rung: str, reason: str = "",
+                           phase_ms: Optional[Dict[str, float]] = None
+                           ) -> None:
+    """Best-effort push of a live re-rendezvous outcome (rung taken +
+    per-phase wall, docs/ELASTIC.md) to the controller's telemetry sink --
+    the same short-lived-emitter shape as ``_push_resume_record``.  Called
+    by the elastic resize ladder on rebootstrap success (rung=live) and
+    again on degrade, so the incident bundle's ``rung`` always reflects the
+    path that actually ran."""
+    emitter = TelemetryEmitter()
+    if not emitter.enabled:
+        return
+    try:
+        emitter.emit_rendezvous(total_ms, rung, reason=reason,
+                                phase_ms=phase_ms)
+    finally:
+        emitter.close()
+
+
 def resume_fastpath_enabled() -> bool:
     """Whether the resume fast path (overlapped restore+compile AND the
     executable snapshot) is on.  ``TRAININGJOB_RESUME_OVERLAP=0`` turns the
