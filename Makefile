@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -76,6 +76,15 @@ fleet-smoke:
 		--duration 3 --replicas-min 1 --replicas-max 4 --workers 4 \
 		--max-wall-seconds 24 --quiet
 
+# Two same-seed churn runs under the seeded control-plane chaos plane
+# (docs/CHAOS.md): each must converge with zero violations and zero
+# unattributed downtime while API errors/timeouts/conflicts, latency
+# spikes, watch drops and stale lists are injected; across the runs the
+# chaos plan digest and the final phase counts must be identical (the
+# seed-is-the-repro determinism contract).
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.chaos_smoke
+
 # Cold run -> serial warm resume -> overlapped warm resume at tiny shapes
 # (docs/RECOVERY.md); exits non-zero unless both resume paths work and
 # report their phase breakdowns.  The measured 124M version is bench.py's
@@ -109,4 +118,4 @@ resize-smoke:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif test dryrun incident-demo fleet-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+ci: lint lint-sarif test dryrun incident-demo fleet-smoke chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke

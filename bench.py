@@ -594,6 +594,54 @@ def bench_control_plane(jobs=120, api_latency=0.005):
     }
 
 
+def bench_control_plane_chaos(jobs=120, api_latency=0.005):
+    """Event-to-visible latency under the seeded control-plane chaos plane
+    vs a fault-free baseline (docs/CHAOS.md).
+
+    Same churn schedule both runs (identical seed/profile, paced so the
+    chaos plan's time-shaped faults land mid-flight); the chaos arm rides
+    API errors/timeouts/conflicts, latency spikes, watch drops and stale
+    lists.  Both arms must converge with zero violations -- surviving the
+    faults is the tentpole -- and the chaos p99 must stay within 3x the
+    clean p99 (gate_p99_le_3x): retries and relists are allowed to cost
+    latency, not availability.
+    """
+    from trainingjob_operator_tpu.fleet.chaos import ChaosProfile
+    from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+    from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+    profile = ChurnProfile(jobs=jobs, duration=3.0, seed=0, replicas=(1, 3),
+                           run_seconds=(0.05, 0.25))
+    runs = {}
+    for arm in ("baseline", "chaos"):
+        chaos = (ChaosProfile(seed=profile.seed, duration=5.0)
+                 if arm == "chaos" else None)
+        harness = FleetHarness(
+            profile, workers=8, api_latency=api_latency,
+            resync_period=30.0, gc_interval=30.0, converge_timeout=300.0,
+            chaos_profile=chaos)
+        runs[arm] = harness.run()
+    base, chaos = runs["baseline"], runs["chaos"]
+    base_p99 = base.event_to_visible_ms["p99"]
+    chaos_p99 = chaos.event_to_visible_ms["p99"]
+    ratio = round(chaos_p99 / base_p99, 2) if base_p99 > 0 else None
+    return {
+        "jobs": jobs,
+        "api_latency_ms": api_latency * 1000.0,
+        "baseline_p50_ms": base.event_to_visible_ms["p50"],
+        "baseline_p99_ms": base_p99,
+        "chaos_p50_ms": chaos.event_to_visible_ms["p50"],
+        "chaos_p99_ms": chaos_p99,
+        "p99_ratio": ratio,
+        "gate_p99_le_3x": ratio is not None and ratio <= 3.0,
+        "api_retries_total": chaos.api_retries_total,
+        "chaos_faults": (chaos.chaos or {}).get("faults"),
+        "informer_relists": (chaos.chaos or {}).get("informer_relists"),
+        "unattributed_downtime_ms": chaos.unattributed_downtime_ms,
+        "converged": base.converged and chaos.converged,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Part 2c: fleet sim kernel -- scan-vs-event A/B at 1k jobs
 # ---------------------------------------------------------------------------
@@ -1395,6 +1443,11 @@ def main() -> int:
     except Exception as exc:
         out["control_plane"] = {"error": f"{type(exc).__name__}: "
                                          f"{str(exc)[:300]}"}
+    try:
+        out["control_plane_chaos"] = bench_control_plane_chaos()
+    except Exception as exc:
+        out["control_plane_chaos"] = {"error": f"{type(exc).__name__}: "
+                                               f"{str(exc)[:300]}"}
     try:
         out["fleet_sim"] = bench_fleet_sim()
     except Exception as exc:

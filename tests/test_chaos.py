@@ -1,0 +1,437 @@
+"""Control-plane chaos plane tests (docs/CHAOS.md).
+
+Layered like the plane itself: plan determinism (fleet/chaos.py), the
+shared retry policy (client/retry.py), fault injection through the
+clientset/tracker proxies (client/chaos.py), informer survival of watch
+drops with the by-job index regression, stale-list vs quorum-list
+semantics, incident chaos-window attribution, and a small seeded fleet
+run that must converge clean under chaos.
+"""
+
+import threading
+import time
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.chaos import (
+    ChaosMonkey,
+    ChaosTracker,
+    chaos_clientset,
+)
+from trainingjob_operator_tpu.client.informers import Informer
+from trainingjob_operator_tpu.client.retry import (
+    ApiTimeoutError,
+    ApiUnavailableError,
+    RetryPolicy,
+    is_transient,
+    retry_call,
+    retrying_clientset,
+)
+from trainingjob_operator_tpu.client.tracker import (
+    ConflictError,
+    ObjectTracker,
+)
+from trainingjob_operator_tpu.controller.controller import job_index_key
+from trainingjob_operator_tpu.core.objects import ObjectMeta, Pod
+from trainingjob_operator_tpu.fleet.chaos import (
+    FAULT_CONFLICT,
+    FAULT_TIMEOUT,
+    FAULT_UNAVAILABLE,
+    WATCHED_KINDS,
+    ChaosGenerator,
+    ChaosPlan,
+    ChaosProfile,
+)
+from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+from trainingjob_operator_tpu.fleet.harness import FleetHarness
+from trainingjob_operator_tpu.obs.incident import IncidentRecorder
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+from conftest import wait_for  # noqa: E402
+
+
+def make_pod(name, job=None, namespace="default"):
+    labels = {}
+    if job is not None:
+        labels = {constants.GROUP_NAME_LABEL: constants.GROUP_NAME,
+                  constants.JOB_NAME_LABEL: job}
+    return Pod(metadata=ObjectMeta(name=name, namespace=namespace,
+                                   labels=labels))
+
+
+def quiet_plan(**overrides) -> ChaosPlan:
+    """A plan that injects nothing by itself: tests drive the proxies with
+    hand-written decision streams / explicit drop_streams calls."""
+    profile = ChaosProfile(seed=0, duration=1.0, latency_spikes=0,
+                           watch_drops=0)
+    defaults = dict(profile=profile, decisions={}, spikes=(), drops=(),
+                    stale=())
+    defaults.update(overrides)
+    return ChaosPlan(**defaults)
+
+
+def _retries_metric(verb):
+    return METRICS.snapshot().get(
+        f'trainingjob_api_retries_total{{verb="{verb}"}}', 0.0)
+
+
+# -- fleet/chaos.py: seeded plan expansion ------------------------------------
+
+class TestPlanDeterminism:
+    def test_same_seed_same_plan_bytes(self):
+        p = ChaosProfile(seed=42, duration=3.0, decisions_per_verb=500,
+                         stale_decisions=100)
+        a, b = ChaosGenerator(p).plan(), ChaosGenerator(p).plan()
+        assert a.canonical() == b.canonical()
+        assert a.digest() == b.digest()
+
+    def test_different_seed_different_plan(self):
+        base = dict(duration=3.0, decisions_per_verb=500, stale_decisions=100)
+        a = ChaosGenerator(ChaosProfile(seed=1, **base)).plan()
+        b = ChaosGenerator(ChaosProfile(seed=2, **base)).plan()
+        assert a.digest() != b.digest()
+
+    def test_plan_shape_matches_profile(self):
+        p = ChaosProfile(seed=7, duration=4.0, decisions_per_verb=300,
+                         latency_spikes=2, watch_drops=4, stale_decisions=50)
+        plan = ChaosGenerator(p).plan()
+        assert set(plan.decisions) == {"create", "update", "update_status",
+                                       "delete"}
+        assert all(len(s) == 300 for s in plan.decisions.values())
+        # Conflicts only on the optimistic-concurrency verbs.
+        assert FAULT_CONFLICT not in plan.decisions["create"]
+        assert FAULT_CONFLICT not in plan.decisions["delete"]
+        assert len(plan.spikes) == 2 and len(plan.drops) == 4
+        assert all(0.0 <= s.start < s.end for s in plan.spikes)
+        # Round-robin drop victims: every watched kind takes a hit.
+        assert {d.kind for d in plan.drops} == set(WATCHED_KINDS)
+        assert len(plan.stale) == 50
+
+
+# -- client/retry.py: the shared bounded-retry-with-jitter --------------------
+
+class TestRetryPolicy:
+    def test_pause_is_jittered_exponential_and_capped(self):
+        pol = RetryPolicy(attempts=5, base_delay=0.1, max_delay=0.3,
+                          jitter=0.5)
+        for retry, nominal in ((0, 0.1), (1, 0.2), (2, 0.3), (5, 0.3)):
+            for _ in range(20):
+                p = pol.pause(retry)
+                assert nominal * 0.5 <= p <= nominal * 1.5
+
+    def test_retry_call_recovers_and_counts(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ApiUnavailableError("brownout")
+            return "ok"
+
+        before = _retries_metric("unit")
+        pol = RetryPolicy(attempts=5, base_delay=0.001, max_delay=0.01)
+        assert retry_call(flaky, policy=pol, verb="unit") == "ok"
+        assert calls["n"] == 3
+        assert _retries_metric("unit") - before == 2.0
+
+    def test_retry_call_exhausts_and_raises_last_error(self):
+        pol = RetryPolicy(attempts=3, base_delay=0.001, max_delay=0.01)
+
+        def always():
+            raise ApiTimeoutError("dead")
+
+        with pytest.raises(ApiTimeoutError):
+            retry_call(always, policy=pol, verb="unit2")
+
+    def test_conflict_is_not_transient(self):
+        """Conflicts mean a stale read: blind re-submission can never win,
+        so the shared policy hands them straight to the re-read loops."""
+        assert not is_transient(ConflictError("stale"))
+        calls = {"n": 0}
+
+        def conflicted():
+            calls["n"] += 1
+            raise ConflictError("stale")
+
+        pol = RetryPolicy(attempts=5, base_delay=0.001)
+        with pytest.raises(ConflictError):
+            retry_call(conflicted, policy=pol, verb="unit3")
+        assert calls["n"] == 1
+
+    def test_single_attempt_policy_disables_wrapping(self):
+        cs = Clientset()
+        assert retrying_clientset(cs, RetryPolicy(attempts=1)) is cs
+
+
+# -- client/chaos.py: injection through the clientset -------------------------
+
+class TestChaosClientset:
+    def test_decisions_apply_in_call_order(self):
+        plan = quiet_plan(decisions={
+            "create": (FAULT_UNAVAILABLE, "ok", FAULT_TIMEOUT, "ok"),
+            "update_status": (FAULT_CONFLICT, "ok"),
+        })
+        cs = chaos_clientset(Clientset(), ChaosMonkey(plan))
+        with pytest.raises(ApiUnavailableError):
+            cs.pods.create(make_pod("p0"))
+        cs.pods.create(make_pod("p0"))          # decision 2: ok
+        with pytest.raises(ApiTimeoutError):
+            cs.pods.create(make_pod("p1"))      # decision 3, held then lost
+        cs.pods.create(make_pod("p1"))          # decision 4: ok
+        # Faulted calls never reached the tracker (pre-commit injection).
+        assert cs.tracker.count("Pod") == 2
+        # Reads pass through untouched -- no chaos decision is consumed.
+        assert cs.pods.get("default", "p0").name == "p0"
+
+    def test_conflict_stream_on_status_writes(self):
+        from trainingjob_operator_tpu.api.types import TPUTrainingJob
+        plan = quiet_plan(decisions={"update_status": (FAULT_CONFLICT, "ok")})
+        cs = chaos_clientset(Clientset(), ChaosMonkey(plan))
+        cs.trainingjobs.create(TPUTrainingJob(metadata=ObjectMeta(name="j")))
+        got = cs.trainingjobs.get("default", "j")
+        got.status.phase = "Running"
+        with pytest.raises(ConflictError):
+            cs.trainingjobs.update_status(got)
+        cs.trainingjobs.update_status(got)       # decision 2: ok
+        assert cs.trainingjobs.get("default", "j").status.phase == "Running"
+
+    def test_retrying_clientset_absorbs_injected_faults(self):
+        """The production layering: retry above chaos.  Transient injected
+        faults are invisible to the caller; only the retry counter moves."""
+        plan = quiet_plan(decisions={
+            "create": (FAULT_UNAVAILABLE, FAULT_TIMEOUT, "ok"),
+        })
+        monkey = ChaosMonkey(plan)
+        pol = RetryPolicy(attempts=5, base_delay=0.001, max_delay=0.01)
+        cs = retrying_clientset(chaos_clientset(Clientset(), monkey), pol)
+        before = _retries_metric("create")
+        created = cs.pods.create(make_pod("p"))
+        assert created.metadata.uid
+        assert monkey.faults[FAULT_UNAVAILABLE] == 1
+        assert monkey.faults[FAULT_TIMEOUT] == 1
+        assert _retries_metric("create") - before == 2.0
+
+    def test_decisions_past_stream_end_are_ok(self):
+        plan = quiet_plan(decisions={"create": (FAULT_UNAVAILABLE,)})
+        cs = chaos_clientset(Clientset(), ChaosMonkey(plan))
+        with pytest.raises(ApiUnavailableError):
+            cs.pods.create(make_pod("p"))
+        for i in range(5):                      # chaos window over
+            cs.pods.create(make_pod(f"q{i}"))
+        assert cs.tracker.count("Pod") == 5
+
+
+# -- stale lists vs quorum reads ----------------------------------------------
+
+class TestStaleList:
+    def test_stale_list_serves_previous_snapshot(self):
+        monkey = ChaosMonkey(quiet_plan(stale=(False, True, False)))
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        tracker.create(make_pod("a"))
+        assert len(tracker.list("Pod")) == 1     # decision 1: fresh, snapped
+        tracker.create(make_pod("b"))
+        stale = tracker.list("Pod")              # decision 2: lagging follower
+        assert [p.name for p in stale] == ["a"]
+        assert monkey.faults["stale_list"] == 1
+        assert len(tracker.list("Pod")) == 2     # decision 3: fresh again
+
+    def test_quorum_list_is_always_exact(self):
+        monkey = ChaosMonkey(quiet_plan(stale=(True,) * 10))
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        tracker.create(make_pod("a"))
+        tracker.list("Pod")                      # seed the snapshot
+        tracker.create(make_pod("b"))
+        assert len(tracker.quorum_list("Pod")) == 2
+        assert monkey.faults["stale_list"] == 0  # quorum never consults chaos
+
+    def test_stale_before_first_snapshot_falls_through_fresh(self):
+        monkey = ChaosMonkey(quiet_plan(stale=(True,)))
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        tracker.create(make_pod("a"))
+        assert len(tracker.list("Pod")) == 1     # nothing older to serve
+        assert monkey.faults["stale_list"] == 0
+
+
+# -- informer watch-drop survival (the by-job index regression) ---------------
+
+class TestInformerWatchDrop:
+    def test_drop_gap_relist_heals_store_and_by_job_index(self):
+        """Kill the Pod stream, mutate the world during the resumption gap,
+        and require the informer's reconnect+relist to heal BOTH the
+        handler-visible state and the secondary by-job index -- the exact
+        delta-loss window a real apiserver watch break opens."""
+        monkey = ChaosMonkey(quiet_plan())
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        informer = Informer(tracker, Pod.KIND)
+        informer.add_index(constants.JOB_INDEX, job_index_key)
+        adds, dels = [], []
+        informer.add_event_handler(
+            on_add=lambda o: adds.append(o.name),
+            on_delete=lambda o: dels.append(o.name))
+
+        tracker.create(make_pod("p0", job="jobA"))
+        tracker.create(make_pod("p1", job="jobA"))
+        assert wait_for(lambda: sorted(adds) == ["p0", "p1"])
+        assert len(informer.by_index(constants.JOB_INDEX,
+                                     "default/jobA")) == 2
+
+        tracker.drop_streams(Pod.KIND, gap=0.05)
+        # Deltas committed inside the gap flow past the dead stream:
+        tracker.delete("Pod", "default", "p0", grace_period=0)
+        tracker.create(make_pod("p2", job="jobA"))
+        tracker.create(make_pod("p3", job="jobB"))
+
+        # The gap timer fires on_error; the informer reconnects + relists.
+        assert wait_for(lambda: informer.relists_total == 1, timeout=10.0)
+        assert wait_for(lambda: "p0" in dels and "p2" in adds, timeout=10.0)
+        job_a = {p.name for p in informer.by_index(constants.JOB_INDEX,
+                                                   "default/jobA")}
+        assert job_a == {"p1", "p2"}            # no entry lost, none leaked
+        job_b = {p.name for p in informer.by_index(constants.JOB_INDEX,
+                                                   "default/jobB")}
+        assert job_b == {"p3"}
+
+        # And the reconnected stream is live: post-recovery events flow.
+        tracker.create(make_pod("p4", job="jobB"))
+        assert wait_for(lambda: "p4" in adds, timeout=10.0)
+        assert {p.name for p in informer.by_index(
+            constants.JOB_INDEX, "default/jobB")} == {"p3", "p4"}
+        informer.stop()
+
+    def test_subscriber_without_on_error_loses_gap_deltas(self):
+        """Pin the legacy hazard the hardened informer exists to close: a
+        plain watch (no on_error) is silently resubscribed after the gap
+        and the deltas committed inside it are simply gone."""
+        monkey = ChaosMonkey(quiet_plan())
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        seen = []
+        tracker.watch("Pod", lambda e: seen.append((e.type, e.obj.name)))
+        tracker.create(make_pod("before"))
+        tracker.drop_streams("Pod", gap=0.05)
+        tracker.create(make_pod("during"))      # lost: stream is down
+        # Poll with uniquely named probes until the silent resubscribe (at
+        # gap end) makes one visible on the stream again.
+        probe = iter(range(10000))
+        assert wait_for(
+            lambda: (tracker.create(make_pod(f"probe{next(probe)}")) or True)
+            and any(n.startswith("probe") for _, n in seen),
+            timeout=10.0)
+        assert ("ADDED", "during") not in seen
+
+    def test_unsubscribe_during_gap_is_not_resurrected(self):
+        monkey = ChaosMonkey(quiet_plan())
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        seen = []
+        unsub = tracker.watch("Pod", lambda e: seen.append(e.obj.name))
+        tracker.drop_streams("Pod", gap=0.05)
+        unsub()                                  # caller quit mid-gap
+        time.sleep(0.15)
+        tracker.create(make_pod("late"))
+        time.sleep(0.05)
+        assert seen == []
+
+
+# -- chaos monkey lifecycle ---------------------------------------------------
+
+class TestChaosMonkey:
+    def test_windows_only_exist_after_attach(self):
+        from trainingjob_operator_tpu.fleet.chaos import LatencySpike, WatchDrop
+        plan = quiet_plan(
+            spikes=(LatencySpike(start=1.0, end=1.5, delay=0.01),),
+            drops=(WatchDrop(at=2.0, gap=0.1, kind="Pod"),))
+        monkey = ChaosMonkey(plan)
+        assert monkey.windows_abs() == []        # no run clock yet
+        monkey.maybe_spike()                     # no-op before attach
+        monkey.attach()
+        try:
+            windows = monkey.windows_abs()
+            kinds = sorted(k for k, _, _ in windows)
+            assert kinds == ["latency", "watch_drop"]
+            for _, start, end in windows:
+                assert end > start
+        finally:
+            monkey.close()
+
+    def test_close_cancels_pending_drops(self):
+        from trainingjob_operator_tpu.fleet.chaos import WatchDrop
+        plan = quiet_plan(drops=(WatchDrop(at=30.0, gap=0.1, kind="Pod"),))
+        monkey = ChaosMonkey(plan)
+        tracker = ChaosTracker(ObjectTracker(), monkey)
+        fired = threading.Event()
+        tracker.watch("Pod", lambda e: None,
+                      on_error=lambda err: fired.set())
+        monkey.attach()
+        monkey.close()
+        assert not fired.wait(0.2)               # timer was cancelled
+
+
+# -- incident chaos-window attribution ----------------------------------------
+
+class TestIncidentChaosAttribution:
+    JOB = "default/chaosjob"
+
+    def _restart_window(self, rec, t0):
+        rec.on_interruption(self.JOB, "ALL", constants.RESTARTING_REASON,
+                            now=t0)
+        rec.record_event(self.JOB, constants.RESTARTING_REASON, "restarting",
+                         ts=t0 + 0.2)
+        rec.on_running(self.JOB, now=t0 + 2.0)
+
+    def test_bundle_carries_clipped_overlapping_windows(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        rec.record_chaos_window("latency", 99.5, 100.5)     # clips to 0.5 s
+        rec.record_chaos_window("watch_drop", 101.0, 101.2)  # inside: 0.2 s
+        rec.record_chaos_window("latency", 300.0, 301.0)     # disjoint
+        self._restart_window(rec, t0=100.0)
+        (bundle,) = rec.bundles(self.JOB)
+        kinds = [w["kind"] for w in bundle["chaos_windows"]]
+        assert sorted(kinds) == ["latency", "watch_drop"]
+        spans = {w["kind"]: (w["start"], w["end"])
+                 for w in bundle["chaos_windows"]}
+        assert spans["latency"] == (100.0, 100.5)
+        assert spans["watch_drop"] == (101.0, 101.2)
+        assert bundle["chaos_overlap_ms"] == pytest.approx(700.0)
+
+    def test_reassembly_is_byte_stable_with_chaos_windows(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        rec.record_chaos_window("watch_drop", 100.3, 100.9)
+        self._restart_window(rec, t0=100.0)
+        first = rec.bundle_json(self.JOB)
+        assert first is not None and "chaos_windows" in first
+        assert rec.reassemble(self.JOB) == first
+        assert rec.reassemble(self.JOB) == first
+
+    def test_clear_chaos_windows_stops_attribution(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        rec.record_chaos_window("latency", 99.0, 105.0)
+        rec.clear_chaos_windows()
+        self._restart_window(rec, t0=100.0)
+        (bundle,) = rec.bundles(self.JOB)
+        assert bundle["chaos_windows"] == []
+        assert bundle["chaos_overlap_ms"] == 0.0
+
+
+# -- the whole plane: seeded fleet run under chaos ----------------------------
+
+class TestChaosFleet:
+    def test_small_chaos_fleet_converges_clean(self):
+        """The ISSUE gate in miniature: a seeded churn schedule with the
+        apiserver browning out underneath must converge with zero invariant
+        violations and zero unattributed downtime, and the run's plan digest
+        must equal a from-scratch expansion of the same profile."""
+        churn = ChurnProfile(jobs=16, duration=1.0, seed=9, replicas=(1, 3))
+        chaos = ChaosProfile(seed=9, duration=3.0)
+        harness = FleetHarness(churn, workers=4, resync_period=30.0,
+                               gc_interval=30.0, converge_timeout=90.0,
+                               chaos_profile=chaos)
+        report = harness.run()
+        assert report.converged, report.violations[:10]
+        assert report.violations == []
+        assert report.unattributed_downtime_ms == 0.0
+        assert report.chaos is not None
+        assert report.chaos["seed"] == 9
+        assert (report.chaos["plan_digest"]
+                == ChaosGenerator(chaos).plan().digest())
+        assert set(report.phase_counts) <= {"Succeed", "Running", "Preempted"}

@@ -1512,6 +1512,73 @@ class TestRetryWithoutBackoff:
         assert analyze(tmp_path, "m.py", src,
                        only=["retry-without-backoff"]) == []
 
+    # -- the jitter advisory (retry-backoff-no-jitter) -----------------------
+
+    def test_advisory_fires_on_constant_sleep_in_client_path(self, tmp_path):
+        """A paced retry loop whose every pacer is the same fixed sleep
+        retries in fleet-wide lockstep; in the API-client/controller tree
+        that is the thundering-herd shape the advisory flags."""
+        src = """
+        import time
+
+        def patient(client):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    time.sleep(0.5)
+        """
+        findings = analyze(tmp_path, "client/m.py", src,
+                           only=["retry-without-backoff"])
+        assert ids(findings) == ["TJA018"]
+        (f,) = findings
+        assert f.check_name == "retry-backoff-no-jitter"
+        assert f.severity == "warning" and "jitter" in f.message
+
+    def test_advisory_quiet_outside_scoped_paths(self, tmp_path):
+        src = """
+        import time
+
+        def patient(client):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    time.sleep(0.5)
+        """
+        assert analyze(tmp_path, "workloads/m.py", src,
+                       only=["retry-without-backoff"]) == []
+
+    def test_advisory_quiet_with_computed_delay(self, tmp_path):
+        src = """
+        import time
+
+        def patient(client, delay):
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    time.sleep(delay * 2)
+        """
+        assert analyze(tmp_path, "controller/m.py", src,
+                       only=["retry-without-backoff"]) == []
+
+    def test_advisory_quiet_with_backoff_helper(self, tmp_path):
+        """Pacing through a *backoff*-named helper (client/retry.py's
+        backoff_pause) is presumed jittered."""
+        src = """
+        def patient(client, policy):
+            attempt = 0
+            while True:
+                try:
+                    return client.fetch()
+                except OSError:
+                    backoff_pause(policy, attempt)
+                    attempt += 1
+        """
+        assert analyze(tmp_path, "client/m.py", src,
+                       only=["retry-without-backoff"]) == []
+
 
 # -- TJA019 finally-state-restore --------------------------------------------
 

@@ -2,12 +2,16 @@
 ``--thread-num N`` safe (client-go parity, SURVEY.md §5.2).
 
 - A key is never processed by two workers at once, however hard the queue
-  is hammered with adds/delayed adds from outside.
+  is hammered with adds/delayed adds from outside -- including when the
+  handler fails and requeues rate-limited (the chaos regime).
 - A re-add landing while the key is being processed is not lost: it is
   redelivered after done().
 - Rate-limited requeues back off exponentially per key and reset on forget.
 - add_after coalesces duplicate delayed keys to the earliest deadline and
   delivers exactly once; shut_down cancels pending delayed items.
+- Past ``quarantine_after`` consecutive failures a key parks at the flat
+  quarantine delay; the transition is reported exactly once per episode
+  and forget (one success) releases it (docs/CHAOS.md).
 """
 
 import collections
@@ -120,6 +124,137 @@ class TestRateLimiting:
         q.done("a")
         q.forget("a")
         assert q.num_requeues("a") == 0
+        q.shut_down()
+
+
+class TestFailureStorm:
+    def test_single_writer_per_key_when_handlers_fail(self):
+        """4 workers, 6 keys, every sync "fails" for a while: rate-limited
+        requeues must preserve the single-writer-per-key guarantee and
+        every key must eventually be processed again after its failures."""
+        q = RateLimitingQueue("storm", base_delay=0.001, max_delay=0.01)
+        keys = [f"k{i}" for i in range(6)]
+        lock = threading.Lock()
+        active = collections.Counter()
+        failures = collections.Counter()
+        recovered = set()
+        violations = []
+
+        def worker():
+            while True:
+                item, shutdown = q.get(timeout=0.2)
+                if shutdown:
+                    return
+                if item is None:
+                    continue
+                with lock:
+                    active[item] += 1
+                    if active[item] > 1:
+                        violations.append(item)
+                time.sleep(0.001)
+                with lock:
+                    active[item] -= 1
+                    failures[item] += 1
+                    failed = failures[item] <= 5
+                if failed:
+                    q.add_rate_limited(item)
+                else:
+                    q.forget(item)
+                    with lock:
+                        recovered.add(item)
+                q.done(item)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(4)]
+        for t in workers:
+            t.start()
+        for k in keys:
+            q.add(k)
+        assert wait_for(lambda: len(recovered) == len(keys), timeout=30.0)
+        q.shut_down()
+        for t in workers:
+            t.join(timeout=5.0)
+        assert violations == []
+        assert all(q.num_requeues(k) == 0 for k in keys)
+
+    def test_backoff_ordering_is_per_key(self):
+        """A deep-failure key's long delay must not hold back a fresh
+        key's short one: deliveries pop in per-key deadline order."""
+        q = RateLimitingQueue("ordering", base_delay=0.02, max_delay=5.0)
+        # Drive "deep" through failed cycles (back-to-back re-adds would
+        # coalesce to the earliest deadline): 0.02 s, 0.04 s, then a
+        # pending 0.08 s entry.
+        q.add_rate_limited("deep")
+        for _ in range(2):
+            item, _ = q.get(timeout=2.0)
+            assert item == "deep"
+            q.done("deep")
+            q.add_rate_limited("deep")
+        q.add_rate_limited("fresh")      # first failure: 0.02 s
+        first, _ = q.get(timeout=2.0)
+        assert first == "fresh"
+        q.done("fresh")
+        second, _ = q.get(timeout=2.0)
+        assert second == "deep"
+        q.done("deep")
+        q.shut_down()
+
+
+class TestQuarantine:
+    def test_entry_is_reported_once_and_delay_flattens(self):
+        q = RateLimitingQueue("quarantine", base_delay=0.001,
+                              max_delay=60.0, quarantine_after=3,
+                              quarantine_delay=0.05)
+        assert q.add_rate_limited("k") is False
+        assert q.add_rate_limited("k") is False
+        # Third consecutive failure crosses the threshold: reported once.
+        assert q.add_rate_limited("k") is True
+        assert q.is_quarantined("k")
+        assert q.num_quarantined() == 1
+        assert q.quarantined_total == 1
+        # Further failures stay parked at the flat delay, silently.
+        assert q.add_rate_limited("k") is False
+        assert q.num_quarantined() == 1
+        # Delay is the flat quarantine cadence, not the exponential ladder
+        # (failures=5 on base 0.001 would be ~0.016 s; quarantine holds it
+        # at 0.05 s -- and far below the 60 s max_delay ceiling).
+        t0 = time.monotonic()
+        for _ in range(5):
+            item, _ = q.get(timeout=2.0)
+            assert item == "k"
+            q.done("k")
+            if q.is_quarantined("k"):
+                q.add_rate_limited("k")
+            else:
+                break
+        assert time.monotonic() - t0 >= 0.05
+        q.shut_down()
+
+    def test_forget_releases_quarantine(self):
+        q = RateLimitingQueue("release", base_delay=0.001,
+                              quarantine_after=2, quarantine_delay=0.02)
+        q.add_rate_limited("k")
+        assert q.add_rate_limited("k") is True
+        item, _ = q.get(timeout=2.0)
+        assert item == "k"
+        q.forget("k")                    # the sync succeeded
+        q.done("k")
+        assert not q.is_quarantined("k")
+        assert q.num_quarantined() == 0
+        assert q.num_requeues("k") == 0
+        # A fresh failure episode starts from the exponential ladder and
+        # must cross the threshold again to re-quarantine.
+        assert q.add_rate_limited("k") is False
+        assert q.add_rate_limited("k") is True
+        assert q.quarantined_total == 2
+        q.shut_down()
+
+    def test_zero_disables(self):
+        q = RateLimitingQueue("off", base_delay=0.001)
+        for _ in range(10):
+            assert q.add_rate_limited("k") is False
+        assert q.num_quarantined() == 0
+        assert not q.is_quarantined("k")
         q.shut_down()
 
 

@@ -21,11 +21,20 @@ The CFG makes "on its back edge" precise.  A finding requires all of:
   try entry that passes **no backoff call** (``_flow.is_backoff_call``) --
   pacing at the loop top or in the handler both break the path, anywhere
   else does not help.
+
+A second, advisory form (``retry-backoff-no-jitter``) fires when the loop
+IS paced but every pacing call in it is a constant-literal ``sleep`` --
+scoped to ``client/`` and ``controller/`` code, where N replicas retrying
+against one recovering apiserver with the same fixed period re-arrive in
+lockstep (thundering herd).  A computed delay (exponential ladder, jittered
+policy, ``backoff``-named helper) is assumed to decorrelate and stays
+quiet; client/retry.py is the blessed implementation.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 from typing import List, Optional, Set
 
 from tools.analyze.findings import Finding, WARNING
@@ -80,6 +89,25 @@ def _is_api_call(call: ast.Call) -> bool:
     return False
 
 
+def _herd_scoped(path: str) -> bool:
+    """Only control-plane client/controller code retries against the one
+    shared apiserver at fleet multiplicity; elsewhere a fixed sleep has no
+    herd to synchronize."""
+    parts = path.replace(os.sep, "/").split("/")
+    return "client" in parts or "controller" in parts
+
+
+def _constant_sleep(call: ast.Call) -> bool:
+    """``time.sleep(0.5)``-shaped: a sleep whose every argument is a bare
+    literal, so all retriers share one fixed period.  Computed delays and
+    ``backoff``-named helpers do not count."""
+    dotted = call_dotted(call) or ""
+    if dotted.rsplit(".", 1)[-1] != "sleep":
+        return False
+    return bool(call.args) and all(
+        isinstance(a, ast.Constant) for a in call.args) and not call.keywords
+
+
 def _swallows(handler: ast.ExceptHandler) -> bool:
     for node in walk_fast(handler):
         if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
@@ -103,6 +131,7 @@ def check(ctx: FileContext) -> List[Finding]:
         if not tries:
             continue
         cfg = None
+        advised: Set[int] = set()  # loops already carrying the jitter advisory
         for t in tries:
             loop = enclosing(parents, t, ast.While, ast.For, ast.AsyncFor,
                              ast.FunctionDef, ast.AsyncFunctionDef)
@@ -133,5 +162,23 @@ def check(ctx: FileContext) -> List[Finding]:
                         f"after catching {caught} with no sleep/backoff on "
                         f"the back edge; add time.sleep or a rate limiter "
                         f"before retrying"))
+                    continue
+                # Paced -- but if every pacing call in the loop is a fixed-
+                # literal sleep and this is control-plane code, N retriers
+                # re-arrive at the recovering apiserver in lockstep.
+                if not _herd_scoped(ctx.path) or id(loop) in advised:
+                    continue
+                pacers = [n for s in loop.body for n in walk_fast(s)
+                          if isinstance(n, ast.Call) and is_backoff_call(n)]
+                if pacers and all(_constant_sleep(c) for c in pacers):
+                    advised.add(id(loop))
+                    findings.append(Finding(
+                        "TJA018", "retry-backoff-no-jitter", ctx.path,
+                        pacers[0].lineno, 0, WARNING,
+                        f"retry loop in {fn.name}() paces every attempt "
+                        f"with the same fixed sleep; under fleet-wide "
+                        f"faults all retriers re-arrive in lockstep "
+                        f"(thundering herd) -- use client/retry.py's "
+                        f"jittered RetryPolicy or add jitter to the delay"))
     findings.sort(key=Finding.sort_key)
     return findings

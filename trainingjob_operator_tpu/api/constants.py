@@ -141,6 +141,17 @@ FLEET_JOBS_ENV = "TRAININGJOB_FLEET_JOBS"
 # timer queue, O(events)) or "scan" (the original fixed-cadence pod walk,
 # kept as the A/B baseline and escape hatch).  User-set, never injected.
 SIM_KERNEL_ENV = "TRAININGJOB_SIM_KERNEL"
+# Control-plane chaos plane (fleet/chaos.py + client/chaos.py): the seed
+# feeding the deterministic fault-schedule generator for `--chaos` harness
+# runs and `make chaos-smoke`.  User-set, never injected.
+CHAOS_SEED_ENV = "TRAININGJOB_CHAOS_SEED"
+# Bounded-retry budget for controller API writes (client/retry.py
+# default_policy; attempts, clamped to [1, 16]; 1 disables retry).
+API_RETRIES_ENV = "TRAININGJOB_API_RETRIES"
+# Sync-loop failure quarantine (cmd/options.py -> workqueue): consecutive
+# failed syncs before a key is parked (0 disables), and how long it parks.
+QUARANTINE_AFTER_ENV = "TRAININGJOB_QUARANTINE_AFTER"
+QUARANTINE_DELAY_ENV = "TRAININGJOB_QUARANTINE_S"
 PALLAS_ENV = "TRAININGJOB_PALLAS"
 FA_BLOCK_Q_ENV = "TRAININGJOB_FA_BLOCK_Q"
 FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
@@ -239,6 +250,10 @@ USER_ENV_KNOBS = frozenset((
     FLEET_SEED_ENV,
     FLEET_JOBS_ENV,
     SIM_KERNEL_ENV,
+    CHAOS_SEED_ENV,
+    API_RETRIES_ENV,
+    QUARANTINE_AFTER_ENV,
+    QUARANTINE_DELAY_ENV,
     INCIDENT_RING_ENV,
     INCIDENT_BUNDLES_ENV,
     HBM_SAMPLE_STEPS_ENV,
@@ -316,6 +331,10 @@ RESHARD_FELL_BACK_REASON = "ReshardFellBack"
 # budget -- survivors are polling for a doc that never arrived, so the
 # resize is wedged on the channel, not on the workload.
 RESIZE_PUBLISH_FAILED_REASON = "ResizePublishFailed"
+# SyncQuarantined: a job key failed N consecutive reconciles and was parked
+# in the workqueue quarantine -- it will be retried on a slow flat cadence
+# instead of the exponential ladder, and one successful sync releases it.
+SYNC_QUARANTINED_REASON = "SyncQuarantined"
 
 # Telemetry-plane reasons (obs/telemetry.py watchdog): a replica's step
 # counter stopped advancing for N x its median step time / started moving
@@ -357,6 +376,7 @@ EVENT_REASONS = frozenset((
     RESHARD_COMPLETED_REASON,
     RESHARD_FELL_BACK_REASON,
     RESIZE_PUBLISH_FAILED_REASON,
+    SYNC_QUARANTINED_REASON,
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
     INCIDENT_RECORDED_REASON,

@@ -1,0 +1,332 @@
+"""Fault-injecting clientset/tracker proxies: the chaos plane's muscle.
+
+``fleet/chaos.py`` plans *what* goes wrong (seeded, deterministic);
+this module makes it go wrong, between the controller and the tracker:
+
+- :class:`ChaosMonkey` consumes a ``ChaosPlan``: per-verb fault decision
+  streams (decision ``i`` applies to the ``i``-th call of that verb),
+  wall-clock latency-spike windows, timer-armed watch-stream drops, and
+  a stale-list decision stream.  Every injected fault is counted in
+  ``trainingjob_chaos_faults_total{kind}``.
+- :func:`chaos_clientset` wraps a clientset's typed *write* verbs so they
+  draw from the monkey before touching the tracker.
+- :class:`ChaosTracker` wraps the tracker the *informers* watch: it can
+  sever watch subscriptions mid-run (resumption gap included) and serve
+  stale ``list()`` snapshots, while ``quorum_list()`` stays exact -- the
+  consistent read informers use to relist after a gap (k8s semantics:
+  relist is a quorum read even when plain lists may hit a lagging
+  follower).
+
+Injection is strictly **pre-commit**: a faulted request never reaches the
+tracker, so "timeout" means *request lost before apply*.  That keeps the
+fault model at-most-once.  The nastier at-least-once shape (applied but
+unacknowledged, so a retry hits AlreadyExists/Conflict) is exercised
+separately by the conflict stream; see docs/CHAOS.md for the taxonomy.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.client.retry import (
+    ApiTimeoutError,
+    ApiUnavailableError,
+)
+from trainingjob_operator_tpu.client.tracker import ConflictError, WatchEvent
+from trainingjob_operator_tpu.fleet.chaos import (
+    FAULT_CONFLICT,
+    FAULT_TIMEOUT,
+    FAULT_UNAVAILABLE,
+    ChaosPlan,
+)
+from trainingjob_operator_tpu.utils.metrics import METRICS
+
+
+class ChaosMonkey:
+    """Runtime state for one chaos schedule: call counters, the run clock,
+    and the timers that fire time-shaped faults.
+
+    Verb decisions are live from construction (they index call *order*,
+    not time); :meth:`attach` starts the run clock that latency windows
+    and watch drops key off, so time-shaped faults line up with the churn
+    schedule no matter how long harness setup took.
+    """
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {v: 0 for v in plan.decisions}
+        self._stale_idx = 0
+        self.faults: Counter = Counter()
+        self._timers: List[threading.Timer] = []
+        self._trackers: List["ChaosTracker"] = []
+        self._t0: Optional[float] = None      # monotonic, for windows
+        self._wall0: Optional[float] = None   # wall, for incident windows
+        self._closed = False
+
+    # -- plan consumption ----------------------------------------------------
+
+    def decide(self, verb: str) -> str:
+        """Next fault decision for ``verb`` ("ok" past the stream's end)."""
+        stream = self.plan.decisions.get(verb)
+        if stream is None:
+            return "ok"
+        with self._lock:
+            i = self._counters[verb]
+            self._counters[verb] = i + 1
+        return stream[i] if i < len(stream) else "ok"
+
+    def decide_stale(self) -> bool:
+        with self._lock:
+            i = self._stale_idx
+            self._stale_idx = i + 1
+        stream = self.plan.stale
+        return stream[i] if i < len(stream) else False
+
+    def record_fault(self, kind: str) -> None:
+        with self._lock:
+            self.faults[kind] += 1
+        METRICS.inc("trainingjob_chaos_faults_total", kind=kind)
+
+    def maybe_spike(self) -> None:
+        """Hold the calling thread for the active latency window's delay,
+        if the run clock is inside one."""
+        if self._t0 is None:
+            return
+        elapsed = time.monotonic() - self._t0
+        for s in self.plan.spikes:
+            if s.start <= elapsed < s.end:
+                self.record_fault("latency")
+                time.sleep(s.delay)
+                return
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def attach(self) -> None:
+        """Start the run clock and arm the watch-drop timers."""
+        self._t0 = time.monotonic()
+        self._wall0 = time.time()
+        for drop in self.plan.drops:
+            t = threading.Timer(drop.at, self._fire_drop, args=(drop,))
+            t.daemon = True
+            t.start()
+            with self._lock:
+                self._timers.append(t)
+
+    def _fire_drop(self, drop: Any) -> None:
+        if self._closed:
+            return
+        self.record_fault("watch_drop")
+        for tr in list(self._trackers):
+            tr.drop_streams(drop.kind, drop.gap)
+
+    def register_tracker(self, tracker: "ChaosTracker") -> None:
+        self._trackers.append(tracker)
+
+    def track_timer(self, timer: threading.Timer) -> None:
+        with self._lock:
+            self._timers.append(timer)
+
+    def windows_abs(self) -> List[Tuple[str, float, float]]:
+        """Chaos windows as (kind, start, end) wall-clock spans, for the
+        incident recorder's downtime attribution.  Empty before attach."""
+        if self._wall0 is None:
+            return []
+        w0 = self._wall0
+        out: List[Tuple[str, float, float]] = []
+        for s in self.plan.spikes:
+            out.append(("latency", w0 + s.start, w0 + s.end))
+        for d in self.plan.drops:
+            out.append(("watch_drop", w0 + d.at, w0 + d.at + d.gap))
+        return out
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            timers, self._timers = self._timers, []
+        for t in timers:
+            t.cancel()
+
+
+class _ChaosClient:
+    """Typed-client proxy that draws a fault decision before each write.
+    Reads pass through -- read-side chaos lives in :class:`ChaosTracker`
+    (stale lists) where the informers actually read."""
+
+    def __init__(self, inner: Any, monkey: ChaosMonkey):
+        self._inner = inner
+        self._monkey = monkey
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def _pre(self, verb: str) -> None:
+        m = self._monkey
+        m.maybe_spike()
+        decision = m.decide(verb)
+        if decision == FAULT_UNAVAILABLE:
+            m.record_fault(decision)
+            raise ApiUnavailableError(f"chaos: injected 5xx on {verb}")
+        if decision == FAULT_TIMEOUT:
+            m.record_fault(decision)
+            time.sleep(m.plan.profile.timeout_hold)
+            raise ApiTimeoutError(f"chaos: injected timeout on {verb}")
+        if decision == FAULT_CONFLICT:
+            m.record_fault(decision)
+            raise ConflictError(f"chaos: injected conflict on {verb}")
+
+    def create(self, obj: Any) -> Any:
+        self._pre("create")
+        return self._inner.create(obj)
+
+    def update(self, obj: Any) -> Any:
+        self._pre("update")
+        return self._inner.update(obj)
+
+    def update_status(self, obj: Any) -> Any:
+        self._pre("update_status")
+        return self._inner.update_status(obj)
+
+    def delete(self, namespace: str, name: str,
+               grace_period: Optional[int] = None) -> Any:
+        self._pre("delete")
+        return self._inner.delete(namespace, name, grace_period)
+
+
+class ChaosClientset:
+    """Clientset view whose write verbs misbehave per the plan.  Wraps the
+    *given* typed clients (never rebuilt from the tracker) so an injected
+    latency layer underneath stays in the request path.  Nodes stay
+    unwrapped: the controller never writes them, and faulting the
+    harness's capacity setup would test the harness, not the operator."""
+
+    def __init__(self, inner: Any, monkey: ChaosMonkey):
+        self._inner = inner
+        self.tracker = inner.tracker
+        self.trainingjobs = _ChaosClient(inner.trainingjobs, monkey)
+        self.pods = _ChaosClient(inner.pods, monkey)
+        self.services = _ChaosClient(inner.services, monkey)
+        self.events = _ChaosClient(inner.events, monkey)
+        self.nodes = inner.nodes
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def chaos_clientset(cs: Any, monkey: ChaosMonkey) -> Any:
+    return ChaosClientset(cs, monkey)
+
+
+class ChaosTracker:
+    """Tracker proxy for the *informer* side of the control plane.
+
+    - ``watch`` subscriptions are registered here so a planned drop can
+      sever every stream of a kind, wait out the resumption gap, then
+      notify subscribers via their ``on_error`` callback (hardened
+      informers reconnect + relist).  A subscriber without ``on_error``
+      is silently resubscribed after the gap -- deltas committed during
+      the gap are lost, which is exactly the legacy hazard the informer
+      relist regression test pins.
+    - ``list`` may serve the previous snapshot for its query (a lagging
+      follower read), per the stale decision stream.
+    - ``quorum_list`` is always exact: the consistent read relist uses.
+
+    Everything else (get, register_finalizer, ``_dispatch_lock``, ...)
+    passes through to the real tracker.
+    """
+
+    def __init__(self, inner: Any, monkey: ChaosMonkey):
+        self._inner = inner
+        self._monkey = monkey
+        self._lock = threading.Lock()
+        self._subs: Dict[int, Dict[str, Any]] = {}
+        self._next_id = 0
+        #: query-key -> previous result (deepcopies), for stale serving.
+        self._snapshots: Dict[Any, List[Any]] = {}
+        monkey.register_tracker(self)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[WatchEvent], None],
+              on_error: Optional[Callable[[BaseException], None]] = None,
+              ) -> Callable[[], None]:
+        rec: Dict[str, Any] = {
+            "kind": kind, "handler": handler, "on_error": on_error,
+            "unsub": self._inner.watch(kind, handler), "dropped": False,
+        }
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            self._subs[sid] = rec
+
+        def unsubscribe() -> None:
+            with self._lock:
+                r = self._subs.pop(sid, None)
+            if r is not None and not r["dropped"]:
+                r["unsub"]()
+
+        return unsubscribe
+
+    def drop_streams(self, kind: str, gap: float) -> None:
+        """Sever every live subscription of ``kind`` now; after ``gap``
+        seconds (the resumption gap -- deltas committed inside it flow
+        past the dead stream) notify or resubscribe the victims."""
+        with self._lock:
+            victims = [(sid, r) for sid, r in self._subs.items()
+                       if r["kind"] == kind and not r["dropped"]]
+            for _, r in victims:
+                r["dropped"] = True
+        for _, r in victims:
+            r["unsub"]()
+        if not victims:
+            return
+        t = threading.Timer(gap, self._after_gap, args=(victims,))
+        t.daemon = True
+        t.start()
+        self._monkey.track_timer(t)
+
+    def _after_gap(self, victims: List[Tuple[int, Dict[str, Any]]]) -> None:
+        for sid, r in victims:
+            with self._lock:
+                if sid not in self._subs:
+                    continue  # unsubscribed during the gap
+                if r["on_error"] is not None:
+                    # The subscriber owns recovery: it will re-watch (a
+                    # fresh subscription) and relist.  Retire this one.
+                    self._subs.pop(sid, None)
+            if r["on_error"] is not None:
+                r["on_error"](
+                    ApiUnavailableError(f"chaos: {r['kind']} watch dropped"))
+            else:
+                with self._lock:
+                    if sid in self._subs:
+                        r["unsub"] = self._inner.watch(r["kind"], r["handler"])
+                        r["dropped"] = False
+
+    # -- reads ---------------------------------------------------------------
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        qkey = (kind, namespace,
+                tuple(sorted(label_selector.items())) if label_selector else None)
+        if self._monkey.decide_stale():
+            with self._lock:
+                snap = self._snapshots.get(qkey)
+            if snap is not None:
+                self._monkey.record_fault("stale_list")
+                return [copy.deepcopy(o) for o in snap]
+        fresh = self._inner.list(kind, namespace, label_selector)
+        with self._lock:
+            self._snapshots[qkey] = [copy.deepcopy(o) for o in fresh]
+        return fresh
+
+    def quorum_list(self, kind: str, namespace: Optional[str] = None,
+                    label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        return self._inner.list(kind, namespace, label_selector)

@@ -10,7 +10,9 @@ update/delete handler triples the controller wires up
 
 from __future__ import annotations
 
+import contextlib
 import copy
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -22,6 +24,9 @@ from trainingjob_operator_tpu.client.tracker import (
     ObjectTracker,
     WatchEvent,
 )
+from trainingjob_operator_tpu.utils.metrics import METRICS
+
+log = logging.getLogger("trainingjob.informers")
 
 
 class Lister:
@@ -52,6 +57,12 @@ class Informer:
     the in-process analogue of the informer delivering from its event queue;
     handlers must be cheap -- the controller's handlers only touch the
     workqueue/expectations, same as the reference's.
+
+    A closed/errored watch stream (a tracker that can drop streams reports
+    it via the ``on_error`` callback) is survived, not ignored: the informer
+    reconnects first, then runs a gap-detecting :meth:`relist` that
+    synthesizes exactly the deltas the dead stream swallowed, so handler
+    state and secondary indices stay complete across the drop.
     """
 
     def __init__(self, tracker: ObjectTracker, kind: str):
@@ -67,11 +78,28 @@ class Informer:
         # with the job's pods and one that scales with the cluster.
         self._index_fns: Dict[str, Callable[[Any], Optional[str]]] = {}
         self._indices: Dict[str, Dict[str, Dict[str, Any]]] = {}
-        self._unsub = tracker.watch(kind, self._on_event)
+        #: Watch re-establishments survived (also a per-kind metric).
+        self.relists_total = 0
+        self._unsub = self._subscribe()
         with self._lock:
-            for obj in tracker.list(kind):
+            for obj in self._quorum_list():
                 self._last_seen[f"{obj.metadata.namespace}/{obj.metadata.name}"] = obj
         self.lister = Lister(tracker, kind)
+
+    def _subscribe(self) -> Callable[[], None]:
+        try:
+            return self._tracker.watch(self._kind, self._on_event,
+                                       on_error=self._on_stream_error)
+        except TypeError:
+            # Tracker predating the on_error contract: it can't report
+            # drops, so there is nothing to recover from.
+            return self._tracker.watch(self._kind, self._on_event)
+
+    def _quorum_list(self) -> List[Any]:
+        """Consistent read for seeding and relist.  A plain ``list`` may be
+        served stale (lagging follower); relist-after-gap must not be."""
+        fn = getattr(self._tracker, "quorum_list", None) or self._tracker.list
+        return fn(self._kind)
 
     def add_event_handler(self,
                           on_add: Optional[Callable[[Any], None]] = None,
@@ -125,12 +153,32 @@ class Informer:
             if new_key is not None:
                 buckets.setdefault(new_key, {})[key] = new
 
+    @staticmethod
+    def _rv_newer(obj: Any, than: Any) -> bool:
+        """True when ``obj`` is a strictly newer revision than ``than``.
+        Non-integer resource versions (mirrored external apiservers) can't
+        be ordered, so any difference counts as newer."""
+        a, b = obj.metadata.resource_version, than.metadata.resource_version
+        if isinstance(a, int) and isinstance(b, int):
+            return a > b
+        return a != b
+
     def _on_event(self, event: WatchEvent) -> None:
         obj = event.obj
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
         with self._lock:
             handlers = list(self._handlers)
             old = self._last_seen.get(key)
+            if (event.type != DELETED and old is not None
+                    and isinstance(obj.metadata.resource_version, int)
+                    and isinstance(old.metadata.resource_version, int)
+                    and obj.metadata.resource_version
+                    < old.metadata.resource_version):
+                # Stale replay: an event committed before a relist already
+                # brought the cache past it (per-object rv order, like the
+                # reference informer's resourceVersion dedup).  Applying it
+                # would regress the cache and indices.
+                return
             if event.type == DELETED:
                 self._last_seen.pop(key, None)
                 self._reindex(key, old if old is not None else obj, None)
@@ -144,6 +192,64 @@ class Informer:
                 h["update"](old if old is not None else obj, obj)
             elif event.type == DELETED and h["delete"]:
                 h["delete"](obj)
+
+    def _on_stream_error(self, err: BaseException) -> None:
+        """The watch stream died.  Reconnect FIRST (so every commit after the
+        relist snapshot reaches the new stream), then close the gap with a
+        relist -- the same reconnect-then-list order the reference reflector
+        uses to guarantee no delta is lost between the two."""
+        log.warning("%s watch stream dropped (%s); reconnecting + relisting",
+                    self._kind, err)
+        try:
+            self._unsub()
+        except Exception as exc:  # the dead stream may already be detached
+            log.debug("%s stale unsubscribe failed: %s", self._kind, exc)
+        self._unsub = self._subscribe()
+        self.relist()
+
+    def relist(self) -> None:
+        """Gap-detecting relist: quorum-list the kind and synthesize the
+        ADDED/MODIFIED/DELETED deltas the cache missed.
+
+        Runs under the tracker's dispatch lock (when it has one) so no watch
+        event can interleave with the diff: the cache is frozen while we
+        compare it against the listed state.  Events already committed but
+        not yet drained will be delivered *after* us -- as stale replays
+        (rv <= listed rv) they are dropped by ``_on_event``'s rv guard, so
+        the cache never regresses.
+
+        rv0 (the tracker's latest rv, read before listing) guards deletes:
+        a cached entry absent from the list is only deleted if its rv <= rv0
+        -- an entry the cache learned of *after* the snapshot must not be
+        killed by an older list.
+        """
+        self.relists_total += 1
+        METRICS.inc("trainingjob_informer_relists_total", kind=self._kind)
+        rv_fn = getattr(self._tracker, "latest_resource_version", None)
+        dispatch_lock = getattr(self._tracker, "_dispatch_lock", None)
+        ctx = dispatch_lock if dispatch_lock is not None else contextlib.nullcontext()
+        with ctx:
+            rv0 = rv_fn() if rv_fn is not None else None
+            listed = {f"{o.metadata.namespace}/{o.metadata.name}": o
+                      for o in self._quorum_list()}
+            with self._lock:
+                cached = dict(self._last_seen)
+            deltas: List[WatchEvent] = []
+            for key, obj in listed.items():
+                old = cached.get(key)
+                if old is None:
+                    deltas.append(WatchEvent(ADDED, obj))
+                elif self._rv_newer(obj, old):
+                    deltas.append(WatchEvent(MODIFIED, obj))
+            for key, old in cached.items():
+                if key in listed:
+                    continue
+                rv = old.metadata.resource_version
+                if (rv0 is not None and isinstance(rv, int)) and rv > rv0:
+                    continue  # newer than the snapshot; not provably gone
+                deltas.append(WatchEvent(DELETED, old))
+            for ev in deltas:
+                self._on_event(ev)
 
     def resync(self) -> None:
         """Re-deliver every object as an update (reference: the informer

@@ -1,0 +1,185 @@
+"""Shared bounded-retry-with-jitter: the one retry idiom for API writes.
+
+Every transient-failure loop in the operator used to be hand-rolled
+(``publish_generation``'s three fixed pauses, ad-hoc conflict loops) -- N
+idioms with N bug surfaces, and none of them jittered, so a fleet of
+controllers recovering from the same apiserver brownout would retry in
+lockstep and re-create the brownout (the thundering herd TJA018's jitter
+advisory now warns about).  This module is the replacement:
+
+- :class:`RetryPolicy` -- bounded attempts, exponential backoff, a jitter
+  fraction that de-synchronizes concurrent retriers;
+- :func:`retry_call` -- run a callable under a policy with a
+  retryable-exception predicate; every retry is counted in
+  ``trainingjob_api_retries_total{verb}``;
+- :func:`retrying_clientset` -- a clientset view whose *write* verbs ride
+  :func:`retry_call`, transparently absorbing transient API faults
+  (``ApiUnavailableError`` / ``ApiTimeoutError`` -- the 5xx/deadline shapes
+  ``client/chaos.py`` injects).  ``ConflictError`` is deliberately NOT
+  retryable here: a conflict means the caller's read is stale, and blind
+  re-submission of the same stale object can never succeed -- the
+  re-read-and-merge loops in ``controller/status.py`` own that case.
+
+Sleeping here is fine: the proxy wraps *API round trips*, which already
+block the calling worker for the round trip itself (fleet harness
+``api_latency``); the backoff budget is bounded and small (sub-second at
+the default policy), the same order as one API round trip under load.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.utils.metrics import METRICS
+
+log = logging.getLogger("trainingjob.retry")
+
+
+class ApiUnavailableError(RuntimeError):
+    """Transient 5xx-style failure: the server never processed the request.
+    Always safe to retry."""
+
+
+class ApiTimeoutError(TimeoutError):
+    """The request deadline elapsed before the server answered.  The chaos
+    plane injects these request-not-delivered (docs/CHAOS.md fault
+    taxonomy), so retrying is safe here too."""
+
+
+#: Exception types every write verb may safely retry.
+TRANSIENT_ERRORS = (ApiUnavailableError, ApiTimeoutError)
+
+
+def is_transient(err: BaseException) -> bool:
+    """Default retryable predicate: transient API faults only."""
+    return isinstance(err, TRANSIENT_ERRORS)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded attempts with exponential backoff and jitter.
+
+    ``jitter`` is the +/- fraction applied to each pause: pause =
+    ``base_delay * 2**retry * uniform(1-jitter, 1+jitter)``, capped at
+    ``max_delay``.  Frozen so one policy instance can be shared across
+    every typed client without aliasing surprises.
+    """
+
+    attempts: int = 5
+    base_delay: float = 0.02
+    max_delay: float = 1.0
+    jitter: float = 0.5
+
+    def pause(self, retry: int, rng: Optional[random.Random] = None) -> float:
+        spread = (rng.uniform if rng is not None else random.uniform)(
+            1.0 - self.jitter, 1.0 + self.jitter)
+        return min(self.base_delay * (2 ** retry), self.max_delay) * spread
+
+
+def default_policy() -> RetryPolicy:
+    """Policy for controller API writes; attempt count is operator-tunable
+    via ``TRAININGJOB_API_RETRIES`` (bounded to something sane)."""
+    try:
+        attempts = int(os.environ.get(constants.API_RETRIES_ENV, "") or 5)
+    except ValueError:
+        attempts = 5
+    return RetryPolicy(attempts=max(1, min(attempts, 16)))
+
+
+def backoff_pause(policy: RetryPolicy, retry: int,
+                  rng: Optional[random.Random] = None) -> None:
+    """Sleep the policy's jittered pause for the ``retry``-th failure.  The
+    name is load-bearing: TJA018 recognizes ``*backoff*`` callees as pacing,
+    so loops built on this helper are provably not hot loops."""
+    time.sleep(policy.pause(retry, rng))
+
+
+def retry_call(fn: Callable[..., Any], *args: Any,
+               policy: Optional[RetryPolicy] = None,
+               retryable: Callable[[BaseException], bool] = is_transient,
+               verb: str = "call",
+               rng: Optional[random.Random] = None,
+               **kwargs: Any) -> Any:
+    """Run ``fn(*args, **kwargs)`` under ``policy``.
+
+    Retries only exceptions ``retryable`` approves; the final attempt's
+    exception propagates unwrapped so callers keep their existing handlers.
+    Each retry increments ``trainingjob_api_retries_total{verb}``.
+    """
+    pol = policy if policy is not None else default_policy()
+    for attempt in range(pol.attempts):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as err:
+            if attempt >= pol.attempts - 1 or not retryable(err):
+                raise
+            METRICS.inc("trainingjob_api_retries_total", verb=verb)
+            log.debug("retrying %s after %s (attempt %d/%d)",
+                      verb, type(err).__name__, attempt + 1, pol.attempts)
+            backoff_pause(pol, attempt, rng)
+    raise AssertionError("unreachable: attempts >= 1")
+
+
+class _RetryingClient:
+    """Typed-client proxy whose write verbs ride :func:`retry_call`.  Reads
+    pass through untouched (they come from informer caches / the local
+    store and transient write faults do not apply)."""
+
+    def __init__(self, inner: Any, policy: RetryPolicy):
+        self._inner = inner
+        self._policy = policy
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def create(self, obj: Any) -> Any:
+        return retry_call(self._inner.create, obj,
+                          policy=self._policy, verb="create")
+
+    def update(self, obj: Any) -> Any:
+        return retry_call(self._inner.update, obj,
+                          policy=self._policy, verb="update")
+
+    def update_status(self, obj: Any) -> Any:
+        return retry_call(self._inner.update_status, obj,
+                          policy=self._policy, verb="update_status")
+
+    def delete(self, namespace: str, name: str,
+               grace_period: Optional[int] = None) -> Any:
+        return retry_call(self._inner.delete, namespace, name, grace_period,
+                          policy=self._policy, verb="delete")
+
+
+class RetryingClientset:
+    """Clientset view wrapping the *given* typed clients (never rebuilt from
+    the tracker: an injected latency/chaos layer on those clients must stay
+    in the path).  Nodes stay unwrapped -- the controller never writes
+    them."""
+
+    def __init__(self, inner: Any, policy: RetryPolicy):
+        self._inner = inner
+        self.tracker = inner.tracker
+        self.trainingjobs = _RetryingClient(inner.trainingjobs, policy)
+        self.pods = _RetryingClient(inner.pods, policy)
+        self.services = _RetryingClient(inner.services, policy)
+        self.events = _RetryingClient(inner.events, policy)
+        self.nodes = inner.nodes
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def retrying_clientset(cs: Any,
+                       policy: Optional[RetryPolicy] = None) -> Any:
+    """Wrap ``cs``'s write verbs in bounded-retry-with-jitter.  A policy of
+    one attempt returns ``cs`` unchanged (retry disabled)."""
+    pol = policy if policy is not None else default_policy()
+    if pol.attempts <= 1:
+        return cs
+    return RetryingClientset(cs, pol)

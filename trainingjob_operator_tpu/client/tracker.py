@@ -100,7 +100,14 @@ class ObjectTracker:
 
     # -- watch ---------------------------------------------------------------
 
-    def watch(self, kind: str, handler: Callable[[WatchEvent], None]) -> Callable[[], None]:
+    def watch(self, kind: str, handler: Callable[[WatchEvent], None],
+              on_error: Optional[Callable[[BaseException], None]] = None,
+              ) -> Callable[[], None]:
+        # ``on_error`` is part of the watch contract (stream closed/errored;
+        # the subscriber must reconnect + relist).  The in-process tracker
+        # never drops a stream, so it is accepted and unused here; fault
+        # injection (client/chaos.py ChaosTracker) is what fires it.
+        del on_error
         with self._lock:
             self._watchers.setdefault(kind, []).append(handler)
 
@@ -285,6 +292,13 @@ class ObjectTracker:
             self.mirror_delete(kind, ns, name)
 
     # -- introspection -------------------------------------------------------
+
+    def latest_resource_version(self) -> int:
+        """The tracker's current global resource version.  Informers snapshot
+        this before a relist so the diff can tell 'deleted during the gap'
+        apart from 'created after my list returned'."""
+        with self._lock:
+            return self._rv
 
     def count(self, kind: str) -> int:
         with self._lock:

@@ -22,6 +22,12 @@ Semantics preserved from client-go:
 - ``shut_down`` cancels all pending delayed deliveries (the single pump
   thread exits and the waiting heap is cleared) -- a fleet-scale run that
   armed thousands of delayed re-syncs leaks nothing on teardown.
+- Optional per-key failure **quarantine**: a key failing
+  ``quarantine_after`` consecutive syncs is parked for a flat
+  ``quarantine_delay`` instead of riding the exponential ladder further --
+  a poisoned key (bad spec, wedged dependency) stops consuming worker
+  slots at the retry cadence, and ``forget`` (one success) releases it.
+  Off by default (``quarantine_after=0``); the controller turns it on.
 
 Scale counters (read by the controller's metrics gauges and bench.py):
 ``retries_total`` (rate-limited requeues), ``depth_high_water`` (max ready
@@ -40,10 +46,14 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 class RateLimitingQueue:
     def __init__(self, name: str = "queue",
-                 base_delay: float = 0.005, max_delay: float = 1000.0):
+                 base_delay: float = 0.005, max_delay: float = 1000.0,
+                 quarantine_after: int = 0, quarantine_delay: float = 30.0):
         self.name = name
         self._base_delay = base_delay
         self._max_delay = max_delay
+        self._quarantine_after = quarantine_after
+        self._quarantine_delay = quarantine_delay
+        self._quarantined: Set[Any] = set()
         self._cond = threading.Condition()
         self._queue: Deque[Any] = collections.deque()  # FIFO of ready items
         self._queued: Set[Any] = set()        # items in _queue
@@ -64,6 +74,7 @@ class RateLimitingQueue:
         self.retries_total = 0
         self.coalesced_total = 0
         self.depth_high_water = 0
+        self.quarantined_total = 0
         self._pump = threading.Thread(target=self._pump_waiting, daemon=True,
                                       name=f"workqueue-{name}-delay")
         self._pump.start()
@@ -113,21 +124,44 @@ class RateLimitingQueue:
             heapq.heappush(self._waiting, (deadline, self._waiting_seq, item))
             self._cond.notify_all()
 
-    def add_rate_limited(self, item: Any) -> None:
+    def add_rate_limited(self, item: Any) -> bool:
+        """Requeue after per-item backoff.  Returns True when this failure
+        pushed the item INTO quarantine (the transition, not the steady
+        state) so the caller can record/alert exactly once per episode."""
+        entered = False
         with self._cond:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
             self.retries_total += 1
-        delay = min(self._base_delay * (2 ** failures), self._max_delay)
+            if (self._quarantine_after > 0
+                    and failures + 1 >= self._quarantine_after):
+                if item not in self._quarantined:
+                    self._quarantined.add(item)
+                    self.quarantined_total += 1
+                    entered = True
+                delay = self._quarantine_delay
+            else:
+                delay = min(self._base_delay * (2 ** failures), self._max_delay)
         self.add_after(item, delay)
+        return entered
 
     def forget(self, item: Any) -> None:
         with self._cond:
             self._failures.pop(item, None)
+            self._quarantined.discard(item)
 
     def num_requeues(self, item: Any) -> int:
         with self._cond:
             return self._failures.get(item, 0)
+
+    def num_quarantined(self) -> int:
+        """Keys currently parked in quarantine (gauge source)."""
+        with self._cond:
+            return len(self._quarantined)
+
+    def is_quarantined(self, item: Any) -> bool:
+        with self._cond:
+            return item in self._quarantined
 
     # -- consume -------------------------------------------------------------
 

@@ -8,8 +8,25 @@ lease 15s / renew 5s / retry 3s).  Time fields are seconds (floats).
 from __future__ import annotations
 
 import argparse
+import os
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+from trainingjob_operator_tpu.api import constants
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 @dataclass
@@ -49,6 +66,14 @@ class OperatorOptions:
     # the first re-expand probe (doubles per failed probe, capped at 15 min).
     scale_pending_time: float = 30.0
     scale_up_delay: float = 30.0
+    # Sync-loop failure quarantine (workqueue): a key failing this many
+    # consecutive reconciles parks for quarantine_delay seconds instead of
+    # hot-looping the exponential ladder; 0 disables.  Env-overridable so a
+    # wedged production fleet can be tuned without a rollout.
+    quarantine_after: int = field(default_factory=lambda: _env_int(
+        constants.QUARANTINE_AFTER_ENV, 8))
+    quarantine_delay: float = field(default_factory=lambda: _env_float(
+        constants.QUARANTINE_DELAY_ENV, 30.0))
 
     @classmethod
     def add_flags(cls, parser: argparse.ArgumentParser) -> None:
@@ -91,6 +116,14 @@ class OperatorOptions:
         parser.add_argument("--scale-up-delay", type=float, default=30.0,
                             help="Delay before a degraded elastic group probes "
                                  "a re-expand, seconds (exponential backoff).")
+        parser.add_argument("--quarantine-after", type=int,
+                            default=_env_int(constants.QUARANTINE_AFTER_ENV, 8),
+                            help="Consecutive failed syncs before a key is "
+                                 "quarantined (0 disables).")
+        parser.add_argument("--quarantine-delay", type=float,
+                            default=_env_float(constants.QUARANTINE_DELAY_ENV, 30.0),
+                            help="Seconds a quarantined key parks between "
+                                 "retry attempts.")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "OperatorOptions":
@@ -109,6 +142,8 @@ class OperatorOptions:
             backend=args.backend,
             scale_pending_time=args.scale_pending_time,
             scale_up_delay=args.scale_up_delay,
+            quarantine_after=args.quarantine_after,
+            quarantine_delay=args.quarantine_delay,
         )
         opt.leader_election.leader_elect = args.leader_elect
         opt.leader_election.lock_path = args.leader_lock

@@ -31,6 +31,7 @@ from trainingjob_operator_tpu.client.expectations import (
     services_key,
 )
 from trainingjob_operator_tpu.client.informers import InformerFactory
+from trainingjob_operator_tpu.client.retry import retrying_clientset
 from trainingjob_operator_tpu.client.tracker import (
     meta_namespace_key,
     split_meta_namespace_key,
@@ -96,14 +97,21 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
     def __init__(self, clientset: Clientset,
                  informer_factory: Optional[InformerFactory] = None,
                  options: Optional[OperatorOptions] = None):
-        self.clientset = clientset
+        # All controller writes ride the shared bounded-retry-with-jitter
+        # policy (client/retry.py): transient API faults (5xx, timeouts) are
+        # absorbed at the clientset boundary instead of failing a whole sync
+        # and re-running it through the workqueue ladder.
+        self.clientset = retrying_clientset(clientset)
         self.options = options or OperatorOptions()
         self.informer_factory = informer_factory or InformerFactory(clientset.tracker)
-        self.recorder = EventRecorder(clientset, constants.CONTROLLER_NAME)
-        self.pod_control = PodControl(clientset, self.recorder)
-        self.service_control = ServiceControl(clientset, self.recorder)
+        self.recorder = EventRecorder(self.clientset, constants.CONTROLLER_NAME)
+        self.pod_control = PodControl(self.clientset, self.recorder)
+        self.service_control = ServiceControl(self.clientset, self.recorder)
         self.expectations = ControllerExpectations()
-        self.work_queue = RateLimitingQueue(constants.KIND)
+        self.work_queue = RateLimitingQueue(
+            constants.KIND,
+            quarantine_after=self.options.quarantine_after,
+            quarantine_delay=self.options.quarantine_delay)
 
         job_informer = self.informer_factory.informer(constants.KIND)
         pod_informer = self.informer_factory.informer(Pod.KIND)
@@ -230,6 +238,8 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # deepcopies per scrape).
         self.metrics.gauge("trainingjob_jobs",
                            lambda: float(len(self._job_keys)))
+        self.metrics.gauge("trainingjob_quarantined_keys",
+                           lambda: float(self.work_queue.num_quarantined()))
         # Telemetry watchdog findings (StepStalled/StepResumed) become job
         # events and a reconcile kick so the Running message refreshes.
         TELEMETRY.set_event_sink(self._telemetry_event)
@@ -266,6 +276,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.metrics.remove_gauge("trainingjob_workqueue_depth")
         self.metrics.remove_gauge("trainingjob_workqueue_depth_high_water")
         self.metrics.remove_gauge("trainingjob_jobs")
+        self.metrics.remove_gauge("trainingjob_quarantined_keys")
         TELEMETRY.set_event_sink(None)
         INCIDENTS.set_event_sink(None)
         self.recorder.set_sink(None)
@@ -348,11 +359,13 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
             if forget:
                 self.work_queue.forget(item)
             else:
-                self.work_queue.add_rate_limited(item)
+                if self.work_queue.add_rate_limited(item):
+                    self._note_quarantined(item)
                 self.metrics.inc("trainingjob_workqueue_retries_total")
         except Exception:
             log.exception("sync %r failed", item)
-            self.work_queue.add_rate_limited(item)
+            if self.work_queue.add_rate_limited(item):
+                self._note_quarantined(item)
             self.metrics.inc("trainingjob_workqueue_retries_total")
         finally:
             self.work_queue.done(item)
@@ -362,6 +375,25 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                 (queue_wait + time.monotonic() - started) * 1000.0,
                 buckets=LATENCY_MS_BUCKETS)
         return True
+
+    def _note_quarantined(self, key: str) -> None:
+        """A key just crossed the quarantine threshold: surface it once per
+        episode (the workqueue reports only the transition) so a poisoned
+        job is visible on the job's event stream, not just in logs."""
+        log.warning("sync %r failed %d consecutive times; quarantined for %.0fs",
+                    key, self.work_queue.num_requeues(key),
+                    self.options.quarantine_delay)
+        try:
+            namespace, name = split_meta_namespace_key(key)
+        except ValueError:
+            return  # unkeyable item: the log line above is all we can say
+        job = self.trainingjob_lister.try_get(namespace, name)
+        if job is not None:
+            self.recorder.event(
+                job, EventRecorder.WARNING, constants.SYNC_QUARANTINED_REASON,
+                f"sync failed {self.work_queue.num_requeues(key)} consecutive "
+                f"times; retrying every {self.options.quarantine_delay:.0f}s "
+                "until one succeeds")
 
     # -- sync (reference: syncHandler, controller.go:270-312) ----------------
 

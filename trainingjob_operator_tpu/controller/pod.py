@@ -28,6 +28,7 @@ from trainingjob_operator_tpu.api.types import (
     TPUTrainingJob,
 )
 from trainingjob_operator_tpu.client.expectations import pods_key
+from trainingjob_operator_tpu.client.retry import RetryPolicy, retry_call
 from trainingjob_operator_tpu.client.tracker import meta_namespace_key
 from trainingjob_operator_tpu.controller.naming import (
     effective_replicas,
@@ -58,6 +59,16 @@ from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
 
 log = logging.getLogger("trainingjob.pod")
+
+
+def _write_generation_doc(base: str, doc: Dict[str, Any]) -> None:
+    """Atomic write of the rendezvous generation doc (tmp + rename); the
+    unit publish_generation's bounded retry wraps."""
+    os.makedirs(base, exist_ok=True)
+    tmp = os.path.join(base, ".generation.tmp")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, os.path.join(base, "generation.json"))
 
 
 def _env_float(name: str, default: float) -> float:
@@ -852,39 +863,29 @@ class PodReconciler:
             "coordinator": f"{instances[0]}:{coord_port}" if instances else "",
         }
         base = resize_dir(job)
-        # Bounded retry: survivors poll this file from the step loop, so a
-        # swallowed write failure leaves them waiting on a generation that
-        # never arrives.  Three attempts with short backoff ride out a
-        # transient filer hiccup without stalling the reconcile worker; on
-        # exhaustion the failure becomes a visible job event
-        # (ResizePublishFailed) instead of a log line nobody watches.
-        last_err = ""
-        for attempt, pause in enumerate((0.05, 0.2, None)):
-            try:
-                os.makedirs(base, exist_ok=True)
-                tmp = os.path.join(base, ".generation.tmp")
-                with open(tmp, "w", encoding="utf-8") as fh:
-                    json.dump(doc, fh)
-                os.replace(tmp, os.path.join(base, "generation.json"))
-                return doc
-            except OSError as err:
-                last_err = f"{type(err).__name__}: {err}"
-                log.warning(
-                    "failed to publish generation for %s/%s under %s "
-                    "(attempt %d)", job.namespace, job.name, base,
-                    attempt + 1, exc_info=True)
-                if pause is not None:
-                    # analyzer: allow[reconcile-purity]: bounded 0.25 s
-                    # worst case, only while the resize dir is failing --
-                    # re-enqueueing would delay the doc a whole resync
-                    # while survivors spin at the old generation.
-                    time.sleep(pause)
-        self.recorder.event(
-            job, EventRecorder.WARNING, constants.RESIZE_PUBLISH_FAILED_REASON,
-            f"failed to publish rendezvous generation "
-            f"{job.status.rendezvous_generation} under {base} after 3 "
-            f"attempts ({last_err}); survivors cannot re-rendezvous until "
-            "the next reconcile republish")
+        # Bounded retry via the shared policy (client/retry.py): survivors
+        # poll this file from the step loop, so a swallowed write failure
+        # leaves them waiting on a generation that never arrives.  Three
+        # jittered attempts ride out a transient filer hiccup without
+        # stalling the reconcile worker; on exhaustion the failure becomes a
+        # visible job event (ResizePublishFailed) instead of a log line
+        # nobody watches.
+        try:
+            retry_call(
+                _write_generation_doc, base, doc,
+                policy=RetryPolicy(attempts=3, base_delay=0.05, max_delay=0.2),
+                retryable=lambda err: isinstance(err, OSError),
+                verb="publish_generation")
+        except OSError as err:
+            log.warning("failed to publish generation for %s/%s under %s",
+                        job.namespace, job.name, base, exc_info=True)
+            self.recorder.event(
+                job, EventRecorder.WARNING,
+                constants.RESIZE_PUBLISH_FAILED_REASON,
+                f"failed to publish rendezvous generation "
+                f"{job.status.rendezvous_generation} under {base} after 3 "
+                f"attempts ({type(err).__name__}: {err}); survivors cannot "
+                "re-rendezvous until the next reconcile republish")
         return doc
 
     # -- container inspection (reference: pod.go:328-437) --------------------

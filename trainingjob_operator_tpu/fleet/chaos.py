@@ -1,0 +1,180 @@
+"""Seeded chaos-schedule generation for the control-plane chaos plane.
+
+The churn plane (``churn.py``) injects *workload*-shaped faults -- pods
+die, jobs are preempted or deleted.  This module is its control-plane
+twin: a ``ChaosProfile`` statistically describes how the *apiserver*
+misbehaves (per-verb error rates, latency brownouts, watch-stream drops,
+stale list reads), and ``ChaosGenerator`` expands it into a concrete
+``ChaosPlan`` the same way ``ChurnGenerator`` expands a churn profile:
+all randomness flows through one ``random.Random(seed)``, so the same
+(profile, seed) pair reproduces the exact fault sequence byte-for-byte.
+``ChaosPlan.digest()`` pins that property in `make chaos-smoke`.
+
+Determinism shape: per-verb faults are *precomputed decision streams* --
+decision ``i`` of the "update" stream applies to the ``i``-th update call,
+whenever it happens to arrive.  That makes the fault sequence a pure
+function of the seed and the call *order*, independent of wall-clock
+timing, which is as deterministic as an injected-fault plane can be under
+a threaded controller.  Time-shaped faults (latency windows, watch drops)
+are scheduled on the run clock instead, like churn disruptions.
+
+The *injection mechanics* (proxies that consume this plan) live in
+``client/chaos.py``; this module is pure planning and is import-cheap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from trainingjob_operator_tpu.api import constants
+
+#: Verbs that get an independent fault-decision stream.  ``update`` and
+#: ``update_status`` are the conflict-prone verbs; creates/deletes only
+#: draw unavailable/timeout faults.
+CHAOS_VERBS = ("create", "update", "update_status", "delete")
+
+#: Fault kinds a per-verb decision can carry (besides "ok").
+FAULT_UNAVAILABLE = "unavailable"   # 5xx-style ApiUnavailableError
+FAULT_TIMEOUT = "timeout"           # deadline elapses, request not applied
+FAULT_CONFLICT = "conflict"         # optimistic-concurrency conflict storm
+
+#: Kinds whose watch streams can be dropped (the tracker keys watches by
+#: object kind, so these are KIND strings, not resource names).
+WATCHED_KINDS = (constants.KIND, "Pod", "Service")
+
+
+@dataclass(frozen=True)
+class ChaosProfile:
+    """Statistical description of control-plane misbehavior.  Frozen so a
+    profile can be shared between a run and its replay."""
+
+    seed: int = 0
+    #: Seconds over which time-shaped faults (spikes, drops) are placed;
+    #: match the churn profile's duration plus convergence slack.
+    duration: float = 6.0
+    #: Per-call probability of a transient 5xx on any write verb.
+    error_rate: float = 0.02
+    #: Per-call probability of a timeout on any write verb.
+    timeout_rate: float = 0.01
+    #: Extra per-call conflict probability on update/update_status.
+    conflict_rate: float = 0.03
+    #: Length of each verb's precomputed decision stream.  Calls beyond
+    #: the stream succeed (the chaos window is over).
+    decisions_per_verb: int = 20000
+    #: Simulated server latency added to each timed-out call, seconds.
+    timeout_hold: float = 0.05
+    #: Count of latency brownout windows spread over ``duration``.
+    latency_spikes: int = 3
+    #: Per-call added latency inside a spike window, drawn uniformly.
+    spike_delay: Tuple[float, float] = (0.01, 0.05)
+    #: Width of each spike window, drawn uniformly.
+    spike_duration: Tuple[float, float] = (0.2, 0.6)
+    #: Watch-stream drops spread over ``duration`` (round-robin across
+    #: WATCHED_KINDS so every informer takes at least one hit).
+    watch_drops: int = 3
+    #: Resumption gap after a drop before informers may reconnect --
+    #: deltas committed inside the gap are exactly what the relist must
+    #: recover.
+    drop_gap: Tuple[float, float] = (0.05, 0.25)
+    #: Per-call probability that a plain list() returns the previous
+    #: (stale) snapshot for that kind, modeling a lagging follower read.
+    stale_rate: float = 0.10
+    #: Length of the stale-list decision stream.
+    stale_decisions: int = 2000
+
+
+@dataclass(frozen=True)
+class LatencySpike:
+    start: float      # seconds from chaos attach
+    end: float
+    delay: float      # seconds added to each call inside the window
+
+
+@dataclass(frozen=True)
+class WatchDrop:
+    at: float         # seconds from chaos attach
+    gap: float        # seconds the stream stays down
+    kind: str         # which WATCHED_KINDS stream dies
+
+
+@dataclass(frozen=True)
+class ChaosPlan:
+    """A fully expanded, deterministic fault schedule."""
+
+    profile: ChaosProfile
+    #: verb -> tuple of decisions, each "ok" | FAULT_* .
+    decisions: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    spikes: Tuple[LatencySpike, ...] = ()
+    drops: Tuple[WatchDrop, ...] = ()
+    #: Decision stream for stale list reads (True = serve stale).
+    stale: Tuple[bool, ...] = ()
+
+    def canonical(self) -> str:
+        """Canonical JSON of the full fault schedule (profile included):
+        two plans are the same fault sequence iff their canonicals match."""
+        doc = {
+            "profile": {k: getattr(self.profile, k)
+                        for k in sorted(self.profile.__dataclass_fields__)},
+            "decisions": {v: list(d) for v, d in sorted(self.decisions.items())},
+            "spikes": [[s.start, s.end, s.delay] for s in self.spikes],
+            "drops": [[d.at, d.gap, d.kind] for d in self.drops],
+            "stale": [int(b) for b in self.stale],
+        }
+        return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.canonical().encode()).hexdigest()
+
+
+class ChaosGenerator:
+    """Expands a :class:`ChaosProfile` into a deterministic ``ChaosPlan``."""
+
+    def __init__(self, profile: ChaosProfile):
+        self.profile = profile
+
+    def plan(self) -> ChaosPlan:
+        p = self.profile
+        rng = random.Random(p.seed)
+
+        decisions: Dict[str, Tuple[str, ...]] = {}
+        for verb in CHAOS_VERBS:
+            conflicty = verb in ("update", "update_status")
+            stream: List[str] = []
+            for _ in range(p.decisions_per_verb):
+                roll = rng.random()
+                if roll < p.error_rate:
+                    stream.append(FAULT_UNAVAILABLE)
+                elif roll < p.error_rate + p.timeout_rate:
+                    stream.append(FAULT_TIMEOUT)
+                elif conflicty and roll < (p.error_rate + p.timeout_rate
+                                           + p.conflict_rate):
+                    stream.append(FAULT_CONFLICT)
+                else:
+                    stream.append("ok")
+            decisions[verb] = tuple(stream)
+
+        spikes = tuple(sorted(
+            (LatencySpike(
+                start=(start := rng.uniform(0.0, p.duration)),
+                end=start + rng.uniform(*p.spike_duration),
+                delay=rng.uniform(*p.spike_delay),
+            ) for _ in range(p.latency_spikes)),
+            key=lambda s: s.start))
+
+        drops = tuple(sorted(
+            (WatchDrop(
+                at=rng.uniform(0.0, p.duration),
+                gap=rng.uniform(*p.drop_gap),
+                kind=WATCHED_KINDS[i % len(WATCHED_KINDS)],
+            ) for i in range(p.watch_drops)),
+            key=lambda d: d.at))
+
+        stale = tuple(rng.random() < p.stale_rate
+                      for _ in range(p.stale_decisions))
+
+        return ChaosPlan(profile=p, decisions=decisions,
+                         spikes=spikes, drops=drops, stale=stale)

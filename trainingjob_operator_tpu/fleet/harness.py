@@ -52,7 +52,13 @@ from trainingjob_operator_tpu.api.types import (
     TPUTrainingJob,
     TrainingJobPhase,
 )
+from trainingjob_operator_tpu.client.chaos import (
+    ChaosMonkey,
+    ChaosTracker,
+    chaos_clientset,
+)
 from trainingjob_operator_tpu.client.clientset import Clientset
+from trainingjob_operator_tpu.client.informers import InformerFactory
 from trainingjob_operator_tpu.client.tracker import (
     ADDED,
     DELETED,
@@ -74,6 +80,10 @@ from trainingjob_operator_tpu.core.objects import (
     PodPhase,
     PodSpec,
     PodTemplateSpec,
+)
+from trainingjob_operator_tpu.fleet.chaos import (
+    ChaosGenerator,
+    ChaosProfile,
 )
 from trainingjob_operator_tpu.fleet.churn import (
     FATE_COMPLETE,
@@ -289,7 +299,15 @@ class FleetReport:
     downtime_phases: Dict[str, Any] = field(default_factory=dict)
     #: Downtime ms the flight recorder could NOT attribute to a named phase
     #: (``unknown`` residue).  The harness files a violation when nonzero.
+    #: ``unknown`` time inside a declared chaos window is attributed to the
+    #: fault plane first (docs/CHAOS.md) and does not count here.
     unattributed_downtime_ms: float = 0.0
+    #: Controller write retries absorbed by client/retry.py during this run
+    #: (sum of trainingjob_api_retries_total across verbs).
+    api_retries_total: int = 0
+    #: Chaos summary when a chaos profile ran: seed, plan digest, injected
+    #: fault counts by kind, informer relists.  None on a clean run.
+    chaos: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -315,6 +333,8 @@ class FleetReport:
             "downtime_phases": self.downtime_phases,
             "unattributed_downtime_ms": round(self.unattributed_downtime_ms,
                                               3),
+            "api_retries_total": self.api_retries_total,
+            "chaos": self.chaos,
         }
 
 
@@ -353,6 +373,7 @@ class FleetHarness:
                  converge_timeout: float = 60.0, with_ports: bool = False,
                  sim_tick: float = 0.02, sim_kernel: Optional[str] = None,
                  max_wall_seconds: float = 0.0,
+                 chaos_profile: Optional[ChaosProfile] = None,
                  progress: Optional[Callable[[str], None]] = None):
         self.profile = profile
         self.workers = workers
@@ -374,6 +395,10 @@ class FleetHarness:
         # violation (CI's regression tripwire for the event kernel -- see
         # `make fleet-smoke`).
         self.max_wall_seconds = max_wall_seconds
+        # Seeded control-plane fault plan (docs/CHAOS.md): when set, the
+        # controller's API view and watch streams ride the chaos plane while
+        # the sim and the driver keep the clean view.
+        self.chaos_profile = chaos_profile
         self._progress = progress or (lambda _msg: None)
         self.violations: List[str] = []
 
@@ -385,12 +410,28 @@ class FleetHarness:
 
         cs = Clientset()
         cs_ctl = latency_clientset(cs, self.api_latency)
-        tc = TrainingJobController(cs_ctl, options=OperatorOptions(
-            resync_period=self.resync_period,
-            resync_shards=self.resync_shards,
-            gc_interval=self.gc_interval,
-            thread_num=self.workers,
-        ))
+        monkey: Optional[ChaosMonkey] = None
+        chaos_plan = None
+        informer_factory: Optional[InformerFactory] = None
+        if self.chaos_profile is not None:
+            chaos_plan = ChaosGenerator(self.chaos_profile).plan()
+            monkey = ChaosMonkey(chaos_plan)
+            # The controller's writes go through the chaos plane stacked on
+            # the latency view; its informers watch a ChaosTracker so stream
+            # drops and stale lists hit the cache path too.  The sim and the
+            # driver keep the clean clientset -- only the control plane is
+            # under test.
+            cs_ctl = chaos_clientset(cs_ctl, monkey)
+            informer_factory = InformerFactory(
+                ChaosTracker(cs.tracker, monkey))
+        tc = TrainingJobController(
+            cs_ctl, informer_factory=informer_factory,
+            options=OperatorOptions(
+                resync_period=self.resync_period,
+                resync_shards=self.resync_shards,
+                gc_interval=self.gc_interval,
+                thread_num=self.workers,
+            ))
         sim = SimRuntime(cs, tick=self.sim_tick,
                          pods_per_node=self.pods_per_node,
                          kernel=self.sim_kernel)
@@ -399,8 +440,19 @@ class FleetHarness:
         recorder = _LatencyRecorder(cs)
 
         sync_count_before = self._sync_count()
+        retries_before = self._counter_sum("trainingjob_api_retries_total")
+        relists_before = self._counter_sum(
+            "trainingjob_informer_relists_total")
         sim.start()
         tc.run(workers=self.workers)
+        if monkey is not None:
+            # Arm the time-shaped faults only once the controller is live so
+            # spike/drop offsets line up with the churn schedule's clock, and
+            # register the windows with the flight recorder for attribution.
+            INCIDENTS.clear_chaos_windows()
+            monkey.attach()
+            for w_kind, w_start, w_end in monkey.windows_abs():
+                INCIDENTS.record_chaos_window(w_kind, w_start, w_end)
         started = time.monotonic()
         downtime_phases: Dict[str, Any] = {}
         unattributed = 0.0
@@ -416,6 +468,8 @@ class FleetHarness:
             tc.stop()
             sim.stop()
             recorder.close()
+            if monkey is not None:
+                monkey.close()
         if unattributed > 0.0:
             self.violations.append(
                 f"incident recorder left {unattributed:.1f} ms of downtime "
@@ -427,6 +481,19 @@ class FleetHarness:
                 f"{self.sim_kernel!r} regressed?)")
 
         sync_count = self._sync_count() - sync_count_before
+        api_retries = int(self._counter_sum("trainingjob_api_retries_total")
+                          - retries_before)
+        chaos_report: Optional[Dict[str, Any]] = None
+        if monkey is not None and chaos_plan is not None:
+            chaos_report = {
+                "seed": self.chaos_profile.seed,
+                "plan_digest": chaos_plan.digest(),
+                "faults": {k: int(v)
+                           for k, v in sorted(monkey.faults.items())},
+                "informer_relists": int(
+                    self._counter_sum("trainingjob_informer_relists_total")
+                    - relists_before),
+            }
         phase_counts = self._phase_counts(cs)
         return FleetReport(
             jobs=len(plans),
@@ -450,6 +517,8 @@ class FleetHarness:
             phase_counts=phase_counts,
             downtime_phases=downtime_phases,
             unattributed_downtime_ms=unattributed,
+            api_retries_total=api_retries,
+            chaos=chaos_report,
         )
 
     @staticmethod
@@ -469,7 +538,14 @@ class FleetHarness:
                 counts[plan.fate] = counts.get(plan.fate, 0) + 1
                 for phase, ms in bundle["phases"].items():
                     phases.setdefault(phase, []).append(ms)
-                unattributed += bundle["phases"].get("unknown", 0.0)
+                # ``unknown`` residue overlapping a declared chaos window is
+                # attributed to the fault plane, not left dangling: the ring
+                # went dark because the apiserver (by design) did.
+                residue = bundle["phases"].get("unknown", 0.0)
+                if residue > 0.0:
+                    residue = max(0.0, residue
+                                  - bundle.get("chaos_overlap_ms", 0.0))
+                unattributed += residue
 
         def pct(values: List[float], q: float) -> float:
             ordered = sorted(values)
@@ -492,6 +568,13 @@ class FleetHarness:
     def _sync_count() -> int:
         return int(METRICS.snapshot().get(
             "trainingjob_reconcile_latency_ms_count", 0))
+
+    @staticmethod
+    def _counter_sum(prefix: str) -> float:
+        """Sum of every labeled counter series under ``prefix`` (counters
+        render as ``name{label="..."}`` keys in the snapshot)."""
+        return sum(v for k, v in METRICS.snapshot().items()
+                   if k.startswith(prefix) and isinstance(v, (int, float)))
 
     # -- schedule driver -----------------------------------------------------
 
@@ -677,6 +760,15 @@ class FleetHarness:
         return counts
 
 
+def _env_opt_int(name: str) -> Optional[int]:
+    """Int from the environment, or None when unset/garbled."""
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m trainingjob_operator_tpu.fleet.harness",
@@ -710,6 +802,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--with-ports", action="store_true",
                     help="Give containers a port so per-index headless "
                          "Services are reconciled too.")
+    ap.add_argument("--chaos", action="store_true",
+                    help="Run the controller under a seeded control-plane "
+                         "fault plan (docs/CHAOS.md): API errors/timeouts/"
+                         "conflicts, latency spikes, watch drops, stale "
+                         "lists.")
+    ap.add_argument("--chaos-seed", type=int,
+                    default=_env_opt_int(constants.CHAOS_SEED_ENV),
+                    help="Chaos plan seed (default: TRAININGJOB_CHAOS_SEED, "
+                         "else --seed).")
     ap.add_argument("--quiet", action="store_true",
                     help="Suppress progress lines; print only the report.")
     args = ap.parse_args(argv)
@@ -717,6 +818,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     profile = ChurnProfile(
         jobs=args.jobs, duration=args.duration, seed=args.seed,
         replicas=(args.replicas_min, args.replicas_max))
+    chaos_profile = None
+    if args.chaos:
+        chaos_seed = (args.chaos_seed if args.chaos_seed is not None
+                      else args.seed)
+        # Fault windows cover the arrival window plus the settling tail so
+        # drops/spikes land while the controller still has work in flight.
+        chaos_profile = ChaosProfile(seed=chaos_seed,
+                                     duration=args.duration + 2.0)
     progress = None if args.quiet else (
         lambda msg: print(f"[fleet] {msg}", file=sys.stderr, flush=True))
     harness = FleetHarness(
@@ -725,7 +834,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         resync_period=args.resync_period, gc_interval=args.gc_interval,
         pods_per_node=args.pods_per_node, with_ports=args.with_ports,
         sim_kernel=args.sim_kernel, max_wall_seconds=args.max_wall_seconds,
-        progress=progress)
+        chaos_profile=chaos_profile, progress=progress)
     report = harness.run()
     print(json.dumps(report.to_dict(), indent=2))
     return 0 if report.converged else 1

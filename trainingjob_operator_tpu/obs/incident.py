@@ -255,6 +255,24 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
             ("first_step", b_compile, t_end)]
 
 
+def _union_ms(windows: Tuple[Tuple[str, float, float], ...]) -> float:
+    """Total milliseconds covered by the union of (kind, start, end)
+    windows (kinds may overlap; double-counting would overstate chaos)."""
+    spans = sorted((s, e) for _, s, e in windows if e > s)
+    total = 0.0
+    cur_s = cur_e = None
+    for s, e in spans:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total * 1e3
+
+
 def _assemble(inc: Dict[str, Any],
               events: Tuple[Tuple[float, str, str], ...],
               steps: Tuple[Tuple[float, int, float, Optional[float],
@@ -263,6 +281,7 @@ def _assemble(inc: Dict[str, Any],
               rendezvous: Tuple[Tuple[float, float, str, str,
                                       Tuple[Tuple[str, float], ...]], ...]
               = (),
+              chaos: Tuple[Tuple[str, float, float], ...] = (),
               ) -> Dict[str, Any]:
     """Ring snapshot -> incident bundle.  Pure and deterministic: the same
     inputs serialize to the same bytes (``reassemble`` asserts this in
@@ -318,6 +337,15 @@ def _assemble(inc: Dict[str, Any],
         "control_downtime_ms": (round(max(t1c - t0, 0.0) * 1e3, 3)
                                 if inc["running_at"] is not None else None),
         "rung": window_rdv[-1][2] if window_rdv else None,
+        # Control-plane chaos attribution: every injected-fault window
+        # (latency spike, watch drop) overlapping this incident, clipped to
+        # it -- a fleet report reading the bundle can tell "slow because
+        # the apiserver was browning out" from an organic regression.
+        "chaos_windows": [{"kind": k, "start": round(s, 6),
+                           "end": round(e, 6),
+                           "ms": round(max(e - s, 0.0) * 1e3, 3)}
+                          for k, s, e in chaos],
+        "chaos_overlap_ms": round(_union_ms(chaos), 3),
         "phases": {p: round(v, 3) for p, v in phases.items()},
         "segments": [{"phase": p, "start": round(a, 6), "end": round(b, 6)}
                      for p, a, b in segments if b > a],
@@ -382,6 +410,9 @@ class IncidentRecorder:
         self._lock = threading.Lock()
         self._jobs: Dict[str, _JobIncidents] = {}
         self._event_sink: Optional[Callable[[str, str, str], None]] = None
+        #: Global (kind, start, end) chaos-fault windows; bundles assembled
+        #: while one overlaps are annotated with the clipped window.
+        self._chaos: Deque[Tuple[str, float, float]] = deque(maxlen=1024)
 
     def set_event_sink(self,
                        sink: Optional[Callable[[str, str, str], None]]) -> None:
@@ -398,6 +429,21 @@ class IncidentRecorder:
         return st
 
     # -- ring taps ------------------------------------------------------------
+
+    def record_chaos_window(self, kind: str, start: float, end: float) -> None:
+        """Declare a control-plane fault window (wall-clock span).  The fleet
+        harness registers the chaos plan's latency spikes and watch drops so
+        every bundle assembled under one carries the attribution."""
+        if end <= start:
+            return
+        with self._lock:
+            self._chaos.append((str(kind), float(start), float(end)))
+
+    def clear_chaos_windows(self) -> None:
+        """Drop declared chaos windows (a new run's schedule replaces the
+        previous run's in this process-global recorder)."""
+        with self._lock:
+            self._chaos.clear()
 
     def record_event(self, job: str, reason: str, message: str,
                      ts: Optional[float] = None) -> None:
@@ -598,7 +644,10 @@ class IncidentRecorder:
         steps = tuple(s for s in st.steps if t0 <= s[0] <= ended)
         resumes = tuple(r for r in st.resumes if t0 <= r[0] <= ended)
         rendezvous = tuple(r for r in st.rendezvous if t0 <= r[0] <= ended)
-        inputs = (inc_dict, events, steps, resumes, rendezvous)
+        chaos = tuple(sorted((k, max(t0, s), min(ended, e))
+                             for (k, s, e) in self._chaos
+                             if s <= ended and e >= t0))
+        inputs = (inc_dict, events, steps, resumes, rendezvous, chaos)
         bundle = _assemble(*inputs)
         encoded = _canonical(bundle)
         if st.bundles and st.bundles[-1]["bundle"]["id"] == inc.id:
