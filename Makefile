@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -85,6 +85,16 @@ fleet-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.chaos_smoke
 
+# Data-plane failure domains (docs/CHAOS.md): two same-seed combined-chaos
+# runs (control-plane faults + seeded node flaps/kills/domain kills) must
+# converge with zero violations, zero unattributed downtime and identical
+# plan digest + phase counts; a flap-grace-0 A/B run must restart strictly
+# more than the damped run; and deterministically corrupted checkpoints
+# (TRAININGJOB_CKPT_FAULT) must classify the fault and fall back to the
+# previous committed step (docs/RECOVERY.md integrity ladder).
+node-chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.node_chaos_smoke
+
 # Cold run -> serial warm resume -> overlapped warm resume at tiny shapes
 # (docs/RECOVERY.md); exits non-zero unless both resume paths work and
 # report their phase breakdowns.  The measured 124M version is bench.py's
@@ -118,4 +128,4 @@ resize-smoke:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif test dryrun incident-demo fleet-smoke chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+ci: lint lint-sarif test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke

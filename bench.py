@@ -642,6 +642,97 @@ def bench_control_plane_chaos(jobs=120, api_latency=0.005):
     }
 
 
+def bench_node_chaos(jobs=80, flap_grace=1.0):
+    """Data-plane failure domains (docs/CHAOS.md): seeded node flaps, a
+    permanent node kill and a failure-domain kill against the hardened
+    NODE_FAIL path, three arms on one churn schedule:
+
+    - ``baseline``: fault-free (the detect->running reference);
+    - ``undamped``: node chaos, flap grace 0 -- every transient NotReady
+      fires NODE_FAIL and restarts the group;
+    - ``damped``: same plan (identical digest), flap grace above the plan's
+      flap durations -- transient flaps are suppressed, only real kills
+      restart.
+
+    Gates: every arm converges with zero violations and zero unattributed
+    downtime; restart amplification damped/undamped strictly < 1.0 (damping
+    must pay for itself); damped event-to-visible p99 within 3x the
+    fault-free p99 (the grace delays NODE_FAIL by at most one flap, it must
+    not sit on real recoveries).
+    """
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.fleet.chaos import ChaosProfile
+    from trainingjob_operator_tpu.fleet.churn import (
+        FATE_COMPLETE,
+        FATE_DELETE,
+        FATE_POD_FAIL,
+        FATE_PREEMPT,
+        FATE_STEADY,
+        ChurnProfile,
+    )
+    from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+    # Steady-heavy mix: node faults only amplify restarts when they land on
+    # pods that are still Running, so most jobs here run until the end.
+    profile = ChurnProfile(jobs=jobs, duration=3.0, seed=0, replicas=(1, 3),
+                           run_seconds=(0.05, 0.25),
+                           fate_weights={FATE_COMPLETE: 0.25,
+                                         FATE_STEADY: 0.50,
+                                         FATE_PREEMPT: 0.07,
+                                         FATE_POD_FAIL: 0.12,
+                                         FATE_DELETE: 0.06})
+    arms = {
+        "baseline": (None, 0.0),
+        "undamped": ("chaos", 0.0),
+        "damped": ("chaos", flap_grace),
+    }
+    runs = {}
+    for arm, (kind, grace) in arms.items():
+        chaos = (ChaosProfile(seed=profile.seed, duration=5.0,
+                              node_flaps=6, node_kills=1, domain_kills=1)
+                 if kind else None)
+        prev = os.environ.get(constants.NODE_FLAP_GRACE_ENV)
+        os.environ[constants.NODE_FLAP_GRACE_ENV] = str(grace)
+        try:
+            harness = FleetHarness(
+                profile, workers=8, resync_period=30.0, gc_interval=30.0,
+                converge_timeout=300.0, pods_per_node=8, nodes_per_slice=4,
+                chaos_profile=chaos)
+            runs[arm] = harness.run()
+        finally:
+            if prev is None:
+                os.environ.pop(constants.NODE_FLAP_GRACE_ENV, None)
+            else:
+                os.environ[constants.NODE_FLAP_GRACE_ENV] = prev
+    base, und, damp = runs["baseline"], runs["undamped"], runs["damped"]
+    amplification = (round(damp.restarts_total / und.restarts_total, 3)
+                     if und.restarts_total else None)
+    base_p99 = base.event_to_visible_ms["p99"]
+    damp_p99 = damp.event_to_visible_ms["p99"]
+    ratio = round(damp_p99 / base_p99, 2) if base_p99 > 0 else None
+    return {
+        "jobs": jobs,
+        "flap_grace_s": flap_grace,
+        "plan_digest": (damp.chaos or {}).get("plan_digest"),
+        "node_faults": {k: v
+                        for k, v in ((damp.chaos or {}).get("faults")
+                                     or {}).items()
+                        if k in ("node_flap", "node_down", "domain_down")},
+        "restarts_undamped": und.restarts_total,
+        "restarts_damped": damp.restarts_total,
+        "restart_amplification": amplification,
+        "gate_amplification_lt_1": (amplification is not None
+                                    and amplification < 1.0),
+        "baseline_p99_ms": base_p99,
+        "damped_p99_ms": damp_p99,
+        "p99_ratio": ratio,
+        "gate_p99_le_3x": ratio is not None and ratio <= 3.0,
+        "unattributed_downtime_ms": max(r.unattributed_downtime_ms
+                                        for r in runs.values()),
+        "converged": all(r.converged for r in runs.values()),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Part 2c: fleet sim kernel -- scan-vs-event A/B at 1k jobs
 # ---------------------------------------------------------------------------
@@ -1448,6 +1539,11 @@ def main() -> int:
     except Exception as exc:
         out["control_plane_chaos"] = {"error": f"{type(exc).__name__}: "
                                                f"{str(exc)[:300]}"}
+    try:
+        out["node_chaos"] = bench_node_chaos()
+    except Exception as exc:
+        out["node_chaos"] = {"error": f"{type(exc).__name__}: "
+                                      f"{str(exc)[:300]}"}
     try:
         out["fleet_sim"] = bench_fleet_sim()
     except Exception as exc:

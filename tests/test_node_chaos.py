@@ -1,0 +1,427 @@
+"""Data-plane failure domains: flap-damped NODE_FAIL, crash-loop
+quarantine, node-chaos plan determinism, and the verified-checkpoint
+fallback ladder's structured reasons.
+
+Unit layer for the PR's robustness state machines (docs/CHAOS.md data-plane
+section, docs/RECOVERY.md integrity ladder):
+
+* TestFlapDamping -- the ``TRAININGJOB_NODE_FLAP_GRACE_S`` debounce against
+  the controller + fake clients, with explicit Ready-condition transition
+  timestamps so each test pins exactly which side of the grace deadline it
+  sits on.
+* TestCrashLoopQuarantine -- the ``_crashloop_gate``/``_crashloop_note``
+  state machine driven directly with explicit ``now_ts`` values: park after
+  N fast failures, one ``CrashLoopQuarantined`` event per episode, flat
+  retry cadence, clean-window release.
+* TestPlanDeterminism -- same seed => identical node-fault plan digest
+  across two FRESH interpreter subprocesses (no shared hash/rng state), and
+  the append-only property: adding node streams never perturbs the
+  control-plane draws of the same seed.
+* TestNodeChaosFleetDeterminism -- two fresh subprocess fleet runs under
+  one seed with node chaos armed converge to identical plan digests AND
+  identical final phase counts (the seed-is-the-repro contract, end to
+  end).
+* TestCorruptResumeFallback -- every rung of the resume-image ladder
+  returns a CLASSIFIED reason (missing/corrupt/stale/structure_mismatch),
+  counts it per reason, and the structured reason lands on the incident
+  bundle's resume timeline entry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.api.types import (
+    RestartPolicy,
+    RestartScope,
+    TrainingJobPhase,
+)
+from trainingjob_operator_tpu.core.objects import (
+    ConditionStatus,
+    make_ready_node,
+)
+from trainingjob_operator_tpu.fleet.chaos import (
+    FAULT_DOMAIN_DOWN,
+    FAULT_NODE_DOWN,
+    FAULT_NODE_FLAP,
+    ChaosGenerator,
+    ChaosProfile,
+)
+from trainingjob_operator_tpu.obs.incident import IncidentRecorder
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+from trainingjob_operator_tpu.workloads import train
+
+from test_controller import (  # noqa: E402
+    get_job,
+    make_env,
+    make_job,
+    pods_of,
+    set_pod_running,
+    sync,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _events(cs, reason):
+    return [e for e in cs.events.list() if e.reason == reason]
+
+
+def _counter(name, **labels):
+    snap = METRICS.snapshot()
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return snap.get(f"{name}{{{inner}}}", 0.0)
+    return snap.get(name, 0.0)
+
+
+# -- flap damping -------------------------------------------------------------
+
+class TestFlapDamping:
+    def _running_job(self, cs, tc, nodes=2):
+        for i in range(nodes):
+            cs.nodes.create(make_ready_node(f"node-{i}"))
+        job = make_job(replicas=2,
+                       restart_policy=RestartPolicy.ON_NODE_FAIL,
+                       restart_scope=RestartScope.POD)
+        cs.trainingjobs.create(job)
+        sync(tc, job)
+        set_pod_running(cs, "job-trainer-0", node="node-0")
+        set_pod_running(cs, "job-trainer-1", node="node-1")
+        sync(tc, job)
+        assert get_job(cs).status.phase == TrainingJobPhase.RUNNING
+        return job
+
+    def _flip_not_ready(self, cs, name, since):
+        node = cs.nodes.get_node(name)
+        node.status.conditions[0].status = ConditionStatus.FALSE
+        node.status.conditions[0].last_transition_time = since
+        cs.nodes.update(node)
+
+    def test_flap_within_grace_is_suppressed(self, monkeypatch):
+        monkeypatch.setenv(constants.NODE_FLAP_GRACE_ENV, "30.0")
+        cs, tc = make_env()
+        job = self._running_job(cs, tc)
+        # NotReady RIGHT NOW: well inside the 30 s grace.
+        self._flip_not_ready(cs, "node-1", since=time.time())
+        sync(tc, job, n=3)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.RUNNING
+        assert got.status.restart_counts.get("trainer", 0) == 0
+        assert len(pods_of(cs)) == 2  # nothing torn down
+        # One NodeFlapSuppressed event per (node, episode), not per sync.
+        assert len(_events(cs, constants.NODE_FLAP_SUPPRESSED_REASON)) == 1
+
+    def test_flap_recovery_within_grace_costs_nothing(self, monkeypatch):
+        monkeypatch.setenv(constants.NODE_FLAP_GRACE_ENV, "30.0")
+        cs, tc = make_env()
+        job = self._running_job(cs, tc)
+        self._flip_not_ready(cs, "node-1", since=time.time())
+        sync(tc, job)
+        # The node comes back before the grace expires: the flap is fully
+        # absorbed -- no restart, no NODE_FAIL, pods untouched.
+        node = cs.nodes.get_node("node-1")
+        node.status.conditions[0].status = ConditionStatus.TRUE
+        node.status.conditions[0].last_transition_time = time.time()
+        cs.nodes.update(node)
+        sync(tc, job, n=2)
+        got = get_job(cs)
+        assert got.status.phase == TrainingJobPhase.RUNNING
+        assert got.status.restart_counts.get("trainer", 0) == 0
+
+    def test_grace_expiry_fires_node_fail(self, monkeypatch):
+        grace = 5.0
+        monkeypatch.setenv(constants.NODE_FLAP_GRACE_ENV, str(grace))
+        cs, tc = make_env()
+        job = self._running_job(cs, tc)
+        # Explicit transition timestamp PAST the grace deadline: the
+        # debounce must stand aside and the normal NODE_FAIL restart fire.
+        self._flip_not_ready(cs, "node-1", since=time.time() - grace - 1.0)
+        sync(tc, job)
+        assert get_job(cs).status.restart_counts["trainer"] == 1
+        assert [p.name for p in pods_of(cs)] == ["job-trainer-0"]
+
+    def test_grace_unset_keeps_immediate_node_fail(self, monkeypatch):
+        # No knob => historical behavior: NotReady restarts on the next
+        # reconcile, no suppression window, no event.
+        monkeypatch.delenv(constants.NODE_FLAP_GRACE_ENV, raising=False)
+        cs, tc = make_env()
+        job = self._running_job(cs, tc)
+        self._flip_not_ready(cs, "node-1", since=time.time())
+        sync(tc, job)
+        assert get_job(cs).status.restart_counts["trainer"] == 1
+        assert _events(cs, constants.NODE_FLAP_SUPPRESSED_REASON) == []
+
+
+# -- crash-loop quarantine ----------------------------------------------------
+
+class TestCrashLoopQuarantine:
+    def _env(self, monkeypatch, after=3, window=30.0, delay=60.0):
+        monkeypatch.setenv(constants.CRASHLOOP_AFTER_ENV, str(after))
+        monkeypatch.setenv(constants.CRASHLOOP_WINDOW_ENV, str(window))
+        monkeypatch.setenv(constants.CRASHLOOP_DELAY_ENV, str(delay))
+        cs, tc = make_env()
+        job = make_job()
+        cs.trainingjobs.create(job)
+        return cs, tc, get_job(cs)
+
+    def test_parks_after_consecutive_fast_failures(self, monkeypatch):
+        cs, tc, job = self._env(monkeypatch, after=3, window=30.0, delay=60.0)
+        t0 = 1000.0
+        # Three restarts 5 s apart, each inside the 30 s window.
+        for i in range(3):
+            now = t0 + 5.0 * i
+            assert tc._crashloop_gate(job, "trainer", "trainer", now) is None
+            tc._crashloop_note(job, "trainer", now)
+        # Fourth attempt at +15 s: parked.
+        parked = tc._crashloop_gate(job, "trainer", "trainer", t0 + 15.0)
+        assert parked is not None
+        phase, msg = parked
+        assert phase == TrainingJobPhase.NONE
+        assert "crash-loop quarantined" in msg
+        quarantined = _events(cs, constants.CRASHLOOP_QUARANTINED_REASON)
+        assert len(quarantined) == 1
+
+    def test_one_quarantined_event_per_episode(self, monkeypatch):
+        # window > delay so the clean-window release can't fire mid-test.
+        cs, tc, job = self._env(monkeypatch, after=2, window=100.0,
+                                delay=60.0)
+        for i in range(2):
+            tc._crashloop_gate(job, "trainer", "trainer", 1000.0 + i)
+            tc._crashloop_note(job, "trainer", 1000.0 + i)
+        # Repeated reconciles while held: still parked, still ONE event.
+        for dt in (2.0, 10.0, 30.0, 50.0):
+            assert tc._crashloop_gate(job, "trainer", "trainer",
+                                      1001.0 + dt) is not None
+        assert len(_events(cs, constants.CRASHLOOP_QUARANTINED_REASON)) == 1
+
+    def test_flat_cadence_allows_retry_after_delay(self, monkeypatch):
+        cs, tc, job = self._env(monkeypatch, after=2, window=30.0, delay=10.0)
+        for now in (1000.0, 1005.0):
+            tc._crashloop_gate(job, "trainer", "trainer", now)
+            tc._crashloop_note(job, "trainer", now)
+        # Held while inside the flat delay ...
+        held = tc._crashloop_gate(job, "trainer", "trainer", 1010.0)
+        assert held is not None and "next restart attempt" in held[1]
+        # ... the attempt past last+delay proceeds (still quarantined, no
+        # second event), and the NEXT fast failure holds for one flat delay
+        # again -- a constant cadence, not exponential growth.
+        assert tc._crashloop_gate(job, "trainer", "trainer", 1016.0) is None
+        tc._crashloop_note(job, "trainer", 1016.0)
+        assert tc._crashloop_gate(job, "trainer", "trainer",
+                                  1020.0) is not None
+        assert tc._crashloop_gate(job, "trainer", "trainer", 1027.0) is None
+        assert len(_events(cs, constants.CRASHLOOP_QUARANTINED_REASON)) == 1
+
+    def test_clean_window_releases_with_event(self, monkeypatch):
+        cs, tc, job = self._env(monkeypatch, after=2, window=30.0, delay=60.0)
+        before = _counter("trainingjob_crashloop_released_total")
+        for now in (1000.0, 1005.0):
+            tc._crashloop_gate(job, "trainer", "trainer", now)
+            tc._crashloop_note(job, "trainer", now)
+        assert tc._crashloop_gate(job, "trainer", "trainer",
+                                  1010.0) is not None  # parked
+        # The incarnation survives a full clean window (30 s past its last
+        # failure): released, counter bumped, one CrashLoopReleased event,
+        # and the next restart proceeds unparked.
+        assert tc._crashloop_gate(job, "trainer", "trainer", 1040.0) is None
+        assert len(_events(cs, constants.CRASHLOOP_RELEASED_REASON)) == 1
+        assert _counter("trainingjob_crashloop_released_total") == before + 1
+        tc._crashloop_note(job, "trainer", 1040.0)
+        assert tc._crashloop_gate(job, "trainer", "trainer", 1041.0) is None
+
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv(constants.CRASHLOOP_AFTER_ENV, raising=False)
+        cs, tc = make_env()
+        job = make_job()
+        cs.trainingjobs.create(job)
+        job = get_job(cs)
+        for i in range(10):
+            assert tc._crashloop_gate(job, "trainer", "trainer",
+                                      1000.0 + i) is None
+            tc._crashloop_note(job, "trainer", 1000.0 + i)
+
+
+# -- plan determinism ---------------------------------------------------------
+
+_DIGEST_SNIPPET = """
+import json, sys
+from trainingjob_operator_tpu.fleet.chaos import ChaosGenerator, ChaosProfile
+plan = ChaosGenerator(ChaosProfile(seed={seed}, duration=4.0, node_flaps=3,
+                                   node_kills=1, domain_kills=1)).plan()
+print(json.dumps({{"digest": plan.digest(),
+                  "faults": [[f.at, f.kind, f.target, f.down]
+                             for f in plan.node_faults]}}))
+"""
+
+
+def _plan_in_subprocess(seed):
+    proc = subprocess.run(
+        [sys.executable, "-c", _DIGEST_SNIPPET.format(seed=seed)],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout)
+
+
+class TestPlanDeterminism:
+    def test_same_seed_same_digest_across_fresh_interpreters(self):
+        a = _plan_in_subprocess(5)
+        b = _plan_in_subprocess(5)
+        assert a["digest"] == b["digest"]
+        assert a["faults"] == b["faults"]
+        assert len(a["faults"]) == 5  # 3 flaps + 1 kill + 1 domain kill
+
+    def test_different_seed_different_plan(self):
+        assert _plan_in_subprocess(5)["digest"] != \
+            _plan_in_subprocess(6)["digest"]
+
+    def test_node_streams_never_perturb_control_plane_draws(self):
+        # Node-fault draws come LAST in the generator: a pre-existing
+        # control-plane-only profile's fault sequence must stay
+        # byte-identical when node streams are added under the same seed.
+        base = ChaosProfile(seed=11, duration=4.0)
+        extended = ChaosProfile(seed=11, duration=4.0, node_flaps=3,
+                                node_kills=1, domain_kills=1)
+        pa, pb = ChaosGenerator(base).plan(), ChaosGenerator(extended).plan()
+        assert pa.decisions == pb.decisions
+        assert pa.spikes == pb.spikes
+        assert pa.drops == pb.drops
+        assert pa.stale == pb.stale
+        assert pa.node_faults == ()
+        kinds = {f.kind for f in pb.node_faults}
+        assert kinds == {FAULT_NODE_FLAP, FAULT_NODE_DOWN, FAULT_DOMAIN_DOWN}
+        # Flaps carry a bounded NotReady duration; permanent kills don't.
+        for f in pb.node_faults:
+            if f.kind == FAULT_NODE_FLAP:
+                assert base.flap_down[0] <= f.down <= base.flap_down[1]
+            else:
+                assert f.down == 0.0
+
+    def test_plan_digest_is_order_insensitive_to_dict_iteration(self):
+        plan = ChaosGenerator(ChaosProfile(seed=3, node_flaps=2)).plan()
+        assert plan.digest() == plan.digest()
+        assert json.loads(plan.canonical())["node_faults"] == \
+            [[f.at, f.kind, f.target, f.down] for f in plan.node_faults]
+
+
+class TestNodeChaosFleetDeterminism:
+    def test_same_seed_same_phase_counts_across_subprocesses(self):
+        cmd = [sys.executable, "-m",
+               "trainingjob_operator_tpu.fleet.harness",
+               "--jobs", "8", "--seed", "13", "--duration", "1.0",
+               "--replicas-min", "1", "--replicas-max", "2",
+               "--pods-per-node", "2", "--nodes-per-slice", "2",
+               "--workers", "4", "--node-chaos", "--node-flaps", "2",
+               "--node-kills", "1", "--domain-kills", "1",
+               "--converge-timeout", "90", "--quiet"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TRAININGJOB_NODE_FLAP_GRACE_S="1.0")
+        reports = []
+        for _ in range(2):
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=300, cwd=REPO_ROOT, env=env)
+            assert proc.returncode == 0, \
+                (proc.stderr or proc.stdout)[-2000:]
+            reports.append(json.loads(proc.stdout))
+        a, b = reports
+        for rep in reports:
+            assert rep["converged"] and not rep["violations"]
+            assert rep["unattributed_downtime_ms"] == 0.0
+        assert a["chaos"]["plan_digest"] == b["chaos"]["plan_digest"]
+        assert a["phase_counts"] == b["phase_counts"]
+
+
+# -- verified-checkpoint fallback reasons -------------------------------------
+
+class TestCorruptResumeFallback:
+    TEMPLATE = {"step": 0, "x": np.arange(4)}
+
+    def _image_path(self, tmp_path):
+        return tmp_path / train._RESUME_IMAGE
+
+    def _load(self, tmp_path, latest=3):
+        train._LAST_RESUME_FALLBACK = ""
+        return train._load_resume_image(str(tmp_path), latest, self.TEMPLATE)
+
+    def _assert_reason(self, tmp_path, reason, latest=3):
+        before = _counter("trainingjob_resume_image_fallbacks_total",
+                          reason=reason)
+        assert self._load(tmp_path, latest) is None
+        assert train._LAST_RESUME_FALLBACK == reason
+        assert _counter("trainingjob_resume_image_fallbacks_total",
+                        reason=reason) == before + 1
+
+    def test_missing_image_classified(self, tmp_path):
+        self._assert_reason(tmp_path, "missing")
+
+    def test_flipped_payload_byte_fails_sha_footer(self, tmp_path):
+        train._write_resume_image(str(tmp_path), 3,
+                                  {"step": 3, "x": np.arange(4)})
+        image = self._image_path(tmp_path)
+        raw = bytearray(image.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF  # one bit of payload, footer untouched
+        image.write_bytes(bytes(raw))
+        self._assert_reason(tmp_path, "corrupt")
+
+    def test_truncated_image_classified_corrupt(self, tmp_path):
+        self._image_path(tmp_path).write_bytes(b"\x00" * train._CKPT_SHA_LEN)
+        self._assert_reason(tmp_path, "corrupt")
+
+    def test_injection_knob_forces_corrupt_rung(self, tmp_path, monkeypatch):
+        train._write_resume_image(str(tmp_path), 3,
+                                  {"step": 3, "x": np.arange(4)})
+        assert self._load(tmp_path) is not None  # image is genuinely valid
+        monkeypatch.setenv(constants.CKPT_FAULT_ENV, "resume_image")
+        self._assert_reason(tmp_path, "corrupt")
+
+    def test_stale_image_classified(self, tmp_path):
+        train._write_resume_image(str(tmp_path), 2,
+                                  {"step": 2, "x": np.arange(4)})
+        self._assert_reason(tmp_path, "stale", latest=3)
+
+    def test_tree_shape_drift_classified(self, tmp_path):
+        train._write_resume_image(str(tmp_path), 3,
+                                  {"step": 3, "y": np.arange(4)})
+        self._assert_reason(tmp_path, "structure_mismatch")
+
+    def test_structured_reason_lands_on_incident_bundle(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        job = "default/incjob"
+        rec.on_interruption(job, "ALL", constants.RESTARTING_REASON,
+                            now=100.0)
+        rec.record_event(job, constants.RESTARTING_REASON, "restarting",
+                         ts=100.2)
+        rec.on_running(job, now=102.0)
+        rec.record_resume(job, restore_ms=300.0, compile_ms=500.0,
+                          overlapped=True, now=102.9, fallback="corrupt")
+        rec.record_step(job, step=5, ms=100.0, now=103.0)
+        (bundle,) = rec.bundles(job)
+        resume_entries = [e for e in bundle["timeline"]
+                          if e["kind"] == "resume"]
+        assert resume_entries == [{"ts": 102.9, "kind": "resume",
+                                   "restore_ms": 300.0, "compile_ms": 500.0,
+                                   "overlapped": True,
+                                   "fallback": "corrupt"}]
+
+    def test_happy_path_resume_entry_has_no_fallback_key(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        job = "default/incjob"
+        rec.on_interruption(job, "ALL", constants.RESTARTING_REASON,
+                            now=100.0)
+        rec.record_event(job, constants.RESTARTING_REASON, "restarting",
+                         ts=100.2)
+        rec.on_running(job, now=102.0)
+        rec.record_resume(job, restore_ms=300.0, compile_ms=500.0,
+                          overlapped=True, now=102.9)
+        rec.record_step(job, step=5, ms=100.0, now=103.0)
+        (bundle,) = rec.bundles(job)
+        (entry,) = [e for e in bundle["timeline"] if e["kind"] == "resume"]
+        assert "fallback" not in entry

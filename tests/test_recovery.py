@@ -207,7 +207,7 @@ class TestResumeImage:
                                                     subdir="t")
         np.testing.assert_array_equal(np.asarray(st2.value["x"]),
                                       np.arange(8) + 2)
-        assert "image unusable" in capsys.readouterr().out
+        assert "image fallback reason=corrupt" in capsys.readouterr().out
 
     def test_knob_disables_image_restore(self, tmp_path, monkeypatch):
         template = {"step": 0, "x": jnp.arange(8)}

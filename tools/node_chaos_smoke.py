@@ -1,0 +1,152 @@
+"""Data-plane node-chaos smoke: combined-fault survival, determinism,
+flap damping, and the verified-checkpoint fallback ladder.
+
+The ``make node-chaos-smoke`` driver (wired into ``make ci``), four legs:
+
+1. COMBINED CHAOS, twice: subprocess fleet runs under one seed with the
+   control-plane fault plane AND seeded node faults (transient flaps, a
+   permanent node kill, a failure-domain kill) armed on the sim's timer
+   queue, flap damping on.  Each run must converge with ZERO invariant
+   violations and ZERO unattributed downtime, and at least one node fault
+   of each planned kind must actually fire.  Across the two runs the plan
+   digest and the final phase counts must be identical (same seed => same
+   faults => same fleet state -- the repro contract of docs/CHAOS.md).
+2. DAMPING A/B: the same run with ``TRAININGJOB_NODE_FLAP_GRACE_S=0``.
+   Restart count under damping must be STRICTLY below the undamped run --
+   the debounce has to absorb transient flaps, not just delay them.
+3. CORRUPT RESUME IMAGE (``TRAININGJOB_CKPT_FAULT=resume_image``): a warm
+   llama_elastic resume whose fast-path image is deterministically
+   corrupted must classify the fault (``image fallback reason=corrupt``)
+   and still resume from orbax at the right step.
+4. CORRUPT LATEST CHECKPOINT (``TRAININGJOB_CKPT_FAULT=corrupt_latest``):
+   with the fast path off, the orbax restore of the newest step is failed
+   deterministically; the run must fall back to the PREVIOUS committed
+   step (``restored previous committed step``) instead of dying
+   (docs/RECOVERY.md integrity ladder).
+
+Usage::
+
+    python -m tools.node_chaos_smoke [--jobs 30] [--seed 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+
+def _fleet_run(args: argparse.Namespace, flap_grace: float) -> dict:
+    cmd = [sys.executable, "-m", "trainingjob_operator_tpu.fleet.harness",
+           "--jobs", str(args.jobs), "--seed", str(args.seed),
+           "--duration", str(args.duration),
+           "--replicas-min", "1", "--replicas-max", "3",
+           "--pods-per-node", "4", "--nodes-per-slice", "3",
+           "--workers", "4", "--chaos", "--node-chaos",
+           "--converge-timeout", str(args.converge_timeout), "--quiet"]
+    env = dict(os.environ,
+               TRAININGJOB_NODE_FLAP_GRACE_S=str(flap_grace))
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600, env=env)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+        raise SystemExit("node-chaos fleet run failed (rc=%d):\n%s"
+                         % (proc.returncode, "\n".join(tail)))
+    return json.loads(proc.stdout)
+
+
+def _llama_run(env_extra: dict, timeout: float = 300.0) -> str:
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "trainingjob_operator_tpu.workloads.llama_elastic"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-2000:], file=sys.stderr)
+        raise SystemExit(f"llama_elastic rc={proc.returncode}")
+    return proc.stdout
+
+
+def _check(cond: bool, message: str) -> None:
+    if not cond:
+        raise SystemExit(f"node-chaos-smoke FAILED: {message}")
+    print(f"ok: {message}", flush=True)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("node-chaos-smoke")
+    parser.add_argument("--jobs", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--duration", type=float, default=2.0)
+    parser.add_argument("--flap-grace", type=float, default=1.0)
+    parser.add_argument("--converge-timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    # -- leg 1: combined chaos, twice, damped --------------------------------
+    reports = [_fleet_run(args, args.flap_grace) for _ in range(2)]
+    for i, rep in enumerate(reports):
+        faults = rep["chaos"]["faults"]
+        print(f"run {i}: converged={rep['converged']} "
+              f"violations={len(rep['violations'])} "
+              f"unattributed_ms={rep['unattributed_downtime_ms']} "
+              f"restarts={rep['restarts_total']} faults={faults}")
+        _check(rep["converged"] and not rep["violations"],
+               f"run {i} converged with zero violations")
+        _check(rep["unattributed_downtime_ms"] == 0.0,
+               f"run {i} left zero downtime unattributed")
+        for kind in ("node_flap", "node_down", "domain_down"):
+            _check(faults.get(kind, 0) > 0,
+                   f"run {i} fired at least one {kind} fault")
+    a, b = reports
+    _check(a["chaos"]["plan_digest"] == b["chaos"]["plan_digest"],
+           "same seed produced the same chaos plan digest")
+    _check(a["phase_counts"] == b["phase_counts"],
+           f"same seed converged to the same phase counts "
+           f"{a['phase_counts']}")
+
+    # -- leg 2: damping A/B --------------------------------------------------
+    undamped = _fleet_run(args, 0.0)
+    print(f"undamped: converged={undamped['converged']} "
+          f"restarts={undamped['restarts_total']}")
+    _check(undamped["converged"] and not undamped["violations"],
+           "undamped run still converged (flaps cost restarts, not jobs)")
+    _check(a["restarts_total"] < undamped["restarts_total"],
+           f"damped restarts {a['restarts_total']} strictly below "
+           f"undamped {undamped['restarts_total']}")
+
+    # -- legs 3+4: checkpoint integrity ladder -------------------------------
+    ckpt = tempfile.mkdtemp(prefix="node-chaos-smoke-")
+    base = {"TRAININGJOB_CHECKPOINT_DIR": ckpt,
+            "TRAININGJOB_JAX_PLATFORM": "cpu",
+            "LLAMA_CKPT_EVERY": "2", "LLAMA_BATCH": "2", "LLAMA_SEQ": "32"}
+    cold = _llama_run(dict(base, LLAMA_STEPS="4"))
+    _check("recovery_timing" in cold, "cold run seeded two committed steps")
+
+    corrupt_image = _llama_run(dict(base, LLAMA_STEPS="6",
+                                    TRAININGJOB_CKPT_FAULT="resume_image"))
+    _check("image fallback reason=corrupt" in corrupt_image,
+           "corrupted resume image classified as corrupt (structured reason)")
+    _check("resumed at step 4" in corrupt_image,
+           "corrupt-image run still resumed from orbax at step 4")
+
+    corrupt_latest = _llama_run(dict(base, LLAMA_STEPS="8",
+                                     TRAININGJOB_RESUME_OVERLAP="0",
+                                     TRAININGJOB_CKPT_FAULT="corrupt_latest"))
+    m = re.search(r"restored previous committed step (\d+)", corrupt_latest)
+    _check(m is not None,
+           "corrupt-latest run fell back to the previous committed step")
+    _check("resumed at step" in corrupt_latest,
+           f"corrupt-latest run resumed training from step "
+           f"{m.group(1) if m else '?'}")
+
+    print("node-chaos-smoke PASSED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,11 @@ POD_ROLE_LABEL = "PodRole"
 # TPU extensions
 SLICE_ID_LABEL = "TPUSliceID"
 GANG_LABEL = "TPUGang"
+# Node-side failure-domain topology label (sim/fleet nodes): every node of
+# one physical slice carries the same value, so a domain-correlated fault
+# (fleet/chaos.py node_faults kind=domain_down) downs them together --
+# pods of a gang share fate with their interconnect, not just their host.
+NODE_SLICE_LABEL = "tpu.trainingjob.dev/slice"
 # Declared member count of the gang: schedulers must not place a gang they
 # have only partially observed (pods of one slice are created over several
 # API calls; placing the visible subset first-come steals its capacity).
@@ -38,6 +43,11 @@ GANG_SIZE_LABEL = "TPUGangSize"
 # controller.job_index_key).  An indexed lookup is O(job's objects); the
 # lister list it replaces deepcopied the whole store per reconcile.
 JOB_INDEX = "by-job"
+
+# Informer secondary index mapping a pod to the node it is placed on.  Node
+# readiness transitions use it to reconcile exactly the affected jobs
+# (O(pods-on-node)) instead of waiting out a resync period.
+NODE_INDEX = "by-node"
 
 # --- identity env vars injected into every container
 # (reference: constants.go:13-21, pkg/controller/pod.go:600-628) -------------
@@ -152,6 +162,28 @@ API_RETRIES_ENV = "TRAININGJOB_API_RETRIES"
 # failed syncs before a key is parked (0 disables), and how long it parks.
 QUARANTINE_AFTER_ENV = "TRAININGJOB_QUARANTINE_AFTER"
 QUARANTINE_DELAY_ENV = "TRAININGJOB_QUARANTINE_S"
+# Node-flap damping (controller/pod.py get_node_status): seconds a node must
+# stay NotReady before the controller treats it as failed.  Inside the grace
+# the node still counts as ready, so NODE_FAIL teardown, elastic shrink and
+# resize keepalive are all uniformly debounced -- a flap storm costs one
+# grace window, not a restart storm.  0 (default) disables damping.
+NODE_FLAP_GRACE_ENV = "TRAININGJOB_NODE_FLAP_GRACE_S"
+# Crash-loop quarantine (controller/pod.py _restart_pods): a replica group
+# whose restarts keep failing within CRASHLOOP_WINDOW_S of each other is
+# parked after CRASHLOOP_AFTER consecutive fast failures, retrying at a
+# flat CRASHLOOP_DELAY_S cadence (one CrashLoopQuarantined event per
+# episode) until a run survives past the window.  AFTER=0 (default)
+# disables quarantine.
+CRASHLOOP_AFTER_ENV = "TRAININGJOB_CRASHLOOP_AFTER"
+CRASHLOOP_WINDOW_ENV = "TRAININGJOB_CRASHLOOP_WINDOW_S"
+CRASHLOOP_DELAY_ENV = "TRAININGJOB_CRASHLOOP_DELAY_S"
+# Deterministic checkpoint-fault injection (workloads/train.py):
+# "resume_image" corrupts the flat resume image's bytes at read (the sha256
+# footer must catch it and classify the fallback as corrupt);
+# "corrupt_latest" makes the latest-step orbax restore raise, driving the
+# fallback ladder down to the previous committed step (max_to_keep=2
+# retains it).  Unset (default) injects nothing.
+CKPT_FAULT_ENV = "TRAININGJOB_CKPT_FAULT"
 PALLAS_ENV = "TRAININGJOB_PALLAS"
 FA_BLOCK_Q_ENV = "TRAININGJOB_FA_BLOCK_Q"
 FA_BLOCK_K_ENV = "TRAININGJOB_FA_BLOCK_K"
@@ -254,6 +286,11 @@ USER_ENV_KNOBS = frozenset((
     API_RETRIES_ENV,
     QUARANTINE_AFTER_ENV,
     QUARANTINE_DELAY_ENV,
+    NODE_FLAP_GRACE_ENV,
+    CRASHLOOP_AFTER_ENV,
+    CRASHLOOP_WINDOW_ENV,
+    CRASHLOOP_DELAY_ENV,
+    CKPT_FAULT_ENV,
     INCIDENT_RING_ENV,
     INCIDENT_BUNDLES_ENV,
     HBM_SAMPLE_STEPS_ENV,
@@ -335,6 +372,16 @@ RESIZE_PUBLISH_FAILED_REASON = "ResizePublishFailed"
 # in the workqueue quarantine -- it will be retried on a slow flat cadence
 # instead of the exponential ladder, and one successful sync releases it.
 SYNC_QUARANTINED_REASON = "SyncQuarantined"
+# Node-flap damping (docs/CHAOS.md data plane): a job's pod sits on a node
+# that went NotReady but is still inside TRAININGJOB_NODE_FLAP_GRACE_S --
+# NODE_FAIL is suppressed for the rest of the grace window (one event per
+# flap episode; the node recovering inside the window costs nothing).
+NODE_FLAP_SUPPRESSED_REASON = "NodeFlapSuppressed"
+# Crash-loop quarantine (docs/CHAOS.md): a replica group's restarts kept
+# failing fast, so the restart machinery parked it at a flat retry cadence
+# (Quarantined, once per episode) until a clean run releases it (Released).
+CRASHLOOP_QUARANTINED_REASON = "CrashLoopQuarantined"
+CRASHLOOP_RELEASED_REASON = "CrashLoopReleased"
 
 # Telemetry-plane reasons (obs/telemetry.py watchdog): a replica's step
 # counter stopped advancing for N x its median step time / started moving
@@ -377,6 +424,9 @@ EVENT_REASONS = frozenset((
     RESHARD_FELL_BACK_REASON,
     RESIZE_PUBLISH_FAILED_REASON,
     SYNC_QUARANTINED_REASON,
+    NODE_FLAP_SUPPRESSED_REASON,
+    CRASHLOOP_QUARANTINED_REASON,
+    CRASHLOOP_RELEASED_REASON,
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
     INCIDENT_RECORDED_REASON,

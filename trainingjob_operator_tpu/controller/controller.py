@@ -119,13 +119,16 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.trainingjob_lister = job_informer.lister
         self.pod_lister = pod_informer.lister
         self.service_lister = service_informer.lister
-        self.node_lister = self.informer_factory.lister(Node.KIND)
+        node_informer = self.informer_factory.informer(Node.KIND)
+        self.node_lister = node_informer.lister
         # Indexed cache lookups (get_pods_by_job/get_services_by_job read
         # these instead of relisting the store per reconcile).
         self.pod_informer = pod_informer
         self.service_informer = service_informer
         pod_informer.add_index(constants.JOB_INDEX, job_index_key)
         service_informer.add_index(constants.JOB_INDEX, job_index_key)
+        pod_informer.add_index(constants.NODE_INDEX,
+                               lambda pod: pod.spec.node_name or None)
         # O(changed-pods) status recomputation: one record per pod, updated
         # from informer deltas by the pod handlers below.
         self.pod_phase_index = PodPhaseIndex()
@@ -149,6 +152,13 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         service_informer.add_event_handler(
             on_add=self.add_service,
             on_delete=self.on_service_deleted,
+        )
+        # Node readiness transitions drive NODE_FAIL detection event-style:
+        # jobs with pods on the transitioning node reconcile NOW instead of
+        # waiting out the resync period (docs/CHAOS.md hardened path).
+        node_informer.add_event_handler(
+            on_update=self.update_node,
+            on_delete=self.delete_node,
         )
 
         self._workers: List[threading.Thread] = []
@@ -209,6 +219,29 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         elif delay > 0:
             self.work_queue.add_after(key, delay)
         else:
+            self.work_queue.add(key)
+
+    # -- node event handlers -------------------------------------------------
+
+    def update_node(self, old: Node, cur: Node) -> None:
+        if old.is_ready() == cur.is_ready():
+            return
+        self._enqueue_jobs_on_node(cur.name)
+
+    def delete_node(self, node: Node) -> None:
+        # A node object going away entirely is a readiness transition too.
+        self._enqueue_jobs_on_node(node.name)
+
+    def _enqueue_jobs_on_node(self, node_name: str) -> None:
+        """Enqueue every job owning a pod placed on ``node_name`` (indexed
+        lookup, O(pods-on-node))."""
+        keys = set()
+        for pod in self.pod_informer.by_index(constants.NODE_INDEX,
+                                              node_name):
+            job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL)
+            if job_name:
+                keys.add(f"{pod.metadata.namespace}/{job_name}")
+        for key in keys:
             self.work_queue.add(key)
 
     def _resolve_controller_ref(self, namespace: str,

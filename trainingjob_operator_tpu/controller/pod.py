@@ -50,10 +50,13 @@ from trainingjob_operator_tpu.core.objects import (
     Condition,
     ConditionStatus,
     EnvVar,
+    Node,
+    NodeConditionType,
     Pod,
     PodConditionType,
     PodPhase,
 )
+from trainingjob_operator_tpu.obs.incident import INCIDENTS
 from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, sink_address
 from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
@@ -213,6 +216,7 @@ class PodReconciler:
                         if spec.edl_policy == EdlPolicy.AUTO else 0)
         pod_slices = get_slices(replica_pods, max(replicas, probe_target))
         node_ready = self.get_node_status()
+        self._damp_node_flaps(job, rt, replica_pods)
         message = ""
         failed_reasons: List[str] = []
         failed_phase = TrainingJobPhase.FAILED
@@ -719,6 +723,110 @@ class PodReconciler:
             self.pod_control.delete_pod(p.namespace, p.name, job, grace_period=grace)
         return TrainingJobPhase.SCALING, msg
 
+    def _damp_node_flaps(self, job: TPUTrainingJob, rt: str,
+                         replica_pods: List[Pod]) -> None:
+        """Bookkeeping for flap suppression (get_node_status): when a pod
+        of this group sits on a node inside its flap grace, re-reconcile
+        at the grace deadline (recovered by then, or NODE_FAIL fires one
+        grace late), surface one ``NodeFlapSuppressed`` event per
+        (node, episode), and declare the window to the incident recorder
+        so suppressed time is attributed to the fault plane instead of
+        counting as unattributed downtime."""
+        pending = getattr(self, "_flap_pending", None)
+        if not pending:
+            return
+        episodes = getattr(self, "_flap_episodes", None)
+        if episodes is None:
+            episodes = self._flap_episodes = {}
+        now_ts = time.time()
+        for p in replica_pods:
+            entry = pending.get(p.spec.node_name or "")
+            if entry is None:
+                continue
+            since, deadline = entry
+            self.enqueue_job(job, delay=max(deadline - now_ts, 0.1))
+            ep_key = f"{p.spec.node_name}/{since:.3f}"
+            if ep_key in episodes:
+                continue
+            while len(episodes) >= 1024:  # bound across flap churn
+                episodes.pop(next(iter(episodes)))
+            episodes[ep_key] = True
+            self.metrics.inc("trainingjob_node_flaps_suppressed_total")
+            INCIDENTS.record_chaos_window("flap_suppressed", since, deadline)
+            self.recorder.event(
+                job, EventRecorder.NORMAL,
+                constants.NODE_FLAP_SUPPRESSED_REASON,
+                f"node {p.spec.node_name} NotReady for {now_ts - since:.1f}s; "
+                f"suppressing NODE_FAIL for {rt} until the "
+                f"{deadline - since:.1f}s flap grace expires")
+
+    def _crashloop_gate(self, job: TPUTrainingJob, rtype: str, rt: str,
+                        now_ts: float) -> Optional[Tuple[str, str]]:
+        """Crash-loop quarantine (the PR 14 workqueue-quarantine pattern
+        applied to the restart state machine): ``TRAININGJOB_CRASHLOOP_AFTER``
+        consecutive restarts each landing within
+        ``TRAININGJOB_CRASHLOOP_WINDOW_S`` of the previous park the replica
+        group at a flat ``TRAININGJOB_CRASHLOOP_DELAY_S`` cadence -- one
+        ``CrashLoopQuarantined`` event per episode -- instead of burning
+        the restart limit at reconcile speed.  A clean window (the
+        incarnation outliving WINDOW before its next failure) releases.
+        Returns the parked (phase, msg) while holding, else None."""
+        after = int(_env_float(constants.CRASHLOOP_AFTER_ENV, 0.0))
+        if after <= 0:
+            return None
+        window = _env_float(constants.CRASHLOOP_WINDOW_ENV, 30.0)
+        delay = _env_float(constants.CRASHLOOP_DELAY_ENV, 60.0)
+        table = getattr(self, "_crashloop", None)
+        if table is None:
+            table = self._crashloop = {}
+        key = f"{job.metadata.uid or meta_namespace_key(job)}/{rtype}"
+        entry = table.get(key)
+        if entry is None:
+            while len(table) >= 1024:  # bound across job churn
+                table.pop(next(iter(table)))
+            entry = table[key] = {"last": 0.0, "fails": 0, "parked": False}
+        if entry["last"] and now_ts - entry["last"] >= window:
+            # The last incarnation ran a clean window before failing again:
+            # the loop is broken, release the episode.
+            if entry["parked"]:
+                self.metrics.inc("trainingjob_crashloop_released_total")
+                self.recorder.event(
+                    job, EventRecorder.NORMAL,
+                    constants.CRASHLOOP_RELEASED_REASON,
+                    f"{rt} ran {now_ts - entry['last']:.1f}s without "
+                    f"restarting; releasing crash-loop quarantine")
+            entry["fails"] = 0
+            entry["parked"] = False
+        if entry["fails"] >= after:
+            if not entry["parked"]:
+                entry["parked"] = True
+                self.metrics.inc("trainingjob_crashloop_quarantined_total")
+                self.recorder.event(
+                    job, EventRecorder.WARNING,
+                    constants.CRASHLOOP_QUARANTINED_REASON,
+                    f"{rt} restarted {entry['fails']} times in under "
+                    f"{window:.0f}s each; parking restarts at a flat "
+                    f"{delay:.0f}s cadence until a clean run")
+            hold = entry["last"] + delay - now_ts
+            if hold > 0:
+                self.enqueue_job(job, delay=max(hold, 0.1))
+                return (TrainingJobPhase.NONE,
+                        f"{rt} crash-loop quarantined; next restart "
+                        f"attempt in {hold:.1f}s")
+        return None
+
+    def _crashloop_note(self, job: TPUTrainingJob, rtype: str,
+                        now_ts: float) -> None:
+        """Record that a restart actually happened (feeds _crashloop_gate)."""
+        table = getattr(self, "_crashloop", None)
+        if table is None:
+            return
+        entry = table.get(
+            f"{job.metadata.uid or meta_namespace_key(job)}/{rtype}")
+        if entry is not None:
+            entry["fails"] += 1
+            entry["last"] = now_ts
+
     def _restart_pods(self, job: TPUTrainingJob, rtype: str, rt: str, pod: Pod,
                       all_pods: List[Pod], pod_slices: List[List[Pod]],
                       phase: str, msg: str,
@@ -728,10 +836,15 @@ class PodReconciler:
         (reference: pod.go:208-250).  Scope Resize takes the
         survivor-keepalive fast path (docs/ELASTIC.md) and only downgrades
         to the ALL drain when survivors would fall below the width floor."""
+        now_ts = time.time()
+        parked = self._crashloop_gate(job, rtype, rt, now_ts)
+        if parked is not None:
+            return parked
         force = phase == TrainingJobPhase.NODE_FAIL
         grace = 0 if force else None
         self._update_restart_count(job, rtype)
         self.metrics.inc("trainingjob_restarts_total")
+        self._crashloop_note(job, rtype, now_ts)
         msg = f"restart times is {job.status.restart_counts.get(rtype, 0)}, {msg} "
         spec = job.spec.replica_specs[rtype]
         scope = spec.restart_scope
@@ -749,7 +862,21 @@ class PodReconciler:
         self.recorder.event(job, EventRecorder.WARNING, constants.RESTARTING_REASON,
                             f"restarting scope={scope} trigger={pod.name}: {msg}")
         if scope == RestartScope.POD:
-            self.pod_control.delete_pod(pod.namespace, pod.name, job, grace_period=grace)
+            victims = [pod]
+            if force and node_ready is not None:
+                # Domain-aware teardown: a slice-wide failure downs every
+                # node in the domain together, so take down ALL of this
+                # group's pods stranded on dead nodes in this one pass --
+                # one restart count, one event, one reconcile -- instead of
+                # N independent NODE_FAIL discoveries.
+                victims += [p for pslice in pod_slices for p in pslice
+                            if p is not pod and p.spec.node_name
+                            and p.spec.node_name not in node_ready]
+            for p in victims:
+                self.pod_control.delete_pod(p.namespace, p.name, job,
+                                            grace_period=grace)
+            if len(victims) > 1:
+                msg += f"(domain teardown: {len(victims)} pods on dead nodes) "
             return TrainingJobPhase.RESTARTING, msg
         if scope == RestartScope.REPLICA:
             for pslice in pod_slices:
@@ -1067,8 +1194,48 @@ class PodReconciler:
     # -- node health (reference: pod.go:439-455, via informer per SURVEY §8) -
 
     def get_node_status(self) -> Dict[str, bool]:
-        return {node.name: True for node in self.node_lister.list()
-                if node.is_ready()}
+        """Ready-node map, flap-damped: a node NotReady for less than
+        ``TRAININGJOB_NODE_FLAP_GRACE_S`` (default 0 = damping off, the
+        historical behavior) is still reported ready, so a transient flap
+        debounces instead of amplifying into a NODE_FAIL restart storm.
+        Suppressed nodes land in ``self._flap_pending`` (name ->
+        (not_ready_since, grace_deadline)); reconcile_pods re-queues
+        affected jobs at the deadline so the suppression RESOLVES -- the
+        node either recovered by then or NODE_FAIL fires one grace late."""
+        grace = _env_float(constants.NODE_FLAP_GRACE_ENV, 0.0)
+        now_ts = time.time()
+        first_seen = getattr(self, "_flap_first_seen", None)
+        if first_seen is None:
+            first_seen = self._flap_first_seen = {}
+        ready: Dict[str, bool] = {}
+        pending: Dict[str, Tuple[float, float]] = {}
+        for node in self.node_lister.list():
+            if node.is_ready():
+                ready[node.name] = True
+                first_seen.pop(node.name, None)
+                continue
+            if grace <= 0.0:
+                continue
+            since = self._not_ready_since(node)
+            if since is None:
+                # No stamped transition (e.g. a conditionless node): time
+                # the grace from our own first observation.
+                while len(first_seen) >= 1024:  # bound across node churn
+                    first_seen.pop(next(iter(first_seen)))
+                since = first_seen.setdefault(node.name, now_ts)
+            if now_ts - since < grace:
+                ready[node.name] = True
+                pending[node.name] = (since, since + grace)
+        self._flap_pending = pending
+        return ready
+
+    @staticmethod
+    def _not_ready_since(node: Node) -> Optional[float]:
+        for cond in node.status.conditions:
+            if (cond.type == NodeConditionType.READY
+                    and cond.status != ConditionStatus.TRUE):
+                return cond.last_transition_time
+        return None
 
     def get_pod_scheduling_message(self, pod: Pod) -> str:
         """Reference: pod.go:457-467."""

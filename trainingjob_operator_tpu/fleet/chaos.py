@@ -46,6 +46,12 @@ FAULT_CONFLICT = "conflict"         # optimistic-concurrency conflict storm
 #: object kind, so these are KIND strings, not resource names).
 WATCHED_KINDS = (constants.KIND, "Pod", "Service")
 
+#: Node-fault kinds (the data-plane stream, executed by the sim's event
+#: kernel -- runtime/sim.py schedule_node_faults).
+FAULT_NODE_FLAP = "node_flap"       # NotReady for `down` seconds, recovers
+FAULT_NODE_DOWN = "node_down"       # one node dies permanently
+FAULT_DOMAIN_DOWN = "domain_down"   # a whole slice's nodes die together
+
 
 @dataclass(frozen=True)
 class ChaosProfile:
@@ -85,6 +91,26 @@ class ChaosProfile:
     stale_rate: float = 0.10
     #: Length of the stale-list decision stream.
     stale_decisions: int = 2000
+    #: Data-plane node-fault streams (all default 0 = no node chaos, which
+    #: keeps every pre-existing profile's plan byte-identical): transient
+    #: NotReady flaps, permanent single-node deaths, and failure-domain
+    #: kills that down every node sharing a slice label together.
+    node_flaps: int = 0
+    #: Seconds a flapped node stays NotReady, drawn uniformly.
+    flap_down: Tuple[float, float] = (0.3, 0.9)
+    node_kills: int = 0
+    domain_kills: int = 0
+
+
+@dataclass(frozen=True)
+class NodeFault:
+    at: float         # seconds from chaos attach
+    kind: str         # FAULT_NODE_FLAP | FAULT_NODE_DOWN | FAULT_DOMAIN_DOWN
+    #: Abstract victim id, resolved at schedule time against the sorted
+    #: live node (or slice) list as ``target % len(candidates)`` -- the
+    #: plan stays a pure function of the seed, never of cluster size.
+    target: int
+    down: float       # NotReady seconds for flaps; 0.0 for permanent kills
 
 
 @dataclass(frozen=True)
@@ -112,6 +138,8 @@ class ChaosPlan:
     drops: Tuple[WatchDrop, ...] = ()
     #: Decision stream for stale list reads (True = serve stale).
     stale: Tuple[bool, ...] = ()
+    #: Data-plane node faults, sorted by fire time.
+    node_faults: Tuple[NodeFault, ...] = ()
 
     def canonical(self) -> str:
         """Canonical JSON of the full fault schedule (profile included):
@@ -123,6 +151,8 @@ class ChaosPlan:
             "spikes": [[s.start, s.end, s.delay] for s in self.spikes],
             "drops": [[d.at, d.gap, d.kind] for d in self.drops],
             "stale": [int(b) for b in self.stale],
+            "node_faults": [[f.at, f.kind, f.target, f.down]
+                            for f in self.node_faults],
         }
         return json.dumps(doc, sort_keys=True, separators=(",", ":"))
 
@@ -176,5 +206,26 @@ class ChaosGenerator:
         stale = tuple(rng.random() < p.stale_rate
                       for _ in range(p.stale_decisions))
 
+        # Node-fault draws come LAST: appending streams never perturbs the
+        # draws above, so a control-plane-only profile's plan stays
+        # byte-identical to what the same seed produced before the
+        # data-plane streams existed.
+        node_faults: List[NodeFault] = []
+        for _ in range(p.node_flaps):
+            node_faults.append(NodeFault(
+                at=rng.uniform(0.0, p.duration), kind=FAULT_NODE_FLAP,
+                target=rng.randrange(1 << 16),
+                down=rng.uniform(*p.flap_down)))
+        for _ in range(p.node_kills):
+            node_faults.append(NodeFault(
+                at=rng.uniform(0.0, p.duration), kind=FAULT_NODE_DOWN,
+                target=rng.randrange(1 << 16), down=0.0))
+        for _ in range(p.domain_kills):
+            node_faults.append(NodeFault(
+                at=rng.uniform(0.0, p.duration), kind=FAULT_DOMAIN_DOWN,
+                target=rng.randrange(1 << 16), down=0.0))
+        node_faults.sort(key=lambda f: (f.at, f.kind, f.target))
+
         return ChaosPlan(profile=p, decisions=decisions,
-                         spikes=spikes, drops=drops, stale=stale)
+                         spikes=spikes, drops=drops, stale=stale,
+                         node_faults=tuple(node_faults))

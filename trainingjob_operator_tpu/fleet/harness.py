@@ -305,6 +305,10 @@ class FleetReport:
     #: Controller write retries absorbed by client/retry.py during this run
     #: (sum of trainingjob_api_retries_total across verbs).
     api_retries_total: int = 0
+    #: Pod restarts the controller performed during this run (delta of
+    #: ``trainingjob_restarts_total``) -- the node-chaos bench compares this
+    #: between damped and undamped arms (restart amplification).
+    restarts_total: int = 0
     #: Chaos summary when a chaos profile ran: seed, plan digest, injected
     #: fault counts by kind, informer relists.  None on a clean run.
     chaos: Optional[Dict[str, Any]] = None
@@ -334,14 +338,22 @@ class FleetReport:
             "unattributed_downtime_ms": round(self.unattributed_downtime_ms,
                                               3),
             "api_retries_total": self.api_retries_total,
+            "restarts_total": self.restarts_total,
             "chaos": self.chaos,
         }
 
 
-def build_job(plan: JobPlan, with_ports: bool = False) -> TPUTrainingJob:
+def build_job(plan: JobPlan, with_ports: bool = False,
+              node_fail_restart: bool = False) -> TPUTrainingJob:
     """A sim-runnable job from a plan.  No container ports by default: the
     service reconciler then creates nothing, which keeps a 100k-replica run
-    about pods (ports=True doubles the object count for DNS realism)."""
+    about pods (ports=True doubles the object count for DNS realism).
+
+    ``node_fail_restart`` (node-chaos runs) gives every job
+    ``ON_NODE_FAIL_WITH_EXIT_CODE`` restart semantics -- the realistic TPU
+    training config: a dead node restarts the group instead of terminally
+    failing the job, so node faults are survivable and restart counts
+    measure the controller's damping (docs/CHAOS.md)."""
     ports = ([ContainerPort(name="aitj-7777", container_port=7777)]
              if with_ports else [])
     template = PodTemplateSpec(
@@ -353,12 +365,16 @@ def build_job(plan: JobPlan, with_ports: bool = False) -> TPUTrainingJob:
     job = TPUTrainingJob(metadata=ObjectMeta(
         name=plan.name, namespace=plan.namespace))
     replica_kw: Dict[str, Any] = {}
-    if plan.fate == FATE_POD_FAIL:
+    if node_fail_restart:
+        replica_kw = dict(
+            restart_policy=RestartPolicy.ON_NODE_FAIL_WITH_EXIT_CODE,
+            restart_scope=RestartScope.ALL)
+    elif plan.fate == FATE_POD_FAIL:
         replica_kw = dict(restart_policy=RestartPolicy.EXIT_CODE,
                           restart_scope=RestartScope.ALL)
     job.spec.replica_specs[RTYPE] = ReplicaSpec(
         replicas=plan.replicas, template=template, **replica_kw)
-    if plan.fate == FATE_POD_FAIL:
+    if node_fail_restart or plan.fate == FATE_POD_FAIL:
         job.spec.restarting_exit_code = "137,143"
     return job
 
@@ -374,6 +390,7 @@ class FleetHarness:
                  sim_tick: float = 0.02, sim_kernel: Optional[str] = None,
                  max_wall_seconds: float = 0.0,
                  chaos_profile: Optional[ChaosProfile] = None,
+                 nodes_per_slice: int = 4,
                  progress: Optional[Callable[[str], None]] = None):
         self.profile = profile
         self.workers = workers
@@ -399,6 +416,10 @@ class FleetHarness:
         # controller's API view and watch streams ride the chaos plane while
         # the sim and the driver keep the clean view.
         self.chaos_profile = chaos_profile
+        # Failure-domain granularity: every ``nodes_per_slice`` sim nodes
+        # share one NODE_SLICE_LABEL value, so a plan's domain_down fault
+        # kills a correlated group (docs/CHAOS.md).
+        self.nodes_per_slice = max(1, nodes_per_slice)
         self._progress = progress or (lambda _msg: None)
         self.violations: List[str] = []
 
@@ -436,13 +457,16 @@ class FleetHarness:
                          pods_per_node=self.pods_per_node,
                          kernel=self.sim_kernel)
         for i in range(max(1, math.ceil(total_replicas / self.pods_per_node))):
-            sim.add_node(f"fleet-n{i:04d}")
+            sim.add_node(f"fleet-n{i:04d}", labels={
+                constants.NODE_SLICE_LABEL:
+                    f"slice-{i // self.nodes_per_slice:03d}"})
         recorder = _LatencyRecorder(cs)
 
         sync_count_before = self._sync_count()
         retries_before = self._counter_sum("trainingjob_api_retries_total")
         relists_before = self._counter_sum(
             "trainingjob_informer_relists_total")
+        restarts_before = self._counter_sum("trainingjob_restarts_total")
         sim.start()
         tc.run(workers=self.workers)
         if monkey is not None:
@@ -453,11 +477,31 @@ class FleetHarness:
             monkey.attach()
             for w_kind, w_start, w_end in monkey.windows_abs():
                 INCIDENTS.record_chaos_window(w_kind, w_start, w_end)
+            if chaos_plan is not None and chaos_plan.node_faults:
+                if self.sim_kernel != "event":
+                    self.violations.append(
+                        "node faults planned but the scan kernel cannot "
+                        "schedule them (use the event kernel)")
+                else:
+                    # Data-plane faults execute inside the sim's timer-queue
+                    # kernel: flaps thaw (not exit-137) on recovery, kills
+                    # stay dead, domain kills down every node in one slice.
+                    sim.schedule_node_faults(chaos_plan.node_faults,
+                                             on_fault=monkey.record_fault)
         started = time.monotonic()
         downtime_phases: Dict[str, Any] = {}
         unattributed = 0.0
         try:
             self._drive(cs, sim, recorder, plans, started)
+            # Let every planned node fault fire (and every flap recover)
+            # before judging: a fault landing after the verdict would
+            # un-settle jobs and make the final phase counts racy.
+            if self._node_faults_planned():
+                fault_deadline = time.monotonic() + (
+                    self.chaos_profile.duration + 30.0)
+                while (sim.pending_node_faults()
+                       and time.monotonic() < fault_deadline):
+                    time.sleep(0.05)
             converged = self._await_convergence(cs, tc, plans)
             # Harvest incident bundles BEFORE the GC sweep: deleting a
             # finished job makes the next sync forget its incident state.
@@ -483,6 +527,8 @@ class FleetHarness:
         sync_count = self._sync_count() - sync_count_before
         api_retries = int(self._counter_sum("trainingjob_api_retries_total")
                           - retries_before)
+        restarts_total = int(self._counter_sum("trainingjob_restarts_total")
+                             - restarts_before)
         chaos_report: Optional[Dict[str, Any]] = None
         if monkey is not None and chaos_plan is not None:
             chaos_report = {
@@ -518,6 +564,7 @@ class FleetHarness:
             downtime_phases=downtime_phases,
             unattributed_downtime_ms=unattributed,
             api_retries_total=api_retries,
+            restarts_total=restarts_total,
             chaos=chaos_report,
         )
 
@@ -576,6 +623,12 @@ class FleetHarness:
         return sum(v for k, v in METRICS.snapshot().items()
                    if k.startswith(prefix) and isinstance(v, (int, float)))
 
+    def _node_faults_planned(self) -> bool:
+        """True when the chaos profile draws any data-plane node faults."""
+        p = self.chaos_profile
+        return p is not None and bool(
+            p.node_flaps or p.node_kills or p.domain_kills)
+
     # -- schedule driver -----------------------------------------------------
 
     def _drive(self, cs: Clientset, sim: SimRuntime,
@@ -600,7 +653,9 @@ class FleetHarness:
                     time.sleep(delay)
             if kind == "create":
                 recorder.mark_create(plan.key)
-                cs.trainingjobs.create(build_job(plan, self.with_ports))
+                cs.trainingjobs.create(build_job(
+                    plan, self.with_ports,
+                    node_fail_restart=self._node_faults_planned()))
             elif kind == FATE_PREEMPT:
                 self._fire_preempt(cs, recorder, plan)
             elif kind == FATE_DELETE:
@@ -811,6 +866,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default=_env_opt_int(constants.CHAOS_SEED_ENV),
                     help="Chaos plan seed (default: TRAININGJOB_CHAOS_SEED, "
                          "else --seed).")
+    ap.add_argument("--node-chaos", action="store_true",
+                    help="Add seeded data-plane node faults to the plan "
+                         "(implies --chaos): transient flaps that thaw, "
+                         "permanent node kills, failure-domain kills.")
+    ap.add_argument("--node-flaps", type=int, default=3,
+                    help="Transient NotReady->recover flaps in the plan "
+                         "(with --node-chaos).")
+    ap.add_argument("--node-kills", type=int, default=1,
+                    help="Permanent single-node kills in the plan.")
+    ap.add_argument("--domain-kills", type=int, default=1,
+                    help="Failure-domain kills (every node in one slice).")
+    ap.add_argument("--nodes-per-slice", type=int, default=4,
+                    help="Sim nodes per failure domain (slice label).")
     ap.add_argument("--quiet", action="store_true",
                     help="Suppress progress lines; print only the report.")
     args = ap.parse_args(argv)
@@ -819,13 +887,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         jobs=args.jobs, duration=args.duration, seed=args.seed,
         replicas=(args.replicas_min, args.replicas_max))
     chaos_profile = None
-    if args.chaos:
+    if args.chaos or args.node_chaos:
         chaos_seed = (args.chaos_seed if args.chaos_seed is not None
                       else args.seed)
         # Fault windows cover the arrival window plus the settling tail so
         # drops/spikes land while the controller still has work in flight.
+        node_kw: Dict[str, Any] = {}
+        if args.node_chaos:
+            node_kw = dict(node_flaps=args.node_flaps,
+                           node_kills=args.node_kills,
+                           domain_kills=args.domain_kills)
         chaos_profile = ChaosProfile(seed=chaos_seed,
-                                     duration=args.duration + 2.0)
+                                     duration=args.duration + 2.0,
+                                     **node_kw)
     progress = None if args.quiet else (
         lambda msg: print(f"[fleet] {msg}", file=sys.stderr, flush=True))
     harness = FleetHarness(
@@ -834,7 +908,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         resync_period=args.resync_period, gc_interval=args.gc_interval,
         pods_per_node=args.pods_per_node, with_ports=args.with_ports,
         sim_kernel=args.sim_kernel, max_wall_seconds=args.max_wall_seconds,
-        chaos_profile=chaos_profile, progress=progress)
+        chaos_profile=chaos_profile, nodes_per_slice=args.nodes_per_slice,
+        progress=progress)
     report = harness.run()
     print(json.dumps(report.to_dict(), indent=2))
     return 0 if report.converged else 1

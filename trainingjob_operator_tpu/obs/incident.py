@@ -130,8 +130,11 @@ class _JobIncidents:
         #: the create/delete markers attribution depends on.
         self.steps: Deque[Tuple[float, int, float, Optional[float],
                                 Optional[float]]] = deque(maxlen=ring)
-        #: (ts, restore_ms, compile_ms, overlapped) resume-span records.
-        self.resumes: Deque[Tuple[float, float, float, bool]] = deque(maxlen=8)
+        #: (ts, restore_ms, compile_ms, overlapped, fallback) resume-span
+        #: records; ``fallback`` is the structured checkpoint-fallback
+        #: reason ("" when the restore took the happy path).
+        self.resumes: Deque[Tuple[float, float, float, bool, str]] = \
+            deque(maxlen=8)
         #: (ts, total_ms, rung, reason, phases) live-rebootstrap records
         #: (docs/ELASTIC.md): the survivor reporting which fallback rung its
         #: re-rendezvous took and how long it spent there.  ``phases`` is a
@@ -153,7 +156,7 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
                events: Tuple[Tuple[float, str, str], ...],
                steps: Tuple[Tuple[float, int, float, Optional[float],
                                   Optional[float]], ...],
-               resumes: Tuple[Tuple[float, float, float, bool], ...],
+               resumes: Tuple[Tuple[float, float, float, bool, str], ...],
                rendezvous: Tuple[Tuple[float, float, str, str,
                                        Tuple[Tuple[str, float], ...]], ...]
                = (),
@@ -230,7 +233,7 @@ def _attribute(kind: str, t0: float, t1c: float, t_end: float,
         # (resume completion - resume duration).  Overlapped restore+compile
         # charges ``compile`` only the non-hidden tail, matching the
         # ~max(restore, compile) wall cost docs/RECOVERY.md measures.
-        ts_r, restore_ms, compile_ms, overlapped = resume[-1]
+        ts_r, restore_ms, compile_ms, overlapped = resume[-1][:4]
         extra_ms = (max(compile_ms - restore_ms, 0.0) if overlapped
                     else compile_ms)
         b_rdv = _clamp(ts_r - (restore_ms + extra_ms) / 1e3, b_resched, t_end)
@@ -277,7 +280,7 @@ def _assemble(inc: Dict[str, Any],
               events: Tuple[Tuple[float, str, str], ...],
               steps: Tuple[Tuple[float, int, float, Optional[float],
                                  Optional[float]], ...],
-              resumes: Tuple[Tuple[float, float, float, bool], ...],
+              resumes: Tuple[Tuple[float, float, float, bool, str], ...],
               rendezvous: Tuple[Tuple[float, float, str, str,
                                       Tuple[Tuple[str, float], ...]], ...]
               = (),
@@ -306,11 +309,19 @@ def _assemble(inc: Dict[str, Any],
         if hbm_bytes is not None:
             entry["hbm_bytes"] = hbm_bytes
         timeline.append(entry)
-    for ts, restore_ms, compile_ms, overlapped in resumes:
-        timeline.append({"ts": round(ts, 6), "kind": "resume",
-                         "restore_ms": round(restore_ms, 3),
-                         "compile_ms": round(compile_ms, 3),
-                         "overlapped": overlapped})
+    for record in resumes:
+        ts, restore_ms, compile_ms, overlapped = record[:4]
+        fallback = record[4] if len(record) > 4 else ""
+        entry = {"ts": round(ts, 6), "kind": "resume",
+                 "restore_ms": round(restore_ms, 3),
+                 "compile_ms": round(compile_ms, 3),
+                 "overlapped": overlapped}
+        if fallback:
+            # Structured checkpoint-fallback reason (missing/stale/corrupt/
+            # structure_mismatch/corrupt_latest...): only present when the
+            # restore degraded, so happy-path bundles stay byte-identical.
+            entry["fallback"] = fallback
+        timeline.append(entry)
     for ts, total_ms, rung, why, rdv_phases in rendezvous:
         entry = {"ts": round(ts, 6), "kind": "rendezvous",
                  "total_ms": round(total_ms, 3), "rung": rung}
@@ -487,16 +498,19 @@ class IncidentRecorder:
         self._emit(emit)
 
     def record_resume(self, job: str, restore_ms: float, compile_ms: float,
-                      overlapped: bool, now: Optional[float] = None) -> None:
+                      overlapped: bool, now: Optional[float] = None,
+                      fallback: str = "") -> None:
         """The workload finished ``overlapped_restore`` (resume.restore /
-        resume.compile spans, pushed as a telemetry resume record)."""
+        resume.compile spans, pushed as a telemetry resume record).
+        ``fallback`` carries the structured checkpoint-fallback reason when
+        the restore degraded (docs/RECOVERY.md integrity ladder)."""
         now = time.time() if now is None else now
         with self._lock:
             st = self._jobs.get(job)
             if st is None or st.completed:
                 return
             st.resumes.append((now, float(restore_ms), float(compile_ms),
-                               bool(overlapped)))
+                               bool(overlapped), str(fallback)))
 
     def record_rendezvous(self, job: str, total_ms: float, rung: str,
                           reason: str = "",

@@ -244,6 +244,7 @@ class TelemetryAggregator:
                 restore_ms = float(record["resume_restore_ms"])
                 compile_ms = float(record.get("resume_compile_ms", 0.0))
                 overlapped = bool(record.get("resume_overlapped", False))
+                fallback = str(record.get("resume_fallback", ""))
             except (TypeError, KeyError, ValueError):
                 self._metrics.inc("trainingjob_telemetry_malformed_total")
                 return False
@@ -252,7 +253,8 @@ class TelemetryAggregator:
                 return False
             if self._incidents is not None:
                 self._incidents.record_resume(job, restore_ms, compile_ms,
-                                              overlapped, now=now)
+                                              overlapped, now=now,
+                                              fallback=fallback)
             return True
         if isinstance(record, dict) and "rendezvous_ms" in record:
             # Live re-rendezvous record (workloads/train.py
@@ -880,18 +882,24 @@ class TelemetryEmitter:
         self._send(record)
 
     def emit_resume(self, restore_ms: float, compile_ms: float,
-                    overlapped: bool) -> None:
+                    overlapped: bool, fallback: str = "") -> None:
         """One resume completed (train.overlapped_restore): push the span
         durations so the controller's incident bundle can attribute the
-        restore/compile tail of the downtime it already measured."""
+        restore/compile tail of the downtime it already measured.
+        ``fallback`` is the structured checkpoint-fallback reason when the
+        restore degraded (docs/RECOVERY.md integrity ladder); "" rides the
+        happy path and is omitted from the wire record."""
         if not self.enabled or time.monotonic() < self._down_until:
             return
-        self._send({
+        record: Dict[str, Any] = {
             "v": 1, "job": self.job, "rtype": self.rtype, "rank": self.rank,
             "resume_restore_ms": round(restore_ms, 3),
             "resume_compile_ms": round(compile_ms, 3),
             "resume_overlapped": overlapped, "ts": time.time(),
-        })
+        }
+        if fallback:
+            record["resume_fallback"] = fallback
+        self._send(record)
 
     def emit_rendezvous(self, total_ms: float, rung: str, reason: str = "",
                         phase_ms: Optional[Dict[str, float]] = None) -> None:

@@ -150,6 +150,8 @@ class _PodRuntime:
     exit_code: int = 0
     terminating_since: Optional[float] = None
     frozen_on: str = ""  # node whose failure froze this pod's reports
+    frozen_at: float = 0.0  # when the freeze started (thaw shifts clocks by it)
+    frozen_exit_at: Optional[float] = None  # exit deadline saved across a flap
     steps_reported: int = 0
     generation_reported: int = 0  # newest rendezvous generation synthesized
 
@@ -177,6 +179,12 @@ class SimRuntime(PodStateRuntime):
         self._timers = TimerQueue()
         self._pending: set = set()
         self.events_total = 0
+        # Scheduled data-plane faults (schedule_node_faults): timer key ->
+        # (fault, resolved node targets, on_fault callback); plus the set
+        # of permanently killed nodes, so a flap-recovery timer landing on
+        # a node a later domain kill took down never resurrects it.
+        self._node_faults: Dict[str, tuple] = {}
+        self._node_dead: set = set()
         # Watch-fed pod/node caches: at fleet scale a per-tick
         # ``pods.list()`` deepcopies the whole store (100k pods x 200 Hz is
         # the difference between a working sim and one that never catches
@@ -347,30 +355,148 @@ class SimRuntime(PodStateRuntime):
         NodeFail detector must handle (pod.go:407-419)."""
         self.set_node_ready(name, False)
         if kill_pods:
+            now = time.time()
             with self._lock:
                 for key, rt in self._state.items():
                     pod = self._pods_cache.get(key)
                     if pod is not None and pod.spec.node_name == name:
+                        rt.frozen_exit_at = rt.will_exit_at  # thaw restores it
                         rt.will_exit_at = None  # frozen: no further reports
                         rt.frozen_on = name
+                        rt.frozen_at = now
                         if self._kernel == "event":
                             self._timers.cancel(key, "exit")
                             self._timers.cancel(key, "step")
                             self._timers.cancel(key, "serve")
 
-    def recover_node(self, name: str) -> None:
-        """Node comes back Ready.  Pods whose processes were frozen by
-        fail_node are reported dead (exit 137), like a recovering kubelet
-        reporting its containers gone."""
+    def recover_node(self, name: str, dead: bool = True) -> None:
+        """Node comes back Ready.  ``dead=True`` (the default, and the
+        historical behavior): pods frozen by fail_node are reported dead
+        (exit 137), like a recovering kubelet reporting its containers
+        gone.  ``dead=False`` models a *flap* -- the host was unreachable
+        but its processes kept running -- so frozen pods thaw: their step
+        and exit clocks shift by the pause so they resume telemetry where
+        they left off instead of tripping the stall watchdog."""
         self.set_node_ready(name, True)
+        now = time.time()
         with self._lock:
             for key, rt in self._state.items():
-                if rt.frozen_on == name:
-                    rt.will_exit_at = time.time()
+                if rt.frozen_on != name:
+                    continue
+                if dead:
+                    rt.will_exit_at = now
                     rt.exit_code = 137
                     rt.frozen_on = ""
+                    rt.frozen_exit_at = None
                     if self._kernel == "event":
                         self._arm_now_locked(key, "exit")
+                else:
+                    pause = now - rt.frozen_at if rt.frozen_at else 0.0
+                    if rt.started_at:
+                        rt.started_at += pause  # step targets don't jump
+                    if rt.frozen_exit_at is not None:
+                        shifted = rt.frozen_exit_at + pause
+                        # A kill delivered DURING the freeze (preempt_pod
+                        # stamped a fresh will_exit_at) must still win:
+                        # keep the earliest exit.
+                        rt.will_exit_at = (shifted if rt.will_exit_at is None
+                                           else min(rt.will_exit_at, shifted))
+                    rt.frozen_on = ""
+                    rt.frozen_at = 0.0
+                    rt.frozen_exit_at = None
+                    if self._kernel == "event":
+                        pod = self._pods_cache.get(key)
+                        if pod is not None:
+                            self._arm_for_pod_locked(key, pod, now)
+
+    def schedule_node_faults(self, faults, on_fault=None) -> int:
+        """Arm a ChaosPlan's data-plane stream (fleet/chaos.py
+        ``node_faults``) on the event kernel's timer queue.  Each fault's
+        abstract ``target`` is resolved NOW against the sorted live node
+        list -- ``target % len(candidates)`` -- (domain kills resolve
+        against the sorted set of ``NODE_SLICE_LABEL`` values and down
+        every node in the chosen slice together), so the same plan on the
+        same cluster always hits the same victims.  Flaps arm a
+        ``chaos_recover`` timer ``down`` seconds after the hit and thaw
+        with ``recover_node(dead=False)``; node/domain kills are permanent
+        (a flap timer landing on a dead node is a no-op).  ``on_fault``
+        is called with the fault kind as each entry fires.  Returns the
+        number of faults scheduled.  Event kernel only: the scan kernel
+        has no timer queue to carry the schedule."""
+        if not faults:
+            return 0
+        if self._kernel != "event":
+            raise RuntimeError(
+                "schedule_node_faults requires the event kernel")
+        now = time.time()
+        scheduled = 0
+        with self._lock:
+            nodes = sorted(self._nodes_cache)
+            domains: Dict[str, List[str]] = {}
+            for name in nodes:
+                slice_label = self._nodes_cache[name].metadata.labels.get(
+                    constants.NODE_SLICE_LABEL)
+                if slice_label:
+                    domains.setdefault(slice_label, []).append(name)
+            for i, fault in enumerate(faults):
+                if fault.kind == "domain_down":
+                    if not domains:
+                        continue
+                    doms = sorted(domains)
+                    targets = tuple(domains[doms[fault.target % len(doms)]])
+                else:
+                    if not nodes:
+                        continue
+                    targets = (nodes[fault.target % len(nodes)],)
+                key = f"@chaos/{i}"
+                self._node_faults[key] = (fault, targets, on_fault)
+                self._arm(key, "chaos", now + fault.at)
+                scheduled += 1
+        return scheduled
+
+    def pending_node_faults(self) -> int:
+        """Scheduled node faults that have not finished firing (a flap
+        counts until its recovery timer has run).  Drivers wait for zero
+        before judging convergence: a fault firing after the verdict would
+        un-settle jobs nondeterministically."""
+        with self._lock:
+            return len(self._node_faults)
+
+    def _fire_node_fault(self, key: str, now: float) -> None:
+        with self._lock:
+            entry = self._node_faults.get(key)
+        if entry is None:
+            return
+        fault, targets, on_fault = entry
+        hit = False
+        for name in targets:
+            if fault.kind == "node_flap":
+                if name in self._node_dead:
+                    continue  # permanently killed meanwhile: stays down
+            else:
+                self._node_dead.add(name)
+            self.fail_node(name)
+            hit = True
+        if hit and on_fault is not None:
+            try:
+                on_fault(fault.kind)
+            except Exception:
+                log.exception("node-fault callback failed for %s", key)
+        if fault.kind == "node_flap":
+            self._arm(key, "chaos_recover", now + fault.down)
+        else:
+            with self._lock:
+                self._node_faults.pop(key, None)
+
+    def _fire_node_recover(self, key: str, now: float) -> None:
+        with self._lock:
+            entry = self._node_faults.pop(key, None)
+        if entry is None:
+            return
+        _, targets, _ = entry
+        for name in targets:
+            if name not in self._node_dead:
+                self.recover_node(name, dead=False)
 
     def preempt_pod(self, namespace: str, name: str, exit_code: int = 137) -> None:
         """SIGKILL analogue: container dies with the given code now."""
@@ -563,6 +689,10 @@ class SimRuntime(PodStateRuntime):
                     self._fire_serve(key, deadline, now)
                 elif kind == "sched":
                     self._fire_sched()
+                elif kind == "chaos":
+                    self._fire_node_fault(key, now)
+                elif kind == "chaos_recover":
+                    self._fire_node_recover(key, now)
                 elif kind == "watchdog":
                     TELEMETRY.check_stalls(now)
                     nxt = deadline + self._tick

@@ -18,6 +18,7 @@ the global array, so elastic resume needs no gather/re-shard choreography.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import logging
 import os
 import signal
@@ -29,6 +30,7 @@ from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.obs.logs import configure_logging, get_logger
 from trainingjob_operator_tpu.obs.telemetry import TelemetryEmitter
 from trainingjob_operator_tpu.obs.trace import tracer_from_env
+from trainingjob_operator_tpu.utils.metrics import METRICS
 from trainingjob_operator_tpu.workloads.rendezvous import Rendezvous
 
 
@@ -203,8 +205,8 @@ class CheckpointState:
                     template = jax.tree.map(abstract, init_value)
                     restored = _load_resume_image(path, latest, template)
                     if restored is None:
-                        restored = manager.restore(
-                            latest, args=ocp.args.StandardRestore(template))
+                        restored = _orbax_restore_with_fallback(
+                            manager, latest, template)
             return cls(path, restored, manager)
         return cls(path, init_value, manager)
 
@@ -371,6 +373,27 @@ def _snapshot_to_host(value: Any) -> Any:
 #: beside the orbax step dirs (single-process snapshot pipeline only).
 _RESUME_IMAGE = "resume-image.bin"
 
+#: Bytes of the sha256 footer appended to the resume-image pickle: read
+#: verifies payload integrity BEFORE unpickling, so a torn or bit-rotted
+#: image classifies as ``corrupt`` instead of surfacing as an arbitrary
+#: unpickling exception (or, worse, silently wrong state).
+_CKPT_SHA_LEN = 32
+
+#: Structured reason of the most recent checkpoint fallback taken in this
+#: process ("" = happy path).  Set by the integrity ladder
+#: (``_load_resume_image`` / ``_orbax_restore_with_fallback``), consumed
+#: and cleared by ``_push_resume_record`` so the resume telemetry record
+#: carries the reason onto the incident bundle (obs/incident.py).
+_LAST_RESUME_FALLBACK = ""
+
+
+def _note_fallback_metric(metric: str, reason: str) -> None:
+    """Record one classified checkpoint fallback: count it per reason and
+    remember the reason for the next resume record."""
+    global _LAST_RESUME_FALLBACK
+    _LAST_RESUME_FALLBACK = reason
+    METRICS.inc(metric, reason=reason)
+
 
 def _write_resume_image(path: str, step: int, host_value: Any) -> None:
     """Persist the host snapshot as a flat **resume image** beside the orbax
@@ -389,9 +412,13 @@ def _write_resume_image(path: str, step: int, host_value: Any) -> None:
     target = os.path.join(path, _RESUME_IMAGE)
     tmp = f"{target}.tmp-{os.getpid()}"
     try:
+        payload = pickle.dumps((step, host_value),
+                               protocol=pickle.HIGHEST_PROTOCOL)
         with open(tmp, "wb") as f:
-            pickle.dump((step, host_value), f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+            # sha256 footer over the pickle payload: the read side verifies
+            # it before unpickling (docs/RECOVERY.md integrity ladder).
+            f.write(payload)
+            f.write(hashlib.sha256(payload).digest())
         os.replace(tmp, target)  # readers see old-or-new, never torn
     # analyzer: allow[broad-except]: the durable orbax commit already
     # succeeded when this runs; a failed image write costs the next resume
@@ -409,38 +436,122 @@ def _load_resume_image(path: str, latest: int, template: Any) -> Any:
     """Resume fast path: rebuild state from the flat image written by
     ``_write_resume_image`` -- one sequential read, one ``device_put`` pass
     onto the template's CURRENT shardings.  Returns ``None`` (caller falls
-    back to the orbax restore) when the fast path is disabled, the job is
-    multi-process (each process must read its own shards), the image is
-    missing or stale (``step != latest``, e.g. a newer sync-mode save
-    superseded it), or anything about reading / re-placing it fails."""
+    back to the orbax restore) with a CLASSIFIED reason -- ``missing``,
+    ``corrupt`` (read error, truncation, sha256 footer mismatch, unpickle
+    failure), ``stale`` (``step != latest``, e.g. a newer sync-mode save
+    superseded it), or ``structure_mismatch`` (template/image tree shape
+    drift) -- counted per reason in
+    ``trainingjob_resume_image_fallbacks_total`` and stamped onto the next
+    resume telemetry record.  ``TRAININGJOB_CKPT_FAULT=resume_image`` flips
+    one byte of the image after the read, deterministically exercising the
+    corrupt rung (docs/RECOVERY.md)."""
     if not resume_fastpath_enabled():
         return None
     import jax
 
     if jax.process_count() != 1:
         return None
+
+    def fall(reason: str, detail: str = "") -> None:
+        _note_fallback_metric("trainingjob_resume_image_fallbacks_total",
+                              reason)
+        suffix = f" ({detail})" if detail else ""
+        print(f"resume: image fallback reason={reason}{suffix}; "
+              f"using orbax restore")
+
     target = os.path.join(path, _RESUME_IMAGE)
     if not os.path.exists(target):
+        fall("missing")
         return None
     import pickle
 
     try:
         with open(target, "rb") as f:
-            step, host_value = pickle.load(f)
-        if step != latest:
-            return None
+            raw = f.read()
+    except OSError as exc:
+        fall("corrupt", f"read failed: {exc!r}")
+        return None
+    if os.environ.get(constants.CKPT_FAULT_ENV, "") == "resume_image" and raw:
+        # Deterministic corruption injection: flip one byte so the sha256
+        # footer check below takes the corrupt rung.
+        raw = bytes([raw[0] ^ 0xFF]) + raw[1:]
+    if len(raw) <= _CKPT_SHA_LEN:
+        fall("corrupt", f"truncated ({len(raw)} bytes)")
+        return None
+    body, footer = raw[:-_CKPT_SHA_LEN], raw[-_CKPT_SHA_LEN:]
+    if hashlib.sha256(body).digest() != footer:
+        fall("corrupt", "sha256 mismatch")
+        return None
+    try:
+        step, host_value = pickle.loads(body)
+    # analyzer: allow[broad-except]: unpickling a verified-but-wrong payload
+    # can raise nearly anything; every failure is the corrupt rung.
+    except Exception as exc:
+        fall("corrupt", f"unpickle failed: {exc!r}")
+        return None
+    if step != latest:
+        fall("stale", f"image step {step} != latest {latest}")
+        return None
+    try:
         restored = jax.tree.map(
             lambda t, x: (jax.device_put(x, t.sharding)
                           if isinstance(t, jax.ShapeDtypeStruct) else x),
             template, host_value)
-        print(f"resume: step {step} restored from resume image")
-        return restored
-    # analyzer: allow[broad-except]: a corrupt or structure-mismatched image
-    # must never fail the resume -- the orbax checkpoint is the source of
-    # truth and restores the same state, just slower.
+    # analyzer: allow[broad-except]: a structure-mismatched image (resumed
+    # with a different model config) must never fail the resume -- the orbax
+    # checkpoint is the source of truth and restores the same state, slower.
     except Exception as exc:
-        print(f"resume: image unusable ({exc!r}); using orbax restore")
+        fall("structure_mismatch", f"{exc!r}")
         return None
+    print(f"resume: step {step} restored from resume image")
+    return restored
+
+
+def _orbax_restore_with_fallback(manager: Any, latest: int,
+                                 template: Any) -> Any:
+    """Orbax restore with a committed-step fallback ladder: try ``latest``
+    first, then walk earlier retained steps (``max_to_keep`` keeps the
+    previous commit around) newest-first.  Each failed rung is counted in
+    ``trainingjob_ckpt_restore_fallbacks_total`` with reason
+    ``corrupt_latest`` (the newest step was unreadable) or
+    ``corrupt_retained`` (an older rung also failed) and stamped onto the
+    resume record.  ``TRAININGJOB_CKPT_FAULT=corrupt_latest`` fails the
+    latest rung deterministically, proving the ladder reaches the previous
+    committed step.  Exhausting every rung re-raises the first error --
+    there is genuinely nothing to restore from."""
+    import orbax.checkpoint as ocp
+
+    steps = sorted({int(s) for s in manager.all_steps()}, reverse=True)
+    if latest not in steps:
+        steps.insert(0, latest)
+    inject = os.environ.get(constants.CKPT_FAULT_ENV, "") == "corrupt_latest"
+    first_err: Optional[BaseException] = None
+    for step in steps:
+        try:
+            if inject and step == latest:
+                raise ValueError(
+                    "injected corrupt checkpoint (TRAININGJOB_CKPT_FAULT="
+                    f"corrupt_latest, step {step})")
+            restored = manager.restore(
+                step, args=ocp.args.StandardRestore(template))
+        # analyzer: allow[broad-except]: a corrupt rung can fail anywhere in
+        # orbax/tensorstore; classify and try the next retained step.
+        except Exception as exc:
+            if first_err is None:
+                first_err = exc
+            reason = ("corrupt_latest" if step == latest
+                      else "corrupt_retained")
+            _note_fallback_metric("trainingjob_ckpt_restore_fallbacks_total",
+                                  reason)
+            print(f"resume: orbax restore of step {step} failed "
+                  f"reason={reason} ({type(exc).__name__}: "
+                  f"{str(exc)[:200]}); trying previous committed step")
+            continue
+        if step != latest:
+            print(f"resume: restored previous committed step {step} "
+                  f"(latest {latest} unreadable)")
+        return restored
+    raise first_err  # every retained step failed; nothing to fall back to
 
 
 def overlapped_restore(restore_fn: Callable[[], Any],
@@ -518,13 +629,17 @@ def _push_resume_record(timings: Dict[str, Any]) -> None:
     inject the address/identity env).  The incident flight recorder uses
     them to split the post-recovery downtime tail into
     rendezvous/restore/compile phases."""
+    global _LAST_RESUME_FALLBACK
+    fallback, _LAST_RESUME_FALLBACK = _LAST_RESUME_FALLBACK, ""
+    timings["fallback"] = fallback
     emitter = TelemetryEmitter()
     if not emitter.enabled:
         return
     try:
         emitter.emit_resume(timings["restore_s"] * 1e3,
                             timings["compile_s"] * 1e3,
-                            bool(timings["overlap"]))
+                            bool(timings["overlap"]),
+                            fallback=fallback)
     finally:
         emitter.close()
 
