@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -28,6 +28,15 @@ lint-diff:
 lint-sarif:
 	$(PY) -m tools.analyze trainingjob_operator_tpu/ tools/ tests/ bench.py --format=sarif > analyze.sarif || true
 	@echo "wrote analyze.sarif"
+
+# Machine-readable shard-state inventory (TJA027): the module-level
+# mutable-singleton ledger ROADMAP item 3 consumes.  Fails when any
+# singleton is unclassified, a registry entry is stale, or something
+# mutates a constant-classified singleton -- i.e. on any shard-hostile
+# write pattern outside the declared registry.
+shard-state-report:
+	$(PY) -m tools.analyze --report shard-state > shard_state.json
+	@echo "wrote shard_state.json"
 
 # Fast suite: the 10k-job fleet run (tests/test_fleet.py) hides behind the
 # slow marker; `make test-slow` opts in.
@@ -128,4 +137,4 @@ resize-smoke:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke

@@ -1681,15 +1681,23 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_twenty_three_checks_registered(self):
+    def test_all_twenty_seven_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
             "TJA007", "TJA008", "TJA009", "TJA015", "TJA018", "TJA019"}
         assert {cid for cid, _fn in runner.PROJECT_REGISTRY.values()} == {
             "TJA010", "TJA011", "TJA012", "TJA013", "TJA014", "TJA016",
-            "TJA017", "TJA020", "TJA021", "TJA022", "TJA023"}
-        assert len(runner.all_checks()) == 23
+            "TJA017", "TJA020", "TJA021", "TJA022", "TJA023", "TJA024",
+            "TJA025", "TJA026", "TJA027"}
+        assert len(runner.all_checks()) == 27
+
+    def test_every_check_has_rule_help(self):
+        """SARIF rule metadata coverage: every registered ID ships a
+        one-line fullDescription (RULE_HELP) -- code scanning shows it on
+        the rule page, so a missing entry is a silent docs gap."""
+        runner._load_checks()
+        assert set(runner.RULE_HELP) == set(runner.all_checks())
 
     def test_sarif_roundtrip(self):
         err = Finding("TJA015", "resource-leak", "a/b.py", 7, 2, "error",
@@ -1702,8 +1710,16 @@ class TestRunnerMachinery:
         (run,) = doc["runs"]
         # Every registered check becomes a rule, so code-scanning can show
         # titles for findings from any pass.
-        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        rules = run["tool"]["driver"]["rules"]
+        rule_ids = {r["id"] for r in rules}
         assert rule_ids == set(runner.all_checks())
+        # Full rule metadata: description, help link, default level
+        # (warning-severity passes downgrade; everything else is error).
+        for r in rules:
+            assert r["fullDescription"]["text"], r["id"]
+            assert "STATIC_ANALYSIS.md" in r["helpUri"]
+            expected = runner.RULE_DEFAULT_LEVELS.get(r["id"], "error")
+            assert r["defaultConfiguration"]["level"] == expected
         first, second = run["results"]
         assert first["ruleId"] == "TJA015" and first["level"] == "error"
         assert first["message"]["text"] == "socket 's' leaks"
@@ -2092,6 +2108,42 @@ class TestChangedSinceMode:
         assert "unchanged.py" not in proc.stdout
         assert "commented.py" not in proc.stdout
 
+    def test_constants_change_widens_project_passes_tree_wide(
+            self, tmp_path):
+        """Editing api/constants.py drops incremental scoping: the
+        registries it declares parameterize project passes, so the edit
+        can land findings in files that did not change -- here, an
+        unchanged module's singleton goes unclassified when its registry
+        entry is deleted."""
+        constants = tmp_path / PKG / "api" / "constants.py"
+        constants.parent.mkdir(parents=True)
+        constants.write_text(
+            "SHARD_STATE_REGISTRY = {\n"
+            '    "api.constants.SHARD_STATE_REGISTRY": "constant",\n'
+            '    "obs.state.CACHE": "shard_local",\n}\n')
+        state = tmp_path / PKG / "obs" / "state.py"
+        state.parent.mkdir(parents=True)
+        state.write_text("CACHE = {}\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "add", "-A")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "-qm", "seed")
+        constants.write_text(
+            "SHARD_STATE_REGISTRY = {\n"
+            '    "api.constants.SHARD_STATE_REGISTRY": "constant",\n}\n')
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", PKG,
+             "--changed-since", "HEAD", "--no-baseline"],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "re-running project passes" in proc.stderr
+        # The finding lands in the *unchanged* file -- exactly what naive
+        # report_only scoping would have swallowed.
+        assert f"{PKG}/obs/state.py" in proc.stdout
+        assert "TJA027" in proc.stdout
+
     def test_exits_zero_fast_when_nothing_changed(self, tmp_path):
         (tmp_path / "ok.py").write_text("def f():\n    return 1\n")
         self._git(tmp_path, "init", "-q")
@@ -2106,3 +2158,387 @@ class TestChangedSinceMode:
             env={**os.environ, "PYTHONPATH": REPO_ROOT})
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "no AST-changed files" in proc.stderr
+
+
+# -- TJA024-027: the determinism layer ----------------------------------------
+
+PKG_INIT = {
+    f"{PKG}/__init__.py": "",
+    f"{PKG}/fleet/__init__.py": "",
+}
+
+
+class TestUnseededRandomness:
+    def test_fires_on_every_unseeded_construct_in_scope(self, tmp_path):
+        findings = analyze_tree(tmp_path, {f"{PKG}/fleet/plan.py": """
+            import random
+            import uuid
+
+            def expand(n):
+                rng = random.Random()
+                pick = random.choice(["a", "b"])
+                token = uuid.uuid4()
+                bucket = hash(pick) % n
+                return rng, pick, token, bucket
+        """}, only=["unseeded-randomness"])
+        assert ids(findings) == ["TJA024"]
+        msgs = "\n".join(f.message for f in findings)
+        assert len(findings) == 4
+        assert "random.Random() without a seed" in msgs
+        assert "random.choice" in msgs
+        assert "uuid.uuid4" in msgs
+        assert "hash()" in msgs and "PYTHONHASHSEED" in msgs
+
+    def test_import_aliases_resolve_to_the_source_tables(self, tmp_path):
+        """``from random import choice`` / ``import numpy as np`` still
+        hit the tables -- the scope contract is about the callee, not the
+        spelling."""
+        findings = analyze_tree(tmp_path, {f"{PKG}/fleet/plan.py": """
+            import numpy as np
+            from random import choice
+
+            def expand():
+                return choice(["a"]), np.random.rand()
+        """}, only=["unseeded-randomness"])
+        assert len(findings) == 2
+        assert any("numpy" in f.message for f in findings)
+
+    def test_quiet_on_seeded_rng_and_out_of_scope_code(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            f"{PKG}/fleet/plan.py": """
+                import random
+                from numpy.random import default_rng
+
+                def expand(seed):
+                    rng = random.Random(seed)
+                    gen = default_rng(seed)
+                    return rng.random() + float(gen.random())
+            """,
+            # Same module-level draw outside DETERMINISM_SCOPE: TJA024
+            # does not fire (TJA025 would, if it reached a digest).
+            f"{PKG}/workloads/gen.py": """
+                import random
+
+                def jitter():
+                    return random.random()
+            """,
+        }, only=["unseeded-randomness"])
+        assert findings == []
+
+
+class TestDigestStability:
+    def test_wall_clock_local_reaches_hasher_update(self, tmp_path):
+        """The localproc-shaped bug: a time.time() value folded into a
+        hashlib digest via a local assignment chain."""
+        findings = analyze_tree(tmp_path, {f"{PKG}/runtime/footer.py": """
+            import hashlib
+            import time
+
+            def footer(payload):
+                stamp = time.time()
+                h = hashlib.sha256()
+                h.update(payload)
+                h.update(str(stamp).encode())
+                return h.hexdigest()
+        """}, only=["digest-stability"])
+        assert ids(findings) == ["TJA025"]
+        (f,) = findings
+        assert "'stamp'" in f.message and "reaches digest sink" in f.message
+
+    def test_taint_crosses_project_function_returns(self, tmp_path):
+        """Interprocedural: a helper returning wall clock taints its
+        caller's sorted-keys json.dumps in another module."""
+        findings = analyze_tree(tmp_path, {
+            f"{PKG}/obs/stamp.py": """
+                import time
+
+                def stamp_ms():
+                    return int(time.time() * 1000)
+            """,
+            f"{PKG}/obs/bundle.py": f"""
+                import json
+
+                from {PKG}.obs.stamp import stamp_ms
+
+                def render(payload):
+                    return json.dumps({{"at": stamp_ms(), "p": payload}},
+                                      sort_keys=True)
+            """,
+        }, only=["digest-stability"])
+        assert ids(findings) == ["TJA025"]
+        (f,) = findings
+        assert f.path == f"{PKG}/obs/bundle.py"
+        assert "stamp_ms()" in f.message
+
+    def test_unsorted_set_materialization_is_a_source(self, tmp_path):
+        """sort_keys launders dict order, not list order: a list built
+        from a set stays hash-randomization-dependent."""
+        findings = analyze_tree(tmp_path, {f"{PKG}/obs/canon.py": """
+            import json
+
+            def canonical():
+                pending = {"create", "delete", "patch"}
+                return json.dumps({"verbs": list(pending)}, sort_keys=True)
+        """}, only=["digest-stability"])
+        assert ids(findings) == ["TJA025"]
+        assert "unsorted set materialization" in findings[0].message
+
+    def test_quiet_on_sorted_sets_and_deterministic_inputs(self, tmp_path):
+        findings = analyze_tree(tmp_path, {f"{PKG}/obs/canon.py": """
+            import hashlib
+            import json
+
+            def canonical(doc):
+                pending = {"create", "delete", "patch"}
+                body = json.dumps({"verbs": sorted(pending), "doc": doc},
+                                  sort_keys=True)
+                return hashlib.sha256(body.encode()).hexdigest()
+        """}, only=["digest-stability"])
+        assert findings == []
+
+
+class TestIterationOrderHazard:
+    def test_fires_on_set_loop_with_append(self, tmp_path):
+        findings = analyze_tree(tmp_path, {f"{PKG}/fleet/expand.py": """
+            def expand(verbs, out):
+                for verb in set(verbs):
+                    out.append(verb)
+        """}, only=["iteration-order-hazard"])
+        assert ids(findings) == ["TJA026"]
+        assert "sorted(...)" in findings[0].message
+
+    def test_module_level_frozenset_and_rng_draws(self, tmp_path):
+        """Materializing (list()) doesn't launder order, and an RNG draw
+        in the body is an order-dependent effect: same seed, different
+        element gets the draw."""
+        findings = analyze_tree(tmp_path, {f"{PKG}/fleet/stream.py": """
+            VERBS = frozenset({"get", "list", "watch"})
+
+            def stream(rng):
+                draws = []
+                for v in list(VERBS):
+                    draws.append(rng.uniform(0.0, 1.0))
+                return draws
+        """}, only=["iteration-order-hazard"])
+        assert ids(findings) == ["TJA026"]
+
+    def test_quiet_on_sorted_loops_and_order_free_bodies(self, tmp_path):
+        findings = analyze_tree(tmp_path, {
+            f"{PKG}/fleet/expand.py": """
+                def expand(verbs, out, seen):
+                    for verb in sorted(set(verbs)):
+                        out.append(verb)
+                    for verb in set(verbs):
+                        seen.add(verb)      # commutative: order-free
+            """,
+            # Out of scope: same hazard shape, not TJA026's business.
+            f"{PKG}/workloads/gen.py": """
+                def expand(verbs, out):
+                    for verb in set(verbs):
+                        out.append(verb)
+            """,
+        }, only=["iteration-order-hazard"])
+        assert findings == []
+
+    def test_injected_unsorted_verb_expansion_is_caught(self, tmp_path):
+        """End to end on the real plan generator: turn fleet/chaos.py's
+        verb expansion into a set loop and the pass must catch exactly
+        the bug the chaos-smoke digest contract exists to prevent."""
+        src = open(os.path.join(REPO_ROOT, PKG, "fleet", "chaos.py")).read()
+        good = "        for verb in CHAOS_VERBS:\n"
+        assert good in src, "chaos.py plan expansion changed; update fixture"
+        broken = src.replace(good, "        for verb in set(CHAOS_VERBS):\n")
+        findings = analyze_tree(
+            tmp_path, {f"{PKG}/fleet/chaos.py": broken},
+            only=["iteration-order-hazard"])
+        assert ids(findings) == ["TJA026"]
+        # The unmodified file is quiet -- the real tree holds the contract.
+        assert analyze_tree(
+            tmp_path, {f"{PKG}/fleet/chaos.py": src},
+            only=["iteration-order-hazard"]) == []
+
+    def test_facts_built_once_across_determinism_passes(self, tmp_path):
+        """TJA024-026 share determinism.facts(); the ProjectContext memo
+        means one build per run (same contract as the CFG and jit-boundary
+        memos -- the 2s lint budget rests on it)."""
+        from tools.analyze import determinism as det
+
+        (tmp_path / "m.py").write_text(textwrap.dedent("""
+            import json
+
+            def canonical():
+                pending = {"a", "b"}
+                return json.dumps(sorted(pending), sort_keys=True)
+        """))
+        before = det.BUILD_COUNT
+        run_checks([str(tmp_path)], root=str(tmp_path),
+                   only=["unseeded-randomness", "digest-stability",
+                         "iteration-order-hazard"])
+        assert det.BUILD_COUNT - before == 1
+
+
+class TestShardStateDiscipline:
+    CONSTANTS = f"{PKG}/api/constants.py"
+
+    def _tree(self, registry, counters_extra=""):
+        return {
+            f"{PKG}/obs/counters.py": """
+                import itertools
+                import threading
+
+                _seq = itertools.count()
+                _lock = threading.Lock()
+                CACHE = {}
+                TABLE = {"a": 1}
+
+                def bump():
+                    return next(_seq)
+
+                def put(k, v):
+                    with _lock:
+                        CACHE[k] = v
+            """ + counters_extra,
+            self.CONSTANTS: registry,
+        }
+
+    FULL = f"""
+        SHARD_STATE_REGISTRY = {{
+            "api.constants.SHARD_STATE_REGISTRY": "constant",
+            "obs.counters._seq": "shard_hostile",
+            "obs.counters.CACHE": "lock_guarded_shared",
+            "obs.counters.TABLE": "constant",
+        }}
+    """
+
+    def test_quiet_when_every_singleton_is_classified(self, tmp_path):
+        assert analyze_tree(tmp_path, self._tree(self.FULL),
+                            only=["shard-state-discipline"]) == []
+
+    def test_unclassified_singleton_is_an_error_at_its_definition(
+            self, tmp_path):
+        registry = self.FULL.replace(
+            '            "obs.counters.CACHE": "lock_guarded_shared",\n', "")
+        findings = analyze_tree(tmp_path, self._tree(registry),
+                                only=["shard-state-discipline"])
+        assert ids(findings) == ["TJA027"]
+        (f,) = findings
+        assert f.path == f"{PKG}/obs/counters.py"
+        assert "'obs.counters.CACHE'" in f.message
+        assert "not classified" in f.message
+
+    def test_mutating_a_constant_classified_singleton_fires_at_the_write(
+            self, tmp_path):
+        findings = analyze_tree(tmp_path, self._tree(self.FULL, """
+
+                def poke():
+                    TABLE["b"] = 2
+            """), only=["shard-state-discipline"])
+        assert ids(findings) == ["TJA027"]
+        (f,) = findings
+        assert f.path == f"{PKG}/obs/counters.py"
+        assert "classified constant" in f.message and "mutated" in f.message
+
+    def test_stale_registry_entry_is_an_error_at_the_registry(self, tmp_path):
+        registry = self.FULL.replace(
+            '"obs.counters.TABLE": "constant",',
+            '"obs.counters.TABLE": "constant",\n'
+            '            "obs.counters.GONE": "shard_local",')
+        findings = analyze_tree(tmp_path, self._tree(registry),
+                                only=["shard-state-discipline"])
+        assert ids(findings) == ["TJA027"]
+        (f,) = findings
+        assert f.path == self.CONSTANTS and "stale" in f.message
+
+    def test_invalid_classification_is_an_error(self, tmp_path):
+        registry = self.FULL.replace('"shard_hostile"', '"per_thread"')
+        findings = analyze_tree(tmp_path, self._tree(registry),
+                                only=["shard-state-discipline"])
+        assert ids(findings) == ["TJA027"]
+        assert "not a valid classification" in findings[0].message
+
+    def test_lock_guarded_claim_without_lock_evidence_warns(self, tmp_path):
+        files = self._tree(self.FULL.replace(
+            '"obs.counters.TABLE": "constant",',
+            '"obs.counters.TABLE": "constant",\n'
+            '            "obs.bare.SHARED": "lock_guarded_shared",'))
+        files[f"{PKG}/obs/bare.py"] = """
+            SHARED = {}
+
+            def put(k, v):
+                SHARED[k] = v
+        """
+        findings = analyze_tree(tmp_path, files,
+                                only=["shard-state-discipline"])
+        assert ids(findings) == ["TJA027"]
+        (f,) = findings
+        assert f.severity == "warning"
+        assert "neither its class nor its module declares a lock" in f.message
+
+    def test_quiet_on_trees_without_the_registry_module(self, tmp_path):
+        """A bare fixture tree is not this package: no constants.py means
+        nothing to hold the inventory against."""
+        assert analyze_tree(tmp_path, {"m.py": "STATE = {}\n"},
+                            only=["shard-state-discipline"]) == []
+
+
+class TestShardStateReport:
+    def test_report_is_clean_and_schema_stable_on_the_repo(self):
+        """``make shard-state-report``'s contract: exit 0, and the JSON
+        document round-trips against the schema docs/STATIC_ANALYSIS.md
+        declares (the worklist ROADMAP item 3 consumes)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze",
+             "--report", "shard-state"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert set(doc) == {"version", "generated_by", "package",
+                            "registry_declared", "singletons",
+                            "unclassified", "stale", "constant_violations"}
+        assert doc["version"] == 1
+        assert doc["package"] == PKG
+        assert doc["registry_declared"] is True
+        assert doc["unclassified"] == []
+        assert doc["stale"] == []
+        assert doc["constant_violations"] == []
+        names = set()
+        for s in doc["singletons"]:
+            assert set(s) == {"name", "path", "line", "kind",
+                              "classification", "lock_guarded", "writes",
+                              "reads", "modules"}
+            assert s["classification"] in {
+                "constant", "shard_local", "lock_guarded_shared",
+                "shard_hostile"}
+            assert isinstance(s["line"], int) and s["line"] > 0
+            for site in s["writes"] + s["reads"]:
+                assert set(site) == {"path", "line", "via"}
+            names.add(s["name"])
+        # The singletons ROADMAP item 3 must split are all inventoried.
+        assert {"obs.incident.INCIDENTS", "obs.goodput.GOODPUT",
+                "obs.telemetry.TELEMETRY", "utils.events._seq"} <= names
+        # Exactly one declared shard-hostile write pattern today: the
+        # global event-sequence counter.
+        hostile = [s["name"] for s in doc["singletons"]
+                   if s["classification"] == "shard_hostile"]
+        assert hostile == ["utils.events._seq"]
+
+    def test_report_exits_nonzero_on_unclassified_state(self, tmp_path):
+        """The CI gate: new module-level mutable state without a registry
+        entry fails ``make shard-state-report``."""
+        for rel, src in {
+            f"{PKG}/api/constants.py": "SHARD_STATE_REGISTRY = {\n"
+            '    "api.constants.SHARD_STATE_REGISTRY": "constant",\n}\n',
+            f"{PKG}/obs/rogue.py": "ROGUE = {}\n",
+        }.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(src)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", PKG,
+             "--report", "shard-state"],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["unclassified"] == ["obs.rogue.ROGUE"]
+        assert "1 unclassified" in proc.stderr

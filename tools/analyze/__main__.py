@@ -21,6 +21,37 @@ from tools.analyze import runner
 #: grandfathered in tools/analyze/baseline.json).
 DEFAULT_PATHS = ["trainingjob_operator_tpu", "tools", "bench.py"]
 
+#: The declared-registry module: a change here re-scopes project passes
+#: (see --changed-since) because the registries it declares parameterize
+#: findings in *other* files.
+CONSTANTS_REL = "trainingjob_operator_tpu/api/constants.py"
+
+
+def _shard_state_report(paths, root) -> int:
+    """``--report shard-state``: build the project context and print the
+    TJA027 inventory JSON (docs/STATIC_ANALYSIS.md documents the schema).
+    Exit 0 only when every singleton is classified, no registry entry is
+    stale, and nothing mutates a constant-classified singleton."""
+    import json
+
+    from tools.analyze.checks import shard_state
+    from tools.analyze.project import ProjectContext
+
+    contexts = {}
+    for abs_path in runner.iter_py_files(paths, root):
+        ctx = runner.make_context(abs_path, root)
+        contexts[ctx.path] = ctx
+    pc = ProjectContext.build(root, contexts)
+    doc, ok = shard_state.report(pc)
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    n = len(doc["singletons"])
+    bad = doc["unclassified"]
+    print(f"{n} singleton(s), {len(bad)} unclassified, "
+          f"{len(doc['stale'])} stale, "
+          f"{len(doc['constant_violations'])} constant violation(s)",
+          file=sys.stderr)
+    return 0 if ok else 1
+
 
 def _git_changed_files(root: str, ref: str) -> set:
     """Repo-relative .py files that differ from ``ref`` (committed diff,
@@ -77,7 +108,16 @@ def main(argv=None) -> int:
                     help="incremental mode: lint only files whose AST "
                          "differs from REF (file passes skip unchanged "
                          "files; project passes still build the full "
-                         "context but report only into changed files)")
+                         "context but report only into changed files; "
+                         "a change to api/constants.py widens project "
+                         "passes back to the full tree, since registry "
+                         "edits land findings in unchanged files)")
+    ap.add_argument("--report", choices=("shard-state",), default=None,
+                    help="emit a machine-readable inventory instead of "
+                         "findings: 'shard-state' prints the TJA027 "
+                         "module-level mutable-singleton inventory as "
+                         "JSON and exits nonzero when it is not clean "
+                         "(unclassified/stale/constant-mutated)")
     ap.add_argument("--max-seconds", type=float, default=None, metavar="S",
                     help="fail (exit 1) when the analysis itself takes longer "
                          "than S wall-clock seconds -- a CI budget proving "
@@ -93,6 +133,10 @@ def main(argv=None) -> int:
     only = args.checks.split(",") if args.checks else None
     paths = args.paths or DEFAULT_PATHS
     root = os.getcwd()
+
+    if args.report == "shard-state":
+        return _shard_state_report(paths, root)
+
     started = time.monotonic()
     report_only = None
     if args.changed_since:
@@ -110,6 +154,15 @@ def main(argv=None) -> int:
                   f"{time.monotonic() - started:.2f}s (no AST-changed "
                   f"files since {args.changed_since})", file=sys.stderr)
             return 0
+        if CONSTANTS_REL in report_only:
+            # The registries in api/constants.py (EVENT_REASONS,
+            # PHASE_TRANSITIONS, SHARD_STATE_REGISTRY, ...) parameterize
+            # the project passes: editing one lands findings in files
+            # that did not change.  Fall back to a full run.
+            print(f"{CONSTANTS_REL} changed: registry edits invalidate "
+                  "incremental scoping, re-running project passes "
+                  "tree-wide", file=sys.stderr)
+            report_only = None
     findings = runner.run_checks(paths, root=root, only=only,
                                  report_only=report_only)
     elapsed = time.monotonic() - started

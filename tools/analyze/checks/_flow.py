@@ -12,7 +12,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Set
 
-from tools.analyze.findings import FileContext
+from tools.analyze.findings import FileContext, _LOCAL_BARRIERS
 from tools.analyze.project import LOCK_FACTORIES
 
 #: Method names that block unconditionally (socket/HTTP/process I/O).
@@ -54,7 +54,12 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
 
     The node list is cached on ``root`` itself: seven call sites across the
     path-sensitive passes sweep the same functions, and re-walking each body
-    per pass dominated the analyzer's --max-seconds budget."""
+    per pass dominated the analyzer's --max-seconds budget.  For functions
+    reached through a built FileContext the cache is already prefilled by
+    ``FileContext._build_walk`` (same membership, BFS order instead of DFS
+    -- every consumer is an order-blind classification scan); the lazy walk
+    below only runs for ASTs parsed outside a FileContext (tests, ad-hoc
+    fragments)."""
     cached = getattr(root, "_tja_local_walk", None)
     if cached is None:
         # Inlined iter_child_nodes with hoisted locals and the fields read
@@ -90,10 +95,6 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
                     push(v)
         root._tja_local_walk = cached
     return iter(cached)
-
-
-_LOCAL_BARRIERS = {ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
-                   ast.ClassDef}
 
 
 def call_dotted(call: ast.Call) -> Optional[str]:

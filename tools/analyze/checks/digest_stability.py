@@ -1,0 +1,135 @@
+"""TJA025 digest-stability: taint from nondeterminism sources to digests.
+
+Everything the robustness gates compare byte-for-byte flows through a
+small set of sinks: ``ChaosPlan.canonical()``/``digest()``
+(fleet/chaos.py), the incident bundle's sorted-keys ``json.dumps``
+(obs/incident.py), checkpoint footers' ``hashlib`` digests
+(workloads/train.py).  A digest is only as reproducible as its inputs;
+this pass tracks nondeterministic *values* -- wall clock, ``id()``,
+default ``repr``, OS entropy, global-``random`` draws -- through local
+assignment chains and project-function returns (determinism.py's
+memoized fixpoint) and reports any that reach a digest sink:
+
+- ``hashlib.sha256(...)``-family constructor arguments, and
+  ``h.update(x)`` where ``h`` is a local hasher;
+- ``json.dumps(..., sort_keys=True)`` arguments -- sorted keys launder
+  dict *order*, not tainted values (and not list order: a list
+  materialized from a set stays unstable, which is why unsorted-set
+  materialization is also a source here);
+- arguments to ``canonical()``/``digest()``/``hexdigest()`` methods
+  (zero-argument calls digest ``self``, which attribute-level taint
+  cannot witness -- the conservative trade the module docstring of
+  determinism.py spells out).
+
+Unlike TJA024 this pass is package-wide (tests excluded): a wall-clock
+timestamp baked into a digest is a bug wherever it happens, not just in
+the plan generators.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.analyze import determinism as det
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+CHECK_ID, CHECK_NAME = "TJA025", "digest-stability"
+
+
+def _sink_of(mod, rec, call: ast.Call) -> Optional[Tuple[str, List[ast.expr]]]:
+    """(sink label, argument exprs to vet) when ``call`` is a digest sink."""
+    fn = call.func
+    canon = det.canonical_callee(mod, fn)
+    if canon in det.HASHLIB_CTORS:
+        return (canon, list(call.args))
+    if isinstance(fn, ast.Attribute):
+        if (fn.attr == "update" and isinstance(fn.value, ast.Name)
+                and rec is not None and fn.value.id in rec.hasher_names):
+            return (f"{fn.value.id}.update", list(call.args))
+        if fn.attr in det.DIGEST_METHODS and call.args:
+            return (f".{fn.attr}()", list(call.args))
+    if canon == "json.dumps":
+        for kw in call.keywords:
+            if (kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                return ("json.dumps(sort_keys=True)", list(call.args))
+    return None
+
+
+def _order_witness(mod, rec, df, expr: ast.expr) -> Optional[Tuple[str, int]]:
+    """A set-typed value materialized into the sink without ``sorted()``:
+    its element order is hash-randomization-dependent."""
+    for node in det.walk_fast(expr):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "sorted"):
+            return None   # conservatively treat a sorted() wrap as laundering
+    for node in det.walk_fast(expr):
+        if det.is_set_expr(mod, rec, node, df) and not isinstance(
+                node, ast.BinOp):
+            return ("unsorted set materialization", node.lineno)
+    return None
+
+
+#: Attribute leaves that make a call a sink *candidate* -- the cheap
+#: pre-filter that keeps this pass from resolving the enclosing function
+#: (and computing its taint set) for the ~99% of calls that digest nothing.
+_SINK_LEAVES = det.DIGEST_METHODS | {"update", "dumps", "new"} | {
+    name.rpartition(".")[2] for name in det.HASHLIB_CTORS}
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    df = det.facts(pc)
+    findings: List[Finding] = []
+    for rel in sorted(df.by_path):
+        ctx = pc.files.get(rel)
+        mod = pc.module_of_path(rel)
+        if ctx is None or mod is None:
+            continue
+        by_fn = {id(rec.node): rec for rec in df.by_path[rel]}
+        taints = {}   # id(rec.node) -> its local value-taint set
+        parents = ctx.parents
+        for call in ctx.by_type(ast.Call):
+            fn = call.func
+            leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if leaf not in _SINK_LEAVES:
+                continue
+            rec = None
+            anc = parents.get(id(call))
+            while anc is not None:
+                rec = by_fn.get(id(anc))
+                if rec is not None:
+                    break
+                anc = parents.get(id(anc))
+            sink = _sink_of(mod, rec, call)
+            if sink is None:
+                continue
+            if rec is not None:
+                vt = taints.get(id(rec.node))
+                if vt is None:
+                    vt = taints[id(rec.node)] = \
+                        det.local_value_taint(mod, rec, df)
+            else:
+                vt = set()
+            label, args = sink
+            for arg in args:
+                witness = det._expr_source(mod, rec, arg, vt, df) \
+                    or _order_witness(mod, rec, df, arg)
+                if witness is not None:
+                    kind, line = witness
+                    findings.append(Finding(
+                        CHECK_ID, CHECK_NAME, rel, call.lineno,
+                        call.col_offset, ERROR,
+                        f"{kind} (line {line}) reaches digest sink "
+                        f"{label}: same-input runs will not reproduce "
+                        "byte-identical digests; feed the sink "
+                        "deterministic values (seeded draws, threaded "
+                        "clocks, sorted materializations) instead"))
+                    break
+    findings.sort(key=Finding.sort_key)
+    return findings

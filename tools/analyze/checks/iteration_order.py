@@ -1,0 +1,125 @@
+"""TJA026 iteration-order-hazard: unordered loops with ordered effects.
+
+The event kernel's tie-break is ``(deadline, seq)`` where ``seq`` is the
+*arming order* (runtime/events.py); plan expansion appends decision
+streams in *loop order* (fleet/chaos.py, fleet/churn.py); seeded RNG
+draws consume state in *call order*.  A ``for`` loop over a ``set`` (or
+``frozenset``) makes all three hash-randomization-dependent: the loop
+body runs in an order that differs between processes, so timers arm in a
+different ``seq`` order, streams append in a different element order, and
+the same seeded RNG hands different draws to different elements --
+byte-identical plans and phase counts for *this* run's PYTHONHASHSEED,
+different ones for the next.
+
+Inside ``DETERMINISM_SCOPE`` this pass flags any ``for`` whose iterable
+is set-typed (display, ``set()``/``frozenset()`` call, set algebra, a
+local or module-level name inferred set-typed, ``list()``/``tuple()``
+wrappers included -- materializing doesn't fix the order) *and* whose
+body contains an order-dependent effect:
+
+- an append-shaped mutation (``append``/``extend``/``insert``/
+  ``appendleft``/``put``/``push``/``heappush``/``publish``/``send``);
+- arming/scheduling (``arm``/``schedule``/``fire``/``emit``/``record``);
+- a draw from any RNG (a call on an ``rng``-named receiver or a
+  ``random.*`` function): draw order is element order;
+- a ``yield``: generator output order is element order.
+
+The fix is mechanical -- iterate ``sorted(...)`` -- which is exactly what
+the flagged loop's message says.  Membership tests, ``add``/``discard``
+into other sets, and dict key deletion are order-independent and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze import determinism as det
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+CHECK_ID, CHECK_NAME = "TJA026", "iteration-order-hazard"
+
+#: Method leaves whose call inside the loop body is an order-dependent
+#: effect (position-encoding mutations and event/timer emission).
+ORDER_SENSITIVE = frozenset({
+    "append", "extend", "insert", "appendleft", "put", "push", "heappush",
+    "publish", "send", "arm", "schedule", "fire", "emit", "record",
+})
+
+_RNG_RECEIVER = ("rng", "random", "rand")
+
+
+def _unordered_iter(mod, rec, df, expr: ast.expr) -> bool:
+    """Set-typed after peeling list()/tuple() wrappers; ``sorted(...)``
+    (and ``enumerate(sorted(...))`` etc.) is ordered."""
+    while (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name)
+           and expr.func.id in ("list", "tuple", "iter", "enumerate",
+                                "reversed") and expr.args):
+        expr = expr.args[0]
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name)
+            and expr.func.id == "sorted"):
+        return False
+    return det.is_set_expr(mod, rec, expr, df)
+
+
+def _effect_in(body: List[ast.stmt]) -> Optional[ast.AST]:
+    """First order-dependent effect in the loop body, or None."""
+    for stmt in body:
+        for node in det.walk_fast(stmt):
+            cls = node.__class__
+            if cls is ast.Yield or cls is ast.YieldFrom:
+                return node
+            if cls is not ast.Call:
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr in ORDER_SENSITIVE:
+                return node
+            recv = fn.value
+            leaf = recv.id if isinstance(recv, ast.Name) else (
+                recv.attr if isinstance(recv, ast.Attribute) else None)
+            if leaf is not None and any(
+                    t in leaf.lower() for t in _RNG_RECEIVER):
+                return node   # RNG draw: state consumed in element order
+    return None
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    df = det.facts(pc)
+    findings: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or not det.in_scope(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        by_fn = {id(rec.node): rec for rec in df.by_path.get(rel, ())}
+        parents = ctx.parents
+        for loop in ctx.by_type(ast.For):
+            rec = None
+            anc = parents.get(id(loop))
+            while anc is not None:
+                rec = by_fn.get(id(anc))
+                if rec is not None:
+                    break
+                anc = parents.get(id(anc))
+            if not _unordered_iter(mod, rec, df, loop.iter):
+                continue
+            effect = _effect_in(loop.body)
+            if effect is None:
+                continue
+            what = ("a yield" if isinstance(effect, (ast.Yield,
+                                                     ast.YieldFrom))
+                    else f"a {effect.func.attr}() call")
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, rel, loop.lineno, loop.col_offset,
+                ERROR,
+                "loop iterates a set whose element order is "
+                f"hash-randomization-dependent, and its body has {what} "
+                f"(line {effect.lineno}) whose effect encodes that order "
+                "(appended streams, (deadline, seq) arming order, RNG "
+                "draw order); iterate sorted(...) to pin it"))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -1,0 +1,370 @@
+"""TJA027 shard-state-discipline: the module-level mutable-state ledger.
+
+ROADMAP item 3 (horizontal controller scale-out) starts with a question
+the code cannot answer about itself at runtime: which module-level
+mutable singletons -- ``INCIDENTS``, ``GOODPUT``, ``TELEMETRY``,
+``METRICS``, port cursors, sequence counters, transition tables -- are
+*shard-local* (each controller shard may own an independent copy),
+which are *lock-guarded-shared* (one copy per process, threads
+coordinate), and which are *shard-hostile* (their semantics assume a
+single global writer over the whole keyspace, so splitting the keyspace
+splits the truth).  This pass turns that inventory into a declared,
+drift-proof contract, the way TJA007/TJA011/TJA013 do for event
+reasons, env vars, and phase transitions:
+
+- every module-level mutable singleton in the package (container
+  displays/constructors and project-class constructions --
+  ``ModuleInfo.global_mutables``/``global_ctors``; lock objects and
+  dunders excluded) must be classified in ``SHARD_STATE_REGISTRY``
+  (api/constants.py) as one of ``constant`` / ``shard_local`` /
+  ``lock_guarded_shared`` / ``shard_hostile``;
+- an unclassified singleton is an **error at its definition** -- new
+  global mutable state cannot land without declaring its shard story;
+- a registry entry naming no singleton is an **error at the registry**
+  (stale inventory; gated on whole-package coverage like TJA011's
+  absence claims);
+- a witnessed mutation of a ``constant``-classified singleton is an
+  **error at the write site** (the classification was a lie);
+- ``lock_guarded_shared`` without lock evidence (no lock attribute on
+  the singleton's class, no module-level lock beside a bare container)
+  is a **warning at the definition**.
+
+``python -m tools.analyze --report shard-state`` emits the full
+machine-readable inventory -- every singleton with its classification,
+lock evidence, and cross-module read/write sites -- which is the
+worklist ROADMAP item 3 consumes (docs/STATIC_ANALYSIS.md documents the
+schema).  The report exits nonzero on exactly the error classes above,
+which is what ``make shard-state-report`` gates CI on.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import ModuleInfo, ProjectContext
+from tools.analyze.runner import register_project
+
+CHECK_ID, CHECK_NAME = "TJA027", "shard-state-discipline"
+
+PKG = "trainingjob_operator_tpu"
+CONSTANTS_REL = f"{PKG}/api/constants.py"
+REGISTRY_NAME = "SHARD_STATE_REGISTRY"
+REPORT_VERSION = 1
+
+CLASSIFICATIONS = frozenset({
+    "constant", "shard_local", "lock_guarded_shared", "shard_hostile",
+})
+
+#: Method-name prefixes treated as reads; everything else called on a
+#: singleton is conservatively a mutation (the report records both).
+READ_PREFIXES = (
+    "get", "is_", "has_", "peek", "depth", "render", "snapshot", "to_",
+    "export", "format", "iter", "keys", "values", "items", "copy",
+    "summary", "describe", "count", "index", "armed", "bundle", "list",
+    "read", "collect", "lines",
+)
+
+
+def _is_read(method: str) -> bool:
+    return method.startswith(READ_PREFIXES)
+
+
+@dataclass
+class Singleton:
+    key: str                 # package-relative dotted, "obs.incident.INCIDENTS"
+    module: str              # full dotted module
+    name: str
+    path: str
+    line: int
+    kind: str                # "dict"/"list"/"set"/"count"/class name
+    classification: Optional[str] = None
+    lock_guarded: bool = False
+    writes: List[Tuple[str, int, str]] = field(default_factory=list)
+    reads: List[Tuple[str, int, str]] = field(default_factory=list)
+
+
+def _registry(mod: ModuleInfo) -> Tuple[Optional[Dict[str, str]],
+                                        Dict[str, int], int]:
+    """(key -> classification, key -> lineno, registry lineno) from the
+    ``SHARD_STATE_REGISTRY`` dict display, resolving value names through
+    the module's string constants.  First element is None when the
+    registry is not declared at all."""
+    if mod.ctx is None or mod.ctx.tree is None:
+        return None, {}, 0
+    for node in mod.ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == REGISTRY_NAME
+                and isinstance(node.value, ast.Dict)):
+            continue
+        entries: Dict[str, str] = {}
+        lines: Dict[str, int] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                entries[k.value] = v.value
+            elif isinstance(v, ast.Name):
+                entries[k.value] = mod.constants.get(v.id, v.id)
+            else:
+                entries[k.value] = "<non-literal>"
+            lines[k.value] = k.lineno
+        return entries, lines, node.lineno
+    return None, {}, 0
+
+
+def _inventory(pc: ProjectContext) -> Dict[str, Singleton]:
+    """Every module-level mutable singleton in the package, keyed by its
+    package-relative dotted name."""
+    out: Dict[str, Singleton] = {}
+    for mod in pc.modules.values():
+        if mod.name != PKG and not mod.name.startswith(PKG + "."):
+            continue
+        if mod.ctx is None or is_test_path(mod.ctx.path):
+            continue
+        rel_mod = mod.name[len(PKG) + 1:] if mod.name != PKG else ""
+        seen = set()
+        for name, (kind, line) in mod.global_mutables.items():
+            if name.startswith("__") or name in mod.module_locks:
+                continue
+            key = f"{rel_mod}.{name}" if rel_mod else name
+            out[key] = Singleton(key=key, module=mod.name, name=name,
+                                 path=mod.ctx.path, line=line, kind=kind,
+                                 lock_guarded=bool(mod.module_locks))
+            seen.add(name)
+        for name, ctor in mod.global_ctors.items():
+            if name in seen or name.startswith("__") \
+                    or name in mod.module_locks:
+                continue
+            ci = pc.resolve_class(mod.name, ctor)
+            if ci is None:
+                continue   # stdlib/external ctor (getLogger, object(), ...)
+            line = 0
+            for node in mod.ctx.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == name):
+                    line = node.lineno
+                    break
+            key = f"{rel_mod}.{name}" if rel_mod else name
+            out[key] = Singleton(
+                key=key, module=mod.name, name=name, path=mod.ctx.path,
+                line=line, kind=ci.name,
+                lock_guarded=bool(ci.lock_attrs) or bool(mod.module_locks))
+    return out
+
+
+def _collect_sites(pc: ProjectContext,
+                   inventory: Dict[str, Singleton]) -> None:
+    """Attribute every witnessed use of a singleton -- method calls,
+    attribute/subscript stores, ``next()`` draws, deletes -- to it, split
+    into reads and writes."""
+    quals = {f"{s.module}.{s.name}": key for key, s in inventory.items()}
+    sing_modules = {s.module for s in inventory.values()}
+
+    for rel, ctx in pc.files.items():
+        if ctx.tree is None or is_test_path(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        if mod is None or (mod.name != PKG
+                           and not mod.name.startswith(PKG + ".")):
+            continue
+        local: Dict[str, str] = {}
+        mod_alias: Dict[str, str] = {}
+        for key, s in inventory.items():
+            if s.module == mod.name:
+                local[s.name] = key
+        for alias, target in mod.imports.items():
+            got = quals.get(target)
+            if got is not None:
+                local[alias] = got
+            elif target in sing_modules:
+                mod_alias[alias] = target
+
+        def resolve(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Name):
+                return local.get(expr.id)
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name):
+                target = mod_alias.get(expr.value.id)
+                if target is not None:
+                    return quals.get(f"{target}.{expr.attr}")
+            return None
+
+        def note(key: str, line: int, via: str, write: bool) -> None:
+            s = inventory[key]
+            (s.writes if write else s.reads).append((rel, line, via))
+
+        for call in ctx.by_type(ast.Call):
+            fn = call.func
+            if isinstance(fn, ast.Attribute):
+                key = resolve(fn.value)
+                if key is not None:
+                    note(key, call.lineno, f"{fn.attr}()",
+                         not _is_read(fn.attr))
+            elif isinstance(fn, ast.Name) and fn.id == "next" and call.args:
+                key = resolve(call.args[0])
+                if key is not None:
+                    note(key, call.lineno, "next()", True)
+        for node in ctx.by_type(ast.Assign):
+            for t in node.targets:
+                key = _store_base(t, resolve)
+                if key is not None:
+                    note(key, node.lineno, "store", True)
+        for node in ctx.by_type(ast.AugAssign):
+            key = _store_base(node.target, resolve)
+            if key is not None:
+                note(key, node.lineno, "augmented store", True)
+        for node in ctx.by_type(ast.Delete):
+            for t in node.targets:
+                key = _store_base(t, resolve)
+                if key is not None:
+                    note(key, node.lineno, "delete", True)
+        for node in ctx.by_type(ast.Subscript):
+            if isinstance(node.ctx, ast.Load):
+                key = resolve(node.value)
+                if key is not None:
+                    note(key, node.lineno, "subscript", False)
+
+
+def _store_base(target: ast.expr, resolve) -> Optional[str]:
+    """Singleton behind ``SING[...] = ...`` / ``SING.attr = ...`` /
+    ``mod.SING[...] = ...`` store targets."""
+    if isinstance(target, (ast.Subscript, ast.Attribute)):
+        got = resolve(target.value)
+        if got is not None:
+            return got
+        # one more level: ``incident.INCIDENTS._rings[k] = v``
+        inner = target.value
+        if isinstance(inner, (ast.Subscript, ast.Attribute)):
+            return resolve(inner.value)
+    return None
+
+
+def build(pc: ProjectContext) -> Tuple[Dict[str, Singleton],
+                                       Optional[Dict[str, str]],
+                                       Dict[str, int], int]:
+    """(inventory with sites, registry, registry entry lines, registry
+    lineno) -- shared by the pass and the ``--report shard-state`` CLI,
+    memoized on the ProjectContext so running both costs one sweep."""
+    cached = getattr(pc, "_shard_state", None)
+    if cached is not None:
+        return cached
+    const_mod = pc.ensure_module(CONSTANTS_REL)
+    registry, entry_lines, reg_line = (
+        _registry(const_mod) if const_mod is not None else (None, {}, 0))
+    inventory = _inventory(pc)
+    _collect_sites(pc, inventory)
+    for key, s in inventory.items():
+        if registry:
+            s.classification = registry.get(key)
+    result = (inventory, registry, entry_lines, reg_line)
+    pc._shard_state = result
+    return result
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    if pc.ensure_module(CONSTANTS_REL) is None:
+        return []   # not this package's tree (bare fixture): nothing to hold
+    inventory, registry, entry_lines, reg_line = build(pc)
+    findings: List[Finding] = []
+    reg = registry or {}
+
+    for key, s in sorted(inventory.items()):
+        cls = reg.get(key)
+        if cls is None:
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, s.path, s.line, 0, ERROR,
+                f"module-level mutable singleton {key!r} ({s.kind}) is not "
+                f"classified in {REGISTRY_NAME} (api/constants.py); declare "
+                "it constant / shard_local / lock_guarded_shared / "
+                "shard_hostile so the scale-out inventory stays complete"))
+            continue
+        if cls not in CLASSIFICATIONS:
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, CONSTANTS_REL,
+                entry_lines.get(key, reg_line), 0, ERROR,
+                f"{REGISTRY_NAME}[{key!r}] = {cls!r} is not a valid "
+                f"classification ({', '.join(sorted(CLASSIFICATIONS))})"))
+            continue
+        if cls == "constant":
+            for path, line, via in sorted(s.writes):
+                findings.append(Finding(
+                    CHECK_ID, CHECK_NAME, path, line, 0, ERROR,
+                    f"{key!r} is classified constant in {REGISTRY_NAME} "
+                    f"but is mutated here ({via}); reclassify it or make "
+                    "the mutation a construction-time initialization"))
+        elif cls == "lock_guarded_shared" and not s.lock_guarded:
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, s.path, s.line, 0, WARNING,
+                f"{key!r} is classified lock_guarded_shared but neither "
+                "its class nor its module declares a lock; guard it or "
+                "reclassify"))
+
+    # Stale registry entries are an absence claim over the whole package:
+    # only report them when the analyzed set actually covers it.
+    if registry is not None and pc.covers_package(PKG):
+        for key in sorted(set(reg) - set(inventory)):
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, CONSTANTS_REL,
+                entry_lines.get(key, reg_line), 0, ERROR,
+                f"{REGISTRY_NAME} entry {key!r} matches no module-level "
+                "mutable singleton in the package: stale inventory"))
+
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+# -- machine-readable report --------------------------------------------------
+
+def report(pc: ProjectContext) -> Tuple[dict, bool]:
+    """The ``--report shard-state`` JSON document and whether it is clean
+    (classified, not stale, constants unmutated)."""
+    inventory, registry, _entry_lines, _reg_line = build(pc)
+    reg = registry or {}
+    singletons = []
+    unclassified: List[str] = []
+    violations: List[dict] = []
+    for key, s in sorted(inventory.items()):
+        cls = reg.get(key)
+        if cls is None or cls not in CLASSIFICATIONS:
+            unclassified.append(key)
+        elif cls == "constant" and s.writes:
+            violations.extend({
+                "singleton": key, "path": p, "line": ln, "via": via,
+            } for p, ln, via in sorted(s.writes))
+        singletons.append({
+            "name": key,
+            "path": s.path,
+            "line": s.line,
+            "kind": s.kind,
+            "classification": cls if cls in CLASSIFICATIONS else None,
+            "lock_guarded": s.lock_guarded,
+            "writes": [{"path": p, "line": ln, "via": via}
+                       for p, ln, via in sorted(s.writes)],
+            "reads": [{"path": p, "line": ln, "via": via}
+                      for p, ln, via in sorted(s.reads)],
+            "modules": sorted({p for p, _ln, _via in s.writes + s.reads}),
+        })
+    stale = sorted(set(reg) - set(inventory)) \
+        if registry is not None and pc.covers_package(PKG) else []
+    doc = {
+        "version": REPORT_VERSION,
+        "generated_by": f"tools.analyze {CHECK_ID} ({CHECK_NAME})",
+        "package": PKG,
+        "registry_declared": registry is not None,
+        "singletons": singletons,
+        "unclassified": unclassified,
+        "stale": stale,
+        "constant_violations": violations,
+    }
+    ok = not unclassified and not stale and not violations \
+        and registry is not None
+    return doc, ok

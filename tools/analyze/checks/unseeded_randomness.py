@@ -1,0 +1,96 @@
+"""TJA024 unseeded-randomness: seeded-RNG discipline in determinism scope.
+
+The chaos/churn planes promise "same (profile, seed) => byte-identical
+plan" (fleet/chaos.py, fleet/churn.py) and the event kernel promises
+"same seed => same phase counts" (runtime/sim.py, runtime/events.py).
+Both hold only while every random draw flows through an explicitly seeded
+``random.Random(seed)`` threaded from the profile.  One module-level
+``random.*`` call -- whose hidden global state any import or test may
+perturb -- or one ``uuid4()``/``os.urandom`` read breaks the contract for
+*some* seed without failing the smokes' seeds.
+
+Inside ``DETERMINISM_SCOPE`` this pass makes every such construct an
+error at the call site:
+
+- module-level ``random.*`` draws and state pokes (``random.seed`` too:
+  reseeding the global generator is how the perturbation happens);
+- ``random.Random()`` with no arguments and ``random.SystemRandom`` (both
+  seed from the OS);
+- legacy ``numpy.random`` globals (``np.random.rand`` ...); seeded
+  ``default_rng(seed)`` is allowed;
+- ``uuid.uuid1``/``uuid.uuid4``, ``os.urandom``, ``secrets.*``;
+- builtin ``hash()`` -- str/bytes hashes are randomized per process
+  (PYTHONHASHSEED), so any hash-derived decision is run-dependent.
+
+Scope resolution is interprocedural only in the sense that the scope is
+*path*-based; the value-flow version of this contract (a nondeterministic
+value reaching a digest anywhere in the package) is TJA025's job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze import determinism as det
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+
+CHECK_ID, CHECK_NAME = "TJA024", "unseeded-randomness"
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or not det.in_scope(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        for call in ctx.by_type(ast.Call):
+            msg = _violation(mod, call)
+            if msg is not None:
+                findings.append(Finding(
+                    CHECK_ID, CHECK_NAME, rel, call.lineno,
+                    call.col_offset, ERROR, msg))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _violation(mod, call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "hash":
+        if mod is not None and (fn.id in mod.imports
+                                or fn.id in mod.functions):
+            return None
+        return ("builtin hash() in determinism scope: str/bytes hashes are "
+                "randomized per process (PYTHONHASHSEED), so any decision "
+                "derived from one is run-dependent; key on the value itself "
+                "or a stable digest")
+    canon = det.canonical_callee(mod, fn)
+    if canon is None:
+        return None
+    if canon in det.GLOBAL_RANDOM:
+        return (f"module-level {canon}() in determinism scope: the global "
+                "generator's state is shared with every other import, so "
+                "the draw sequence is not a function of the profile seed; "
+                "draw from an explicitly seeded random.Random threaded "
+                "from the profile/plan")
+    if canon == "random.Random" and not call.args:
+        return ("random.Random() without a seed in determinism scope "
+                "seeds from the OS; construct it as random.Random(seed) "
+                "with the profile/plan seed")
+    if canon == "random.SystemRandom":
+        return ("random.SystemRandom draws OS entropy and cannot be "
+                "seeded; determinism scope requires random.Random(seed)")
+    if canon.startswith("numpy.random.") and not (
+            canon == "numpy.random.default_rng" and call.args):
+        return (f"legacy numpy global RNG ({canon}) in determinism scope; "
+                "use numpy.random.default_rng(seed) and thread the "
+                "generator explicitly")
+    if canon in ("uuid.uuid1", "uuid.uuid4", "os.urandom") \
+            or canon.startswith("secrets."):
+        return (f"{canon}() is unseedable OS entropy; determinism scope "
+                "must derive identifiers from the seeded RNG or from "
+                "deterministic inputs (names, counters)")
+    return None

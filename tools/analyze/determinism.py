@@ -1,0 +1,494 @@
+"""Determinism layer shared by TJA024-TJA026 (docs/STATIC_ANALYSIS.md).
+
+The robustness gates (chaos-smoke, node-chaos-smoke, recovery-smoke) all
+rest on one contract: same seed => byte-identical ``ChaosPlan.digest()``,
+phase counts, and incident-bundle reassembly.  The smokes prove it
+dynamically for the seeds they happen to run; this layer proves the
+*absence of the bug classes* that break it for some other seed:
+
+- **sources** of nondeterminism: wall clock (``time.time`` and friends),
+  OS entropy (``os.urandom``, ``uuid.uuid4``, ``secrets``), the global
+  ``random`` module state, ``id()``/default ``repr`` (address-dependent),
+  and unsorted ``set`` materialization (hash-randomization-dependent);
+- **sinks** that pin bytes: ``canonical()``/``digest()`` methods,
+  ``hashlib`` constructors/updates, sorted-keys ``json.dumps``;
+- **scope** where *any* unseeded randomness is illegal, not just flows
+  that reach a digest: the plan generators and the event kernel
+  (``DETERMINISM_SCOPE``).
+
+Everything here is built **once per ProjectContext** and memoized on it,
+exactly like ``jit_boundary.boundary()``: four passes share one sweep over
+the per-file ASTs the runner already parsed.  ``BUILD_COUNT`` exists so
+tests can assert the single build (the 2 s ``make lint`` budget depends on
+it).
+
+Like the rest of the analyzer this is a conservative syntactic
+approximation: taint is tracked through local assignment chains and
+project-function returns, not through object attributes or containers.
+The passes only report what they can witness; waivers cover deliberate
+nondeterminism (docs/STATIC_ANALYSIS.md lists the inventory).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.findings import walk_fast
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import ModuleInfo, ProjectContext, _dotted
+
+PKG = "trainingjob_operator_tpu"
+
+#: Paths (dir prefixes ending in "/" or exact files) where *every*
+#: randomness source must be an explicitly seeded ``random.Random``:
+#: the chaos/churn plan generators, the chaos injection proxies, and the
+#: event-driven sim kernel whose (deadline, seq) ordering the phase-count
+#: determinism rests on.
+DETERMINISM_SCOPE = (
+    f"{PKG}/fleet/",
+    f"{PKG}/client/chaos.py",
+    f"{PKG}/runtime/sim.py",
+    f"{PKG}/runtime/events.py",
+)
+
+#: Built exactly once per ProjectContext (tests assert this, like
+#: jit_boundary.BUILD_COUNT).
+BUILD_COUNT = 0
+
+# -- source / sink tables -----------------------------------------------------
+
+#: Wall-clock reads: value differs run to run, so any digest it reaches
+#: differs run to run.
+WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+
+#: OS entropy: fresh randomness on every call, unseedable by design.
+OS_ENTROPY = frozenset({
+    "os.urandom", "uuid.uuid1", "uuid.uuid4",
+    "secrets.token_bytes", "secrets.token_hex", "secrets.token_urlsafe",
+    "secrets.randbits", "secrets.choice", "secrets.randbelow",
+})
+
+#: Module-level ``random.*`` draw/state functions -- the shared global
+#: generator whose state any import may perturb (the classic "works until
+#: another module draws first" seed-stability bug).
+GLOBAL_RANDOM = frozenset({
+    "random.random", "random.randint", "random.randrange", "random.uniform",
+    "random.choice", "random.choices", "random.shuffle", "random.sample",
+    "random.gauss", "random.normalvariate", "random.expovariate",
+    "random.betavariate", "random.triangular", "random.vonmisesvariate",
+    "random.paretovariate", "random.weibullvariate", "random.lognormvariate",
+    "random.getrandbits", "random.randbytes", "random.seed",
+    "random.setstate", "random.getstate",
+})
+
+#: Process-address sources: ``id()`` (and default ``repr``, which embeds
+#: it) differ per process, so they are digest poison but harmless for
+#: control flow.
+ADDRESS_SOURCES = frozenset({"id", "repr", "ascii"})
+
+#: hashlib constructor leaves (``hashlib.sha256(...)`` et al).
+HASHLIB_CTORS = frozenset({
+    "hashlib.md5", "hashlib.sha1", "hashlib.sha224", "hashlib.sha256",
+    "hashlib.sha384", "hashlib.sha512", "hashlib.blake2b",
+    "hashlib.blake2s", "hashlib.sha3_256", "hashlib.sha3_512",
+    "hashlib.new",
+})
+
+#: Method names that pin bytes when *called with arguments* -- the
+#: repo-wide canonical/digest idiom (fleet/chaos.py, obs/incident.py).
+DIGEST_METHODS = frozenset({"canonical", "digest", "hexdigest"})
+
+#: Set-producing method names (receiver set-typed => result set-typed).
+_SET_METHODS = frozenset({
+    "union", "intersection", "difference", "symmetric_difference", "copy",
+})
+
+
+def in_scope(rel_path: str) -> bool:
+    """Whether ``rel_path`` is inside the strict determinism scope."""
+    for p in DETERMINISM_SCOPE:
+        if (rel_path.startswith(p) if p.endswith("/") else rel_path == p):
+            return True
+    return False
+
+
+def canonical_callee(mod: Optional[ModuleInfo],
+                     func: ast.expr) -> Optional[str]:
+    """Canonical dotted name of a call target, with the head segment
+    resolved through the module's import aliases: ``monotonic()`` after
+    ``from time import monotonic`` -> ``time.monotonic``; ``np.random.rand``
+    after ``import numpy as np`` -> ``numpy.random.rand``.  Attribute
+    chains rooted at non-imported names (``rng.random``) come back verbatim
+    and match no source table."""
+    dotted = _dotted(func)
+    if dotted is None:
+        return None
+    head, sep, rest = dotted.partition(".")
+    if mod is not None:
+        target = mod.imports.get(head)
+        if target is not None:
+            return f"{target}.{rest}" if sep else target
+    return dotted
+
+
+# -- per-function records -----------------------------------------------------
+
+@dataclass
+class FnRec:
+    """One function or method body, pre-digested for the taint passes."""
+    qual: str                 # "pkg.mod.fn" | "pkg.mod.Class.method"
+    node: ast.AST = None
+    path: str = ""
+    module: str = ""
+    #: simple-Name assignments in document order: (names, value expr).
+    assigns: List[Tuple[Tuple[str, ...], ast.expr]] = field(
+        default_factory=list)
+    #: return value expressions.
+    returns: List[ast.expr] = field(default_factory=list)
+    #: local names bound to set-typed values (fixpoint over assigns).
+    set_names: Set[str] = field(default_factory=set)
+    #: local names bound to hashlib hasher objects (``h = sha256()``).
+    hasher_names: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class DetFacts:
+    """The memoized determinism layer: every FnRec in the analyzed package
+    (tests excluded), plus the returns-nondeterministic fixpoint."""
+    #: qual -> record, package functions and methods only.
+    fns: Dict[str, FnRec] = field(default_factory=dict)
+    #: per-file: rel path -> records in that file (document order).
+    by_path: Dict[str, List[FnRec]] = field(default_factory=dict)
+    #: quals whose return value carries a nondeterminism source.
+    tainted_returns: Set[str] = field(default_factory=set)
+    #: module-level names bound to sets, per module dotted name.
+    module_set_names: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+def facts(pc: ProjectContext) -> DetFacts:
+    """The determinism facts for this run, built once and memoized on
+    ``pc`` (the TJA024/025/026 passes all start here)."""
+    cached = getattr(pc, "_determinism_facts", None)
+    if cached is not None:
+        return cached
+    global BUILD_COUNT
+    BUILD_COUNT += 1
+    df = _build(pc)
+    pc._determinism_facts = df
+    return df
+
+
+def _build(pc: ProjectContext) -> DetFacts:
+    df = DetFacts()
+    for rel, ctx in pc.files.items():
+        if ctx.tree is None or is_test_path(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        if mod is None:
+            continue
+        msets: Set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _is_set_expr(mod, None, node.value)):
+                # Includes frozenset(...) constants: immutable, but their
+                # iteration order is still hash-randomization-dependent.
+                msets.add(node.targets[0].id)
+        df.module_set_names[mod.name] = msets
+        recs = _collect_file(rel, mod, ctx)
+        df.by_path[rel] = recs
+        for rec in recs:
+            df.fns[rec.qual] = rec
+    _returns_fixpoint(pc, df)
+    return df
+
+
+def _collect_file(rel: str, mod: ModuleInfo, ctx) -> List[FnRec]:
+    """One sweep over the file's cached Assign/Return buckets, attributed
+    to the enclosing function via the shared parents map (the same trick
+    project.py uses for self-attribute inference)."""
+    recs: List[FnRec] = []
+    by_fn: Dict[int, FnRec] = {}
+    parents = ctx.parents
+
+    def rec_for(node: ast.AST) -> Optional[FnRec]:
+        anc = parents.get(id(node))
+        while anc is not None:
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                got = by_fn.get(id(anc))
+                if got is None:
+                    qual = _qual_of(mod, ctx, anc)
+                    got = FnRec(qual=qual, node=anc, path=rel,
+                                module=mod.name)
+                    by_fn[id(anc)] = got
+                    recs.append(got)
+                return got
+            anc = parents.get(id(anc))
+        return None
+
+    for sub in ctx.by_type(ast.Assign):
+        names = tuple(t.id for t in sub.targets if isinstance(t, ast.Name))
+        if not names:
+            continue
+        rec = rec_for(sub)
+        if rec is not None:
+            rec.assigns.append((names, sub.value))
+    for sub in ctx.by_type(ast.AnnAssign):
+        if sub.value is None or not isinstance(sub.target, ast.Name):
+            continue
+        rec = rec_for(sub)
+        if rec is not None:
+            rec.assigns.append(((sub.target.id,), sub.value))
+    for sub in ctx.by_type(ast.Return):
+        if sub.value is None:
+            continue
+        rec = rec_for(sub)
+        if rec is not None:
+            rec.returns.append(sub.value)
+    for rec in recs:
+        _infer_locals(mod, rec)
+    return recs
+
+
+def _qual_of(mod: ModuleInfo, ctx, fn_node: ast.AST) -> str:
+    parents = ctx.parents
+    parts = [fn_node.name]
+    anc = parents.get(id(fn_node))
+    while anc is not None:
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(anc.name)
+        anc = parents.get(id(anc))
+    return ".".join([mod.name] + list(reversed(parts)))
+
+
+def _infer_locals(mod: ModuleInfo, rec: FnRec) -> None:
+    """Two-round fixpoint over the assign list: which locals are
+    set-typed, which hold hashlib hasher objects."""
+    for _ in range(2):
+        changed = False
+        for names, value in rec.assigns:
+            if _is_set_expr(mod, rec, value):
+                for n in names:
+                    if n not in rec.set_names:
+                        rec.set_names.add(n)
+                        changed = True
+            if isinstance(value, ast.Call):
+                canon = canonical_callee(mod, value.func)
+                if canon in HASHLIB_CTORS:
+                    for n in names:
+                        if n not in rec.hasher_names:
+                            rec.hasher_names.add(n)
+                            changed = True
+        if not changed:
+            break
+
+
+def _is_set_expr(mod: ModuleInfo, rec: Optional[FnRec], expr: ast.expr,
+                 df: Optional["DetFacts"] = None) -> bool:
+    """Whether ``expr`` is (syntactically) set-typed: displays,
+    comprehensions, set()/frozenset() calls, set-algebra BinOps,
+    set-producing methods on set-typed receivers, and names inferred
+    set-typed locally or at module level (``df`` adds the cross-checked
+    module-level set constants, frozensets included)."""
+    cls = expr.__class__
+    if cls is ast.Set or cls is ast.SetComp:
+        return True
+    if cls is ast.Name:
+        if rec is not None and expr.id in rec.set_names:
+            return True
+        if (df is not None and mod is not None
+                and expr.id in df.module_set_names.get(mod.name, ())):
+            return True
+        got = mod.global_mutables.get(expr.id) if mod is not None else None
+        return got is not None and got[0] == "set"
+    if cls is ast.Call:
+        fn = expr.func
+        leaf = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if leaf in ("set", "frozenset"):
+            return True
+        if (leaf in _SET_METHODS and isinstance(fn, ast.Attribute)
+                and _is_set_expr(mod, rec, fn.value, df)):
+            return True
+        return False
+    if cls is ast.BinOp and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return (_is_set_expr(mod, rec, expr.left, df)
+                or _is_set_expr(mod, rec, expr.right, df))
+    return False
+
+
+def is_set_expr(mod: ModuleInfo, rec: Optional[FnRec], expr: ast.expr,
+                df: Optional["DetFacts"] = None) -> bool:
+    """Public alias for the checks (see ``_is_set_expr``)."""
+    return _is_set_expr(mod, rec, expr, df)
+
+
+# -- source classification ----------------------------------------------------
+
+def source_kind(mod: Optional[ModuleInfo],
+                call: ast.Call) -> Optional[str]:
+    """Human-readable nondeterminism-source label for a call expression,
+    or None.  This is the TJA025 source table; TJA024 adds the
+    scope-specific constructs on top (see unseeded_randomness.py)."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in ADDRESS_SOURCES:
+        if mod is not None and (fn.id in mod.imports
+                                or fn.id in mod.functions):
+            return None     # shadowed builtin
+        return f"{fn.id}() (process-address-dependent)"
+    canon = canonical_callee(mod, fn)
+    if canon is None:
+        return None
+    if canon in WALL_CLOCK:
+        return f"wall clock ({canon})"
+    if canon in OS_ENTROPY:
+        return f"OS entropy ({canon})"
+    if canon in GLOBAL_RANDOM:
+        return f"global random state ({canon})"
+    if canon == "random.Random" and not call.args:
+        return "unseeded random.Random()"
+    if canon == "random.SystemRandom":
+        return "OS entropy (random.SystemRandom)"
+    if canon.startswith("numpy.random.") and not (
+            canon == "numpy.random.default_rng" and call.args):
+        return f"legacy numpy global RNG ({canon})"
+    return None
+
+
+# -- returns-nondeterministic fixpoint ----------------------------------------
+
+def _callee_quals(mod: ModuleInfo, rec: Optional[FnRec],
+                  call: ast.Call) -> List[str]:
+    """Project-function quals a call may target: plain names resolved
+    through the module table and imports, ``self.m()`` resolved against
+    the enclosing class's methods."""
+    fn = call.func
+    out: List[str] = []
+    if isinstance(fn, ast.Name):
+        if fn.id in mod.functions:
+            out.append(f"{mod.name}.{fn.id}")
+        target = mod.imports.get(fn.id)
+        if target is not None:
+            out.append(target)
+    elif isinstance(fn, ast.Attribute):
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                if rec is not None:
+                    # qual prefix: strip the method leaf off rec.qual.
+                    cls_qual = rec.qual.rpartition(".")[0]
+                    out.append(f"{cls_qual}.{fn.attr}")
+            else:
+                target = mod.imports.get(recv.id)
+                if target is not None:
+                    out.append(f"{target}.{fn.attr}")
+    return out
+
+
+def _expr_source(mod: ModuleInfo, rec: Optional[FnRec], expr: ast.expr,
+                 vtainted: Set[str], df: DetFacts
+                 ) -> Optional[Tuple[str, int]]:
+    """First value-taint witness inside ``expr``: a source call, a
+    value-tainted local, or a call to a returns-nondeterministic project
+    function.  Returns (label, lineno) or None."""
+    for node in walk_fast(expr):
+        cls = node.__class__
+        if cls is ast.Name:
+            if node.id in vtainted:
+                return (f"nondeterministic local {node.id!r}", node.lineno)
+        elif cls is ast.Call:
+            kind = source_kind(mod, node)
+            if kind is not None:
+                return (kind, node.lineno)
+            for q in _callee_quals(mod, rec, node):
+                if q in df.tainted_returns:
+                    leaf = q.rpartition(".")[2]
+                    return (f"call to {leaf}() "
+                            "(returns a nondeterministic value)",
+                            node.lineno)
+    return None
+
+
+def local_value_taint(mod: ModuleInfo, rec: FnRec,
+                      df: DetFacts) -> Set[str]:
+    """Locals carrying a nondeterministic *value* (wall clock, entropy,
+    address), via a small assignment-chain fixpoint in document order."""
+    tainted: Set[str] = set()
+    for _ in range(3):
+        changed = False
+        for names, value in rec.assigns:
+            if all(n in tainted for n in names):
+                continue
+            if _expr_source(mod, rec, value, tainted, df) is not None:
+                for n in names:
+                    if n not in tainted:
+                        tainted.add(n)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _returns_fixpoint(pc: ProjectContext, df: DetFacts) -> None:
+    """Interprocedural closure: a function is returns-nondeterministic
+    when any return expression carries a source, a source-tainted local,
+    or a call to an already-tainted function.
+
+    Delta-driven for the 2s lint budget: taint can only *originate* at a
+    direct source call, so round one fully evaluates just the functions
+    containing one (a cheap call-leaf scan finds them, and collects each
+    function's referenced project quals along the way); afterwards a
+    pending function is re-examined only when a qual it references newly
+    became tainted, instead of re-running the whole-package taint walk
+    every round."""
+    mods = pc.modules
+
+    def evaluate(mod: ModuleInfo, rec: FnRec) -> bool:
+        vt = local_value_taint(mod, rec, df)
+        for r in rec.returns:
+            if _expr_source(mod, rec, r, vt, df) is not None:
+                return True
+        return False
+
+    pending: Dict[str, Tuple[FnRec, Set[str]]] = {}
+    newly: Set[str] = set()
+    for rec in df.fns.values():
+        if not rec.returns:
+            continue
+        mod = mods.get(rec.module)
+        if mod is None:
+            continue
+        direct = False
+        refs: Set[str] = set()
+        for expr in [v for _n, v in rec.assigns] + rec.returns:
+            for node in walk_fast(expr):
+                if node.__class__ is not ast.Call:
+                    continue
+                if not direct and source_kind(mod, node) is not None:
+                    direct = True
+                refs.update(_callee_quals(mod, rec, node))
+        if direct and evaluate(mod, rec):
+            df.tainted_returns.add(rec.qual)
+            newly.add(rec.qual)
+        else:
+            pending[rec.qual] = (rec, refs)
+    while newly:
+        delta, newly = newly, set()
+        for qual in list(pending):
+            rec, refs = pending[qual]
+            if refs.isdisjoint(delta):
+                continue
+            mod = mods.get(rec.module)
+            if evaluate(mod, rec):
+                df.tainted_returns.add(qual)
+                newly.add(qual)
+                del pending[qual]

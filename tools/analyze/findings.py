@@ -63,6 +63,13 @@ def walk_fast(root) -> list:
     return out
 
 
+#: Deferred-execution scopes: ``walk_local`` (checks/_flow.py) stops at
+#: these, and ``FileContext._build_walk`` prefills each one's own-body walk
+#: during its single fused sweep.  One definition so the two stay in sync.
+_LOCAL_BARRIERS = {ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef}
+
+
 def fingerprint(f: Finding, occurrence: int) -> str:
     """Stable identity for baselining: line-number independent."""
     raw = f"{f.check_id}|{f.path}|{f.message}|{occurrence}"
@@ -122,18 +129,31 @@ class FileContext:
         # The per-class buckets ``by_type`` serves are filled in the same
         # sweep -- a second full pass over ``nodes`` just to bucket them
         # was the next-largest slice once the walk itself was fused.
+        # The per-function ``walk_local`` caches (checks/_flow.py) are
+        # also prefilled here: each node is appended to the list of its
+        # nearest enclosing def/class/lambda, so the path-sensitive and
+        # determinism passes never re-walk a function body they reach
+        # through a built FileContext (the re-walks were the largest
+        # remaining slice of the 2 s budget after the walk was fused).
         nodes: list = []
         parents: dict = {}
         buckets: dict = {}
         if self.tree is not None:
             isinst, AST = isinstance, ast.AST
+            barriers = _LOCAL_BARRIERS
             push = nodes.append
             push(self.tree)
+            # owners[i] is the _tja_local_walk list of nodes[i]'s nearest
+            # enclosing barrier (None at module level), maintained in
+            # lockstep with the queue.
+            owners: list = [None]
+            opush = owners.append
             i = 0
             # ``nodes`` doubles as the BFS queue (index-walked, never
             # popped) -- same order as ``ast.walk``, no deque traffic.
             while i < len(nodes):
                 n = nodes[i]
+                own = owners[i]
                 i += 1
                 cls = n.__class__
                 b = buckets.get(cls)
@@ -141,6 +161,14 @@ class FileContext:
                     buckets[cls] = [n]
                 else:
                     b.append(n)
+                if own is not None:
+                    own.append(n)
+                if cls in barriers:
+                    # Children belong to this barrier's own-body walk; the
+                    # list is complete by the time _build_walk returns, and
+                    # walk_local's membership semantics are order-blind
+                    # (BFS here vs its lazy DFS).
+                    own = n._tja_local_walk = []
                 d = n.__dict__
                 for name in n._fields:
                     v = d.get(name)
@@ -149,9 +177,11 @@ class FileContext:
                             if isinst(item, AST):
                                 parents[id(item)] = n
                                 push(item)
+                                opush(own)
                     elif isinst(v, AST):
                         parents[id(v)] = n
                         push(v)
+                        opush(own)
         self._nodes = nodes
         self._parents = parents
         self._buckets = buckets

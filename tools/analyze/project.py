@@ -43,6 +43,14 @@ from tools.analyze.findings import FileContext
 #: threading factories whose assignment makes an attribute "a lock".
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
+#: Constructor leaf names whose module-level assignment creates a mutable
+#: container singleton (the TJA027 inventory universe, alongside displays
+#: and project-class constructions).
+MUTABLE_CONTAINER_CTORS = {
+    "dict", "list", "set", "bytearray", "deque", "defaultdict",
+    "Counter", "OrderedDict", "ChainMap",
+}
+
 #: Lock factories that are reentrant: a self-cycle on one is legal.
 REENTRANT_FACTORIES = {"RLock", "Condition"}
 
@@ -62,6 +70,27 @@ def _lock_factory_name(value: ast.expr) -> Optional[str]:
     name = fn.id if isinstance(fn, ast.Name) else (
         fn.attr if isinstance(fn, ast.Attribute) else None)
     return name if name in LOCK_FACTORIES else None
+
+
+def _mutable_kind(value: ast.expr) -> Optional[str]:
+    """Container kind ("dict"/"list"/...) when ``value`` constructs a
+    mutable container, "count" for ``itertools.count()``, else None."""
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(value, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(value, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(value, ast.Call):
+        dotted = _dotted(value.func)
+        if dotted is None:
+            return None
+        leaf = dotted.rpartition(".")[2]
+        if leaf in MUTABLE_CONTAINER_CTORS:
+            return leaf
+        if leaf == "count" and dotted in ("count", "itertools.count"):
+            return "count"
+    return None
 
 
 def module_name_for(rel_path: str) -> Optional[str]:
@@ -127,6 +156,12 @@ class ModuleInfo:
     #: module-level singletons: NAME -> raw class-name string from
     #: ``NAME = ClassName(...)`` (e.g. ``METRICS = MetricsRegistry()``).
     global_ctors: Dict[str, str] = field(default_factory=dict)
+    #: module-level mutable containers: NAME -> (kind, lineno) for dict/
+    #: list/set displays and comprehensions, builtin/collections container
+    #: constructors, and ``itertools.count()`` counters.  The raw material
+    #: for the TJA027 shard-state inventory; lock factories are excluded
+    #: (they live in ``module_locks``).
+    global_mutables: Dict[str, Tuple[str, int]] = field(default_factory=dict)
 
 
 def _dotted(node: ast.expr) -> Optional[str]:
@@ -328,10 +363,19 @@ class ProjectContext:
                 kind = _lock_factory_name(node.value)
                 if kind is not None:
                     info.module_locks[name] = kind
-                elif isinstance(node.value, ast.Call):
-                    ctor = _dotted(node.value.func)
-                    if ctor is not None:
-                        info.global_ctors[name] = ctor
+                else:
+                    mut = _mutable_kind(node.value)
+                    if mut is not None:
+                        info.global_mutables[name] = (mut, node.lineno)
+                    if isinstance(node.value, ast.Call):
+                        ctor = _dotted(node.value.func)
+                        if ctor is not None:
+                            info.global_ctors[name] = ctor
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                mut = _mutable_kind(node.value)
+                if mut is not None:
+                    info.global_mutables[node.target.id] = (mut, node.lineno)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info.functions[node.name] = node
             elif isinstance(node, ast.ClassDef):

@@ -64,12 +64,13 @@ def register_project(check_id: str, check_name: str):
 def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
-        broad_except, constant_drift, dead_reasons, donation_discipline,
-        env_contract, event_reasons, exception_escape, finally_restore,
-        host_sync_hot_loop, impure_capture, lock_blocking, lock_discipline,
-        lock_order, metric_drift, orphaned_thread, phase_transitions,
-        py_compat, recompile_hazard, reconcile_purity, resource_leak,
-        retry_backoff, status_discipline, tracer_safety,
+        broad_except, constant_drift, dead_reasons, digest_stability,
+        donation_discipline, env_contract, event_reasons, exception_escape,
+        finally_restore, host_sync_hot_loop, impure_capture, iteration_order,
+        lock_blocking, lock_discipline, lock_order, metric_drift,
+        orphaned_thread, phase_transitions, py_compat, recompile_hazard,
+        reconcile_purity, resource_leak, retry_backoff, shard_state,
+        status_discipline, tracer_safety, unseeded_randomness,
     )
 
 
@@ -217,6 +218,76 @@ def apply_baseline(findings: List[Finding],
 
 # -- output ------------------------------------------------------------------
 
+#: check_id -> one-line rule description, surfaced as the SARIF rule's
+#: fullDescription (code-scanning UIs show it next to each alert).  The
+#: full prose lives in docs/STATIC_ANALYSIS.md's catalog; tests assert
+#: this map covers every registered check.
+RULE_HELP: Dict[str, str] = {
+    "TJA001": "Files must parse under the oldest supported grammar "
+              "(Python 3.10); backslashes in f-string fields included.",
+    "TJA002": "Attributes guarded by a lock in one method must be guarded "
+              "everywhere (static race detector).",
+    "TJA003": "Reconcile paths must not sleep, do raw I/O, or wait "
+              "unbounded; return and re-enqueue instead.",
+    "TJA004": "except Exception must re-raise, log, or forward the bound "
+              "exception -- swallowing is a decision, not a default.",
+    "TJA005": "Label/annotation/env-var contract strings must come from "
+              "api/constants.py, not inline literals.",
+    "TJA006": "No Python branches on traced values, host syncs, or prints "
+              "inside jit/pmap/shard_map-wrapped functions.",
+    "TJA007": "recorder.event(...) reasons must come from the "
+              "EVENT_REASONS registry in api/constants.py.",
+    "TJA008": "threading.Thread needs daemon=True or join evidence; a "
+              "leaked non-daemon thread blocks shutdown.",
+    "TJA009": "job.status.phase/conditions writes must go through the "
+              "status machine's helpers, never raw assignment.",
+    "TJA010": "Whole-program lock-acquisition-order graph must stay "
+              "acyclic (deadlock detector).",
+    "TJA011": "Every TRAININGJOB_* env var must be declared, injected, "
+              "and read -- three-way contract consistency.",
+    "TJA012": "Emitted trainingjob_* metric names must match the "
+              "documented registry in docs/OBSERVABILITY.md.",
+    "TJA013": "Witnessed phase transitions must be legal per "
+              "PHASE_TRANSITIONS in api/constants.py.",
+    "TJA014": "EVENT_REASONS members never emitted anywhere are dead "
+              "documented events.",
+    "TJA015": "Resources acquired from factories must be released on "
+              "every CFG path (exception paths included).",
+    "TJA016": "No blocking I/O reachable while a lock is held -- one "
+              "congested peer stalls every contending thread.",
+    "TJA017": "Thread targets must not let exceptions escape silently "
+              "(whole-project escaping-type fixpoint).",
+    "TJA018": "Remote-retry loops need a pause (with jitter in client/"
+              "controller code) on the back edge.",
+    "TJA019": "Sentinel flags toggled around blocking regions must be "
+              "restored on exception paths (finally).",
+    "TJA020": "No jit wrapper construction in loops and no cache-key-"
+              "churning static arguments.",
+    "TJA021": "No device-to-host syncs on hot-loop paths; deliberate "
+              "fences carry documented waivers.",
+    "TJA022": "Donated buffers must not be read after the donating call; "
+              "hot state round trips should donate.",
+    "TJA023": "No side effects on outside-owned state inside traced "
+              "closures (they run at trace time, not per step).",
+    "TJA024": "Determinism-scoped code must draw randomness only from "
+              "explicitly seeded random.Random instances.",
+    "TJA025": "Nondeterministic values (wall clock, id(), entropy, "
+              "unsorted sets) must not reach digest sinks.",
+    "TJA026": "Loops over sets with order-dependent side effects must "
+              "iterate sorted(...).",
+    "TJA027": "Module-level mutable singletons must be classified in "
+              "SHARD_STATE_REGISTRY (shard-state inventory).",
+}
+
+#: check_id -> SARIF defaultConfiguration level.  Checks that emit both
+#: severities default to their dominant (error) level; per-result levels
+#: still carry the exact severity.
+RULE_DEFAULT_LEVELS: Dict[str, str] = {
+    "TJA004": "warning", "TJA018": "warning", "TJA019": "warning",
+    "TJA021": "warning",
+}
+
+
 def format_sarif(findings: List[Finding]) -> str:
     """Minimal SARIF 2.1.0: one run, rules from the registry, results with
     a physical location + level -- enough for GitHub code-scanning upload,
@@ -225,6 +296,11 @@ def format_sarif(findings: List[Finding]) -> str:
         "id": cid,
         "name": name,
         "shortDescription": {"text": name},
+        "fullDescription": {"text": RULE_HELP.get(cid, name)},
+        "helpUri": ("https://example.invalid/docs/STATIC_ANALYSIS.md"
+                    "#check-catalog"),
+        "defaultConfiguration": {
+            "level": RULE_DEFAULT_LEVELS.get(cid, "error")},
     } for cid, name in sorted(all_checks().items())]
     results = [{
         "ruleId": f.check_id,

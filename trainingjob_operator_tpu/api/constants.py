@@ -475,3 +475,64 @@ ERROR_CONTAINER_STATUS = (
     "RegistryUnavailable",
     "InvalidImageName",
 )
+
+# --- shard-state inventory (TJA027 shard-state-discipline) ------------------
+# Every module-level mutable singleton in the package, classified for the
+# horizontal controller scale-out (ROADMAP item 3).  The analyzer derives
+# the singleton universe from the ASTs (container displays/constructors and
+# project-class constructions at module level) and holds it against this
+# registry: unclassified state is an error, stale entries are errors, and
+# a witnessed mutation of a ``constant`` entry is an error at the write
+# site.  ``python -m tools.analyze --report shard-state`` emits the full
+# machine-readable inventory (docs/STATIC_ANALYSIS.md).
+#
+# Classifications:
+#   constant            -- built at import, never mutated; shards may each
+#                          hold a copy with no coordination.
+#   shard_local         -- keyed by job (or another shardable key): each
+#                          shard owning its keys' slice keeps the truth
+#                          intact.  Safe to scale out as-is.
+#   lock_guarded_shared -- one copy per process, threads coordinate via a
+#                          witnessed lock.  Safe per process; a scale-out
+#                          gets one per shard (acceptable for metrics/
+#                          traces, which scrape per-process anyway).
+#   shard_hostile       -- semantics assume a single global writer over
+#                          the whole keyspace; splitting the keyspace
+#                          splits the truth.  The scale-out worklist.
+SHARD_STATE_CONSTANT = "constant"
+SHARD_STATE_LOCAL = "shard_local"
+SHARD_STATE_LOCK_GUARDED = "lock_guarded_shared"
+SHARD_STATE_HOSTILE = "shard_hostile"
+
+SHARD_STATE_REGISTRY = {
+    # Import-time tables, never written after construction (the registry
+    # classifies itself: it is a module-level dict too).
+    "api.constants.SHARD_STATE_REGISTRY": SHARD_STATE_CONSTANT,
+    "api.constants.PHASE_TRANSITIONS": SHARD_STATE_CONSTANT,
+    "api.types.PHASE_REASON": SHARD_STATE_CONSTANT,
+    "client.kube.KINDS": SHARD_STATE_CONSTANT,
+    "data.tokens._DTYPES": SHARD_STATE_CONSTANT,
+    "data.tokens._CODES": SHARD_STATE_CONSTANT,
+    "fleet.harness._SETTLED_PHASES": SHARD_STATE_CONSTANT,
+    "models.bert.SHARDING_RULES": SHARD_STATE_CONSTANT,
+    "models.moe.SHARDING_RULES": SHARD_STATE_CONSTANT,
+    "models.resnet.SHARDING_RULES": SHARD_STATE_CONSTANT,
+    "obs.trace.NOOP_SPAN": SHARD_STATE_CONSTANT,
+    # Per-job keyed recorders: each controller shard owning its jobs'
+    # slice keeps incident rings / goodput ledgers / telemetry coherent.
+    "obs.incident.INCIDENTS": SHARD_STATE_LOCAL,
+    "obs.goodput.GOODPUT": SHARD_STATE_LOCAL,
+    "obs.telemetry.TELEMETRY": SHARD_STATE_LOCAL,
+    # Process-wide, lock-coordinated: one per shard is the correct shape
+    # (metrics and traces are scraped per process; the sink address and
+    # port cursor are process-scoped by construction).
+    "obs.trace.TRACER": SHARD_STATE_LOCK_GUARDED,
+    "utils.metrics.METRICS": SHARD_STATE_LOCK_GUARDED,
+    "obs.telemetry._published": SHARD_STATE_LOCK_GUARDED,
+    "runtime.localproc._port_cursor": SHARD_STATE_LOCK_GUARDED,
+    # The event sequence counter total-orders events across every job in
+    # the process; per-shard counters would interleave ambiguously in a
+    # merged stream.  ROADMAP item 3's first refactor target: replace
+    # with (shard_id, seq) pairs or a per-job counter.
+    "utils.events._seq": SHARD_STATE_HOSTILE,
+}

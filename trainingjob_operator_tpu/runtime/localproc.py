@@ -100,6 +100,12 @@ class LocalProcRuntime(PodStateRuntime):
         self._log_dir = Path(log_dir or "/tmp/tpu-trainingjob-logs")
         self._log_dir.mkdir(parents=True, exist_ok=True)
         self._port_map: Dict[Tuple[str, str], int] = {}
+        #: (namespace, name) -> launch count: the per-pod monotonic attempt
+        #: counter that keys log filenames.  A wall-clock-ms key collided
+        #: when two restarts of the same pod landed in one millisecond,
+        #: silently overwriting the earlier attempt's log -- exactly the
+        #: log a crash-loop postmortem needs.
+        self._launch_attempts: Dict[Tuple[str, str], int] = {}
         self._node_names = [f"local-{i}" for i in range(nodes)]
         #: None = unbounded (every pending pod launches).  Set to bound node
         #: capacity like a real cluster: pods beyond it go Unschedulable --
@@ -299,7 +305,12 @@ class LocalProcRuntime(PodStateRuntime):
             for e in container.env:
                 env[e.name] = self._rewrite_value(e.value, pod.namespace)
 
-            log_path = self._log_dir / f"{pod.namespace}_{pod.name}_{int(time.time()*1000)}.log"
+            with self._lock:
+                attempt = self._launch_attempts.get(
+                    (pod.namespace, pod.name), 0) + 1
+                self._launch_attempts[(pod.namespace, pod.name)] = attempt
+            log_path = self._log_dir / (
+                f"{pod.namespace}_{pod.name}_{attempt:04d}.log")
             try:
                 log_file = open(log_path, "wb")
             except OSError as e:

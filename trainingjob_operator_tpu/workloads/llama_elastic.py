@@ -232,12 +232,18 @@ def main() -> int:
         cache_dir = rendezvous.compile_cache_dir(rdv)
         if not cache_dir:
             return ""
+        import dataclasses
         import hashlib
 
+        # Field-wise, sorted config rendering: repr(cfg) happens to be
+        # stable for a frozen dataclass, but a default object repr embeds
+        # the process address -- render the fields so the cache key can
+        # never pick one up (TJA025 digest-stability).
+        cfg_desc = str(sorted(dataclasses.asdict(cfg).items()))
         desc = "|".join((jax.__version__, jax.default_backend(),
                          str(jax.device_count()),
                          str(tuple(mesh.devices.shape)),
-                         str(mesh.axis_names), repr(cfg), remat,
+                         str(mesh.axis_names), cfg_desc, remat,
                          str((global_batch, seq, accum, ce_chunk, lr))))
         key = hashlib.sha256(desc.encode()).hexdigest()[:16]
         os.makedirs(cache_dir, exist_ok=True)
