@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -134,7 +134,16 @@ serve-smoke:
 resize-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.resize_smoke
 
+# Fleet SLO plane (docs/SLO.md): a healthy seeded chaos fleet must raise
+# ZERO breaches (false-positive gate) with the profiler attributing >=90%
+# of reconcile CPU to named spans at <5% overhead; the same seeds with the
+# plane off must produce byte-identical plan digest + phase counts (the
+# plane observes, never perturbs); a latency-degraded arm must raise >=1
+# breach, emit the SLOBreach event and stamp the incident bundle.
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.slo_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke
+ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke

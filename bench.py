@@ -733,6 +733,59 @@ def bench_node_chaos(jobs=80, flap_grace=1.0):
     }
 
 
+def bench_slo_plane(jobs=80):
+    """Fleet SLO plane on/off A/B on one seeded chaos schedule
+    (docs/SLO.md): the observability plane must observe, not perturb.
+
+    Same churn + chaos profile both arms (identical seeds); the ``plane``
+    arm additionally runs the tsdb sweeper, the burn-rate engine and the
+    sampling span profiler.  Gates:
+
+    - zero breaches on a healthy fleet (default objectives hold under the
+      stock chaos magnitudes -- a breach here is a false positive);
+    - >=90% of busy worker-thread samples attribute to spans under
+      ``sync_job`` (the profiler resolves the reconcile path, not noise);
+    - profiler overhead < 5% of wall (sampling must stay cheap);
+    - phase counts and the chaos plan digest byte-identical plane-on vs
+      plane-off (the plane cannot touch scheduling determinism).
+    """
+    from trainingjob_operator_tpu.fleet.chaos import ChaosProfile
+    from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+    from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+    profile = ChurnProfile(jobs=jobs, duration=3.0, seed=0, replicas=(1, 3),
+                           run_seconds=(0.05, 0.25))
+    runs = {}
+    for arm in ("off", "plane"):
+        harness = FleetHarness(
+            profile, workers=8, resync_period=30.0, gc_interval=30.0,
+            converge_timeout=300.0,
+            chaos_profile=ChaosProfile(seed=profile.seed, duration=5.0),
+            slo_plane=(arm == "plane"), profiler=(arm == "plane"))
+        runs[arm] = harness.run()
+    off, on = runs["off"], runs["plane"]
+    verdicts = on.slo_verdicts or {}
+    prof = on.profile_top or {}
+    attribution = (prof.get("span_attribution") or {}).get("ratio")
+    overhead = prof.get("overhead_ratio")
+    return {
+        "jobs": jobs,
+        "breaches_total": verdicts.get("breaches_total"),
+        "gate_zero_false_breaches": verdicts.get("breaches_total") == 0,
+        "profiler_samples": prof.get("samples_total"),
+        "span_attribution_ratio": attribution,
+        "gate_attribution_ge_0_9": (attribution is not None
+                                    and attribution >= 0.9),
+        "profiler_overhead_ratio": overhead,
+        "gate_overhead_lt_5pct": overhead is not None and overhead < 0.05,
+        "profile_top": (prof.get("top") or [])[:3],
+        "phase_counts_identical": on.phase_counts == off.phase_counts,
+        "plan_digest_identical": ((on.chaos or {}).get("plan_digest")
+                                  == (off.chaos or {}).get("plan_digest")),
+        "converged": off.converged and on.converged,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Part 2c: fleet sim kernel -- scan-vs-event A/B at 1k jobs
 # ---------------------------------------------------------------------------
@@ -1544,6 +1597,11 @@ def main() -> int:
     except Exception as exc:
         out["node_chaos"] = {"error": f"{type(exc).__name__}: "
                                       f"{str(exc)[:300]}"}
+    try:
+        out["slo_plane"] = bench_slo_plane()
+    except Exception as exc:
+        out["slo_plane"] = {"error": f"{type(exc).__name__}: "
+                                     f"{str(exc)[:300]}"}
     try:
         out["fleet_sim"] = bench_fleet_sim()
     except Exception as exc:

@@ -1,0 +1,402 @@
+"""Fleet SLO plane (docs/SLO.md): tsdb ring edge cases, burn-rate engine
+transitions, incident stamping, the tracer's per-thread span registry, the
+sampling profiler, and the /debug endpoint surface (index + error hygiene).
+
+Unit layer first with private instances (a tsdb over its own registry,
+driven with explicit timestamps -- sweeps and evaluation are pure functions
+of the rings, so the tests pin the delta/clamp/burn arithmetic exactly),
+then the live profiler against a real busy thread, then HTTP.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs import trace
+from trainingjob_operator_tpu.obs.incident import IncidentRecorder
+from trainingjob_operator_tpu.obs.profiler import SpanProfiler
+from trainingjob_operator_tpu.obs.slo import SLOEngine, SLOSpec, default_slos
+from trainingjob_operator_tpu.obs.trace import Tracer
+from trainingjob_operator_tpu.obs.tsdb import TimeSeriesStore
+from trainingjob_operator_tpu.utils.metrics import (
+    MetricsRegistry,
+    serve_metrics,
+)
+
+JOB = "default/slojob"
+
+
+def _tsdb(reg, **kw):
+    kw.setdefault("interval", 0.5)
+    kw.setdefault("points", 240)
+    kw.setdefault("max_series", 2048)
+    return TimeSeriesStore(metrics=reg, **kw)
+
+
+# -- tsdb ring-buffer edge cases ----------------------------------------------
+
+class TestTsdbRings:
+    def test_eviction_at_exactly_full_ring(self):
+        reg = MetricsRegistry()
+        val = [0.0]
+        reg.gauge("g_load", lambda: val[0])
+        ts = _tsdb(reg, points=4)
+        for i in range(5):
+            val[0] = float(i)
+            ts.sample(now=float(i + 1))
+        points = ts.series("g_load")
+        # Ring holds exactly `points`; the oldest sweep fell off.
+        assert len(points) == 4
+        assert points[0] == (2.0, 1.0)
+        assert points[-1] == (5.0, 4.0)
+
+    def test_counter_deltaified_and_reset_clamped_at_zero(self):
+        reg = MetricsRegistry()
+        reg.inc("c_ops", 5.0)
+        ts = _tsdb(reg)
+        ts.sample(now=1.0)           # first sighting: history, not a delta
+        reg.inc("c_ops", 3.0)
+        ts.sample(now=2.0)
+        reg._counters["c_ops"] = 2.0  # simulate a counter reset/backwards
+        ts.sample(now=3.0)
+        assert ts.series("c_ops") == [(1.0, 0.0), (2.0, 3.0), (3.0, 0.0)]
+
+    def test_histogram_materializes_delta_and_sampled_stats(self):
+        reg = MetricsRegistry()
+        reg.observe("h_ms", 10.0)
+        reg.observe("h_ms", 30.0)
+        ts = _tsdb(reg)
+        ts.sample(now=1.0)
+        reg.observe("h_ms", 20.0)
+        ts.sample(now=2.0)
+        assert ts.series("h_ms_count") == [(1.0, 0.0), (2.0, 1.0)]
+        assert ts.series("h_ms_max")[-1] == (2.0, 30.0)
+        assert ts.series("h_ms_p99") is not None
+
+    def test_cardinality_cap_rejects_counted_once_per_name(self):
+        reg = MetricsRegistry()
+        for name in ("g_a", "g_b", "g_c"):
+            reg.gauge(name, lambda: 1.0)
+        ts = _tsdb(reg, max_series=2)
+        ts.sample(now=1.0)
+        # g_c rejected and counted -- audibly, in the registry too.
+        assert ts.dropped_series == 1
+        assert any(k.startswith("trainingjob_tsdb_series_dropped_total")
+                   for k in reg.snapshot())
+        # The drop counter itself becomes a (rejected) series next sweep;
+        # counted once, then the dedup set silences repeats forever.
+        ts.sample(now=2.0)
+        assert ts.dropped_series == 2
+        ts.sample(now=3.0)
+        ts.sample(now=4.0)
+        assert ts.dropped_series == 2
+        assert ts.names() == ["g_a", "g_b"]
+
+    def test_sparkline_and_summary(self):
+        reg = MetricsRegistry()
+        val = [0.0]
+        reg.gauge("g_ramp", lambda: val[0])
+        ts = _tsdb(reg)
+        for i in range(8):
+            val[0] = float(i)
+            ts.sample(now=float(i))
+        text = ts.render_sparklines()
+        assert "g_ramp" in text and "[0..7]" in text
+        summary = ts.summary()
+        assert summary["series_count"] == 1
+        assert summary["series"]["g_ramp"] == {"n": 8, "last": 7.0}
+        assert ts.series("nope") is None and ts.window("nope", 0.0) == []
+
+
+# -- burn-rate engine ---------------------------------------------------------
+
+def _engine(reg, ts, incidents=None):
+    eng = SLOEngine(tsdb=ts, metrics=reg, incidents=incidents
+                    if incidents is not None
+                    else IncidentRecorder(metrics=MetricsRegistry()))
+    eng.short_s, eng.long_s = 2.0, 6.0
+    eng.burn_threshold = 4.0
+    return eng
+
+
+LAT_SPEC = SLOSpec(name="latency", objective="lat_ms stays under 1.0",
+                   series_prefix="g_lat", reduce="max", op="<=",
+                   threshold=1.0, target=0.99, min_points=4)
+
+
+class TestSLOEngine:
+    def test_breach_and_recovery_transitions_fire_sink_once(self):
+        reg = MetricsRegistry()
+        val = [5.0]
+        reg.gauge("g_lat", lambda: val[0])
+        ts = _tsdb(reg)
+        rec = IncidentRecorder(metrics=MetricsRegistry())
+        eng = _engine(reg, ts, incidents=rec)
+        eng.configure((LAT_SPEC,))
+        fired = []
+        eng.set_event_sink(lambda n, r, m: fired.append((n, r)))
+
+        # 8 bad ticks, 0.5 s apart -> both windows burn at 100x budget.
+        for i in range(8):
+            ts.sample(now=0.5 * (i + 1))
+        eng.evaluate(now=4.0)
+        st = eng.verdicts()["slos"]["latency"]
+        assert st["breached"] and st["breaches"] == 1
+        assert st["burn_short"] == 100.0 and st["burn_long"] == 100.0
+        assert fired == [("latency", constants.SLO_BREACH_REASON)]
+        assert any(k.startswith("trainingjob_slo_breaches_total")
+                   for k in reg.snapshot())
+        # Still breached on re-evaluation: no duplicate event.
+        eng.evaluate(now=4.0)
+        assert len(fired) == 1
+
+        # Good ticks fill the short window -> burn 0 -> recovery.
+        val[0] = 0.5
+        for i in range(8):
+            ts.sample(now=4.5 + 0.5 * i)
+        eng.evaluate(now=8.0)
+        st = eng.verdicts()["slos"]["latency"]
+        assert not st["breached"] and st["recoveries"] == 1
+        assert fired[-1] == ("latency", constants.SLO_RECOVERED_REASON)
+
+    def test_no_verdict_below_min_points(self):
+        reg = MetricsRegistry()
+        reg.gauge("g_lat", lambda: 99.0)  # always bad
+        ts = _tsdb(reg)
+        eng = _engine(reg, ts)
+        eng.configure((LAT_SPEC,))
+        for i in range(3):                # min_points is 4
+            ts.sample(now=0.5 * (i + 1))
+        eng.evaluate(now=1.5)
+        assert not eng.verdicts()["slos"]["latency"]["breached"]
+
+    def test_avg_reduce_across_label_sets(self):
+        reg = MetricsRegistry()
+        reg.gauge("g_lat", lambda: 0.0, job="a")
+        reg.gauge("g_lat", lambda: 4.0, job="b")
+        ts = _tsdb(reg)
+        eng = _engine(reg, ts)
+        spec = SLOSpec(name="avg", objective="avg", series_prefix="g_lat",
+                       reduce="avg", op="<=", threshold=3.0)
+        eng.configure((spec,))
+        for i in range(5):
+            ts.sample(now=0.5 * (i + 1))
+        eng.evaluate(now=2.5)
+        st = eng.verdicts()["slos"]["avg"]
+        assert st["last"] == 2.0 and not st["breached"]
+
+    def test_default_slos_shape(self):
+        names = [s.name for s in default_slos()]
+        assert names == ["event_visible_p99", "detect_running_p99",
+                         "goodput_floor", "serve_token_p99"]
+
+
+# -- incident stamping --------------------------------------------------------
+
+def _restart_window(rec, t0):
+    rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON, now=t0)
+    rec.record_event(JOB, constants.RESTARTING_REASON, "restarting",
+                     ts=t0 + 0.2)
+    rec.on_running(JOB, now=t0 + 1.0)
+
+
+class TestIncidentStamping:
+    def test_overlapping_breach_episode_stamps_bundle(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry())
+        rec.record_slo_breach("latency", 4.0)
+        _restart_window(rec, t0=5.0)          # inside the open episode
+        (bundle,) = rec.bundles(JOB)
+        assert bundle["slo_breaches"] == ["latency"]
+
+    def test_closed_episode_does_not_stamp_later_bundle(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry())
+        rec.record_slo_breach("latency", 4.0)
+        rec.record_slo_recovered("latency", 8.0)
+        _restart_window(rec, t0=10.0)         # entirely after the episode
+        (bundle,) = rec.bundles(JOB)
+        # Absent key, not an empty list: healthy bundles stay byte-identical
+        # to pre-SLO-plane serializations.
+        assert "slo_breaches" not in bundle
+
+    def test_clear_resets_episodes(self):
+        rec = IncidentRecorder(metrics=MetricsRegistry())
+        rec.record_slo_breach("latency", 4.0)
+        rec.clear_slo_breaches()
+        _restart_window(rec, t0=5.0)
+        (bundle,) = rec.bundles(JOB)
+        assert "slo_breaches" not in bundle
+
+
+# -- per-thread span registry (obs/trace.py) ----------------------------------
+
+class TestThreadSpanRegistry:
+    def test_nested_stack_root_first_and_exit_restores(self):
+        tracer = Tracer()
+        ident = threading.get_ident()
+        trace.enable_span_registry()
+        try:
+            with tracer.span("outer"):
+                with tracer.span("inner"):
+                    assert trace.thread_span_stack(ident) == ("outer",
+                                                              "inner")
+                assert trace.thread_span_stack(ident) == ("outer",)
+            assert trace.thread_span_stack(ident) == ()
+        finally:
+            trace.disable_span_registry()
+
+    def test_disabled_registry_records_nothing(self):
+        tracer = Tracer()
+        ident = threading.get_ident()
+        with tracer.span("outer"):
+            assert trace.thread_span_stack(ident) == ()
+
+
+# -- sampling profiler --------------------------------------------------------
+
+class TestSpanProfiler:
+    def test_attributes_busy_worker_cpu_to_spans(self):
+        reg = MetricsRegistry()
+        prof = SpanProfiler(metrics=reg, interval_ms=2.0, seed=0)
+        tracer = Tracer()
+        stop = threading.Event()
+
+        def burn():
+            with tracer.span("sync_job"):
+                x = 1
+                while not stop.is_set():
+                    x = (x * 31 + 7) % 1000003
+
+        # Profiler first: the span registry must be live before the burn
+        # thread enters its span, or the sample has nothing to attribute.
+        prof.start()
+        th = threading.Thread(target=burn, daemon=True,
+                              name="trainingjob-worker-t0")
+        try:
+            th.start()
+            time.sleep(0.4)
+        finally:
+            stop.set()
+            th.join(timeout=2.0)
+            prof.stop()
+        rep = prof.report()
+        assert rep["samples_total"] > 0 and rep["busy_samples"] > 0
+        attr = rep["span_attribution"]
+        assert attr["worker_busy"] > 0 and attr["worker_attributed"] > 0
+        # The burn thread spends its whole life inside sync_job; only the
+        # sliver between thread start and span entry can miss.
+        assert attr["ratio"] >= 0.5
+        assert any(row["spans"].startswith("sync_job")
+                   for row in rep["top"])
+        assert "burn" in prof.collapsed()
+        assert 0.0 <= rep["overhead_ratio"] < 1.0
+        assert any(k.startswith("trainingjob_profiler_samples_total")
+                   for k in reg.snapshot())
+
+    def test_noop_until_started_and_reset(self):
+        prof = SpanProfiler(metrics=MetricsRegistry(), interval_ms=2.0,
+                            seed=0)
+        rep = prof.report()
+        assert rep["samples_total"] == 0 and not rep["running"]
+        prof.reset()
+        assert prof.collapsed() == "\n"
+
+
+# -- /debug endpoint surface --------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _get_err(port, path):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(port, path)
+    return exc.value.code, exc.value.read().decode()
+
+
+class TestDebugEndpoints:
+    @pytest.fixture
+    def server(self):
+        reg = MetricsRegistry()
+        reg.gauge("g_lat", lambda: 1.0)
+        ts = _tsdb(reg)
+        ts.sample(now=1.0)
+        ts.sample(now=2.0)
+        eng = _engine(reg, ts)
+        eng.configure((LAT_SPEC,))
+        eng.evaluate(now=2.0)
+        prof = SpanProfiler(metrics=reg, interval_ms=5.0, seed=0)
+        tracer = Tracer()
+        with tracer.span("sync_job", job=JOB):
+            pass
+        srv = serve_metrics(0, reg, tracer=tracer, events_fn=lambda: [],
+                            tsdb=ts, slos=eng, profiler=prof)
+        yield srv.server_address[1]
+        srv.shutdown()
+
+    def test_debug_index_enumerates_routes(self, server):
+        status, body = _get(server, "/debug")
+        doc = json.loads(body)
+        assert status == 200 and doc["count"] == len(doc["routes"])
+        by_path = {r["path"]: r for r in doc["routes"]}
+        assert by_path["/debug/timeseries"]["enabled"]
+        assert by_path["/debug/slo"]["enabled"]
+        assert by_path["/debug/profile"]["enabled"]
+        assert not by_path["/debug/incidents"]["enabled"]  # not wired here
+        assert all(r["description"] for r in doc["routes"])
+
+    def test_timeseries_summary_series_and_sparkline(self, server):
+        status, body = _get(server, "/debug/timeseries")
+        doc = json.loads(body)
+        assert status == 200 and doc["series_count"] == 1
+        status, body = _get(server, "/debug/timeseries?series=g_lat")
+        doc = json.loads(body)
+        assert status == 200 and doc["points"] == [[1.0, 1.0], [2.0, 1.0]]
+        status, body = _get(server,
+                            "/debug/timeseries?format=sparkline")
+        assert status == 200 and "g_lat" in body
+
+    def test_timeseries_unknown_series_404(self, server):
+        code, _ = _get_err(server, "/debug/timeseries?series=nope")
+        assert code == 404
+
+    def test_timeseries_bad_format_400(self, server):
+        code, body = _get_err(server, "/debug/timeseries?format=csv")
+        assert code == 400 and "csv" in body
+
+    def test_slo_verdicts_and_bad_format_400(self, server):
+        status, body = _get(server, "/debug/slo")
+        doc = json.loads(body)
+        assert status == 200 and "latency" in doc["slos"]
+        assert doc["windows"]["burn_threshold"] == 4.0
+        code, body = _get_err(server, "/debug/slo?format=xml")
+        assert code == 400 and "xml" in body
+
+    def test_profile_report_collapsed_and_bad_format_400(self, server):
+        status, body = _get(server, "/debug/profile")
+        assert status == 200 and "span_attribution" in json.loads(body)
+        status, _ = _get(server, "/debug/profile?format=collapsed")
+        assert status == 200
+        code, body = _get_err(server, "/debug/profile?format=pprof")
+        assert code == 400 and "pprof" in body
+
+    def test_events_bad_format_400(self, server):
+        code, body = _get_err(server, "/debug/events?format=yaml")
+        assert code == 400 and "yaml" in body
+
+    def test_unwired_routes_404(self):
+        srv = serve_metrics(0, MetricsRegistry())
+        port = srv.server_address[1]
+        try:
+            for path in ("/debug/timeseries", "/debug/slo",
+                         "/debug/profile"):
+                code, _ = _get_err(port, path)
+                assert code == 404
+        finally:
+            srv.shutdown()

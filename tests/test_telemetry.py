@@ -409,12 +409,17 @@ class TestDebugStepsEndpoint:
             srv.shutdown()
 
     def test_traces_junk_limit_and_unknown_format(self, server):
-        # ?limit=junk falls back to "no limit" instead of 500ing.
-        status, body = _get(server, "/debug/traces?limit=junk")
-        assert status == 200 and json.loads(body)["count"] == 1
-        # Unknown ?format= serves the default JSON form.
-        status, body = _get(server, "/debug/traces?format=starlight")
-        assert status == 200 and "traces" in json.loads(body)
+        # ?limit=junk is an explicit 400 naming the bad value, never a 500
+        # and never a silent fallback (docs/OBSERVABILITY.md).
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/debug/traces?limit=junk")
+        assert exc.value.code == 400
+        assert "junk" in exc.value.read().decode()
+        # Unknown ?format= likewise 400s with the accepted values.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/debug/traces?format=starlight")
+        assert exc.value.code == 400
+        assert "starlight" in exc.value.read().decode()
 
     def test_events_with_no_matches_is_empty_not_error(self, server):
         status, body = _get(server, "/debug/events?job=absent/job")

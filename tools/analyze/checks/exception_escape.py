@@ -32,11 +32,11 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.analyze.findings import ERROR, Finding
+from tools.analyze.findings import ERROR, Finding, _LOCAL_BARRIERS
 from tools.analyze.project import ProjectContext, _self_attr
 from tools.analyze.runner import register_project
 from tools.analyze.checks._flow import (
-    call_dotted, enclosing, functions_of, parents_of, walk_local,
+    call_dotted, enclosing, functions_of, parents_of,
 )
 from tools.analyze.cfg import handler_type_names
 
@@ -92,6 +92,17 @@ class _Escapes:
         self._caught: Dict[int, Tuple[Set[str], bool]] = {}
         #: id(fn) -> {name: nested def node} directly inside fn's body.
         self._local_defs: Dict[int, Dict[str, ast.AST]] = {}
+        #: id(fn) -> the Call/Raise/Assert nodes in fn's own body.
+        self._interesting: Dict[int, List[ast.AST]] = {}
+
+    @staticmethod
+    def _owner(parents, node) -> Optional[ast.AST]:
+        """Nearest enclosing scope barrier -- the function (or class/lambda)
+        whose ``walk_local`` would yield ``node``."""
+        cur = parents.get(id(node))
+        while cur is not None and cur.__class__ not in _LOCAL_BARRIERS:
+            cur = parents.get(id(cur))
+        return cur
 
     def index(self) -> None:
         for rel, ctx in self.pc.files.items():
@@ -104,10 +115,24 @@ class _Escapes:
                 self.sites.append((fn, parents, mod.name if mod else None,
                                    cls.name if cls else None))
                 self.sets[id(fn)] = set()
-                self._local_defs[id(fn)] = {
-                    n.name: n for n in walk_local(fn)
-                    if isinstance(n, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef))}
+                self._local_defs[id(fn)] = {}
+            # Attribute nested defs and raise/assert/call sites to their
+            # owning function by parent-chain (#interesting-nodes x depth)
+            # instead of re-walking every function body (#all-nodes): the
+            # body rewalks were this pass's largest slice of the lint
+            # budget.  Owner == nearest barrier reproduces walk_local's
+            # membership exactly; order within a set is irrelevant to the
+            # fixpoint.
+            for d in functions_of(ctx):
+                own = self._owner(parents, d)
+                if own is not None:
+                    defs = self._local_defs.get(id(own))
+                    if defs is not None:
+                        defs[d.name] = d
+            for node in ctx.by_type(ast.Call, ast.Raise, ast.Assert):
+                own = self._owner(parents, node)
+                if own is not None and id(own) in self.sets:
+                    self._interesting.setdefault(id(own), []).append(node)
 
     def _callee_nodes(self, call: ast.Call, fn: ast.AST, parents,
                       mod_name: Optional[str],
@@ -161,10 +186,7 @@ class _Escapes:
             fid = id(fn)
             const[fid] = set()
             deps[fid] = []
-            for node in walk_local(fn):
-                # Exact-class dispatch with no allocations on the skip
-                # path: ~95% of nodes are neither raise/assert/call, and
-                # this loop runs over every function body in the tree.
+            for node in self._interesting.get(fid, ()):
                 ncls = node.__class__
                 if ncls is ast.Call:
                     callees = self._callee_nodes(node, fn, parents,
@@ -214,6 +236,12 @@ def _target_functions(pc: ProjectContext, esc: _Escapes
         mod = pc.module_of_path(rel)
         parents = parents_of(ctx)
         for call in ctx.by_type(ast.Call):
+            f = call.func
+            # Cheap name gate before building the dotted string: almost no
+            # call in the tree is a Thread construction.
+            if not (f.__class__ is ast.Name and f.id == "Thread"
+                    or f.__class__ is ast.Attribute and f.attr == "Thread"):
+                continue
             dotted = call_dotted(call)
             if dotted not in ("threading.Thread", "Thread"):
                 continue

@@ -33,9 +33,10 @@ import ast
 from typing import List, Optional
 
 from tools.analyze import dataflow
-from tools.analyze.findings import FileContext, Finding, WARNING
+from tools.analyze.findings import (FileContext, Finding, WARNING,
+                                    _LOCAL_BARRIERS)
 from tools.analyze.runner import register
-from tools.analyze.checks._flow import functions_of, walk_local
+from tools.analyze.checks._flow import functions_of
 
 
 def _toggle_target(stmt: ast.AST) -> Optional[str]:
@@ -80,18 +81,30 @@ def check(ctx: FileContext) -> List[Finding]:
         return []
     findings: List[Finding] = []
     analysis = _Toggles()
+    # Cheap gate: >= 2 sentinel assignments to one target, else no
+    # set/restore pair can exist and the CFG build is wasted.  The counts
+    # come from one sweep of the file's Assign bucket attributed to the
+    # owning function by parent-chain (#assigns x depth), not a rewalk of
+    # every function body (#all-nodes) -- the rewalks were this pass's
+    # dominant cost on toggle-free files, i.e. nearly all of them.
+    parents = ctx.parents
+    barriers = _LOCAL_BARRIERS
+    counts_by_fn = {}
+    for node in ctx.by_type(ast.Assign):
+        tgt = _toggle_target(node)
+        if tgt is None:
+            continue
+        cur = parents.get(id(node))
+        while cur is not None and cur.__class__ not in barriers:
+            cur = parents.get(id(cur))
+        if cur is None:
+            continue
+        counts = counts_by_fn.setdefault(id(cur), {})
+        counts[tgt] = counts.get(tgt, 0) + 1
     for fn in functions_of(ctx):
         if fn.name == "__init__":
             continue
-        # Cheap gate: >= 2 sentinel assignments to one target, else no
-        # set/restore pair can exist and the CFG build is wasted.
-        counts = {}
-        for node in walk_local(fn):
-            if node.__class__ is not ast.Assign:
-                continue
-            tgt = _toggle_target(node)
-            if tgt is not None:
-                counts[tgt] = counts.get(tgt, 0) + 1
+        counts = counts_by_fn.get(id(fn), {})
         if not any(c >= 2 for c in counts.values()):
             continue
         cfg = ctx.cfg(fn)

@@ -30,7 +30,8 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from tools.analyze import dataflow
-from tools.analyze.findings import ERROR, Finding, walk_fast
+from tools.analyze.findings import (ERROR, Finding, walk_fast,
+                                    _LOCAL_BARRIERS)
 from tools.analyze.project import ProjectContext
 from tools.analyze.runner import register_project
 from tools.analyze.checks._flow import (
@@ -41,38 +42,54 @@ from tools.analyze.project import LOCK_FACTORIES
 
 
 class _FnFacts:
-    """One walk_local sweep per function, shared by every stage of this
-    pass (the repeated per-function walks were the analyzer's hottest
-    profile line before this was consolidated)."""
+    """One sweep per file, shared by every stage of this pass."""
 
     __slots__ = ("locks", "withs", "has_acquire", "blocking")
 
-    def __init__(self, fn: ast.AST):
+    def __init__(self):
         self.locks: Set[str] = set()
         self.withs: List[ast.AST] = []
         self.has_acquire = False
         self.blocking: List[Tuple[ast.Call, str]] = []
-        # Exact-class dispatch, most common kind first: this loop runs over
-        # every node of every function body and the isinstance tuple sieves
-        # were a visible slice of the lint budget.
-        for node in walk_local(fn):
-            ncls = node.__class__
-            if ncls is ast.Call:
-                if node.func.__class__ is ast.Attribute \
-                        and node.func.attr == "acquire":
-                    self.has_acquire = True
-                why = blocking_reason(node)
-                if why is not None:
-                    self.blocking.append((node, why))
-            elif ncls is ast.With or ncls is ast.AsyncWith:
-                self.withs.append(node)
-            elif ncls is ast.Assign and node.value.__class__ is ast.Call:
-                f = node.value.func
-                name = f.id if f.__class__ is ast.Name else (
-                    f.attr if f.__class__ is ast.Attribute else None)
-                if name in LOCK_FACTORIES:
-                    self.locks |= {t.id for t in node.targets
-                                   if t.__class__ is ast.Name}
+
+
+def _collect_facts(ctx, fns) -> Dict[int, "_FnFacts"]:
+    """Facts for every function of one file, from a single sweep of the
+    relevant by_type buckets with each node attributed to its owning
+    function by parent-chain (#interesting-nodes x depth) -- re-walking
+    every function body (#all-nodes) was this pass's hottest profile line.
+    Owner == nearest scope barrier reproduces walk_local membership: nodes
+    inside a nested lambda/class belong to it, not to the enclosing def."""
+    facts = {id(fn): _FnFacts() for fn in fns}
+    parents = ctx.parents
+    barriers = _LOCAL_BARRIERS
+    for node in ctx.by_type(ast.Call, ast.With, ast.AsyncWith, ast.Assign):
+        cur = parents.get(id(node))
+        while cur is not None and cur.__class__ not in barriers:
+            cur = parents.get(id(cur))
+        if cur is None:
+            continue
+        ff = facts.get(id(cur))
+        if ff is None:
+            continue
+        ncls = node.__class__
+        if ncls is ast.Call:
+            if node.func.__class__ is ast.Attribute \
+                    and node.func.attr == "acquire":
+                ff.has_acquire = True
+            why = blocking_reason(node)
+            if why is not None:
+                ff.blocking.append((node, why))
+        elif ncls is ast.With or ncls is ast.AsyncWith:
+            ff.withs.append(node)
+        elif node.value.__class__ is ast.Call:
+            f = node.value.func
+            name = f.id if f.__class__ is ast.Name else (
+                f.attr if f.__class__ is ast.Attribute else None)
+            if name in LOCK_FACTORIES:
+                ff.locks |= {t.id for t in node.targets
+                             if t.__class__ is ast.Name}
+    return facts
 
 
 def _may_block(pc: ProjectContext, res: _Resolver,
@@ -158,8 +175,7 @@ def check(pc: ProjectContext) -> List[Finding]:
             continue
         fns = functions_of(ctx)
         fns_by_file[rel] = fns
-        for fn in fns:
-            facts_of[id(fn)] = _FnFacts(fn)
+        facts_of.update(_collect_facts(ctx, fns))
     may_block = _may_block(pc, res, facts_of)
     findings: List[Finding] = []
     seen: Set[Tuple[str, int]] = set()

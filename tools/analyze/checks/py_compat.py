@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ast
 import io
+import sys
 import tokenize
 from typing import List, Tuple
 
@@ -116,8 +117,14 @@ def check(ctx: FileContext) -> List[Finding]:
             f"does not parse under Python "
             f"{MIN_GRAMMAR[0]}.{MIN_GRAMMAR[1]} grammar: {msg}"))
         return findings
-    if "\\" not in ctx.source \
-            or not any(isinstance(n, ast.JoinedStr) for n in ctx.nodes):
+    if sys.version_info < (3, 12):
+        # The file parsed under this interpreter, and before 3.12 a
+        # backslash inside a replacement field IS a SyntaxError -- the
+        # token scan cannot find anything the parse gate didn't.  It only
+        # earns its keep (and its tokenize cost) on 3.12+, where PEP 701
+        # makes the parse succeed.
+        return findings
+    if "\\" not in ctx.source or not ctx.by_type(ast.JoinedStr):
         return findings   # no f-string + backslash combo: skip the tokenize
     # Second gate: only tokenize when a backslash falls within some
     # f-string's own line span.  Most files that pass the first gate have
@@ -125,7 +132,7 @@ def check(ctx: FileContext) -> List[Finding]:
     # f-string -- a line-span scan is ~free, a full tokenize is not.
     lines = ctx.source.split("\n")
     if not any("\\" in line
-               for n in ctx.nodes if isinstance(n, ast.JoinedStr)
+               for n in ctx.by_type(ast.JoinedStr)
                for line in lines[n.lineno - 1:(n.end_lineno or n.lineno)]):
         return findings
     for line, col in _fstring_backslash_positions(ctx.source):

@@ -1,0 +1,148 @@
+"""Seeded SLO-plane smoke: no false alarms, real alarms, zero footprint.
+
+The ``make slo-smoke`` driver (wired into ``make ci``): three subprocess
+runs of the fleet harness exercising the fleet SLO plane (docs/SLO.md).
+Subprocesses, not in-process runs: the tsdb, SLO engine, profiler,
+incident recorder and metrics registry are process-global singletons, so
+only a fresh interpreter gives each arm a clean slate.
+
+- **healthy / plane on** (``--chaos --slo --profile``): the default
+  objectives must hold under the stock chaos magnitudes -- ANY breach on
+  this arm is a false positive.  The profiler must attribute >= 90% of
+  busy worker samples to spans under ``sync_job`` and cost < 5% of wall.
+- **healthy / plane off**: same churn + chaos seeds without the plane.
+  The chaos plan digest and final phase counts must be byte-identical to
+  the plane-on arm -- observing the fleet must not perturb it.
+- **degraded**: same harness with per-write API latency injected and the
+  event->visible objective tightened below it (env overrides, tight
+  burn-rate windows so the breach fires inside the run).  The engine must
+  raise >= 1 breach, the breach must land as an ``SLOBreach`` event, and
+  at least one incident bundle must carry the breached objective.
+
+Usage::
+
+    python -m tools.slo_smoke [--jobs 40] [--seed 0] [--chaos-seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+def _run(args: argparse.Namespace, extra=(), env_overrides=None,
+         jobs=None) -> dict:
+    cmd = [sys.executable, "-m", "trainingjob_operator_tpu.fleet.harness",
+           "--jobs", str(jobs if jobs is not None else args.jobs),
+           "--seed", str(args.seed),
+           "--duration", str(args.duration),
+           "--replicas-min", "1", "--replicas-max", "3",
+           "--workers", "4", "--chaos",
+           "--chaos-seed", str(args.chaos_seed),
+           "--converge-timeout", str(args.converge_timeout), "--quiet"]
+    cmd += list(extra)
+    env = dict(os.environ)
+    if env_overrides:
+        env.update(env_overrides)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600, env=env)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+        raise SystemExit("slo fleet run failed (rc=%d):\n%s"
+                         % (proc.returncode, "\n".join(tail)))
+    return json.loads(proc.stdout)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("slo-smoke")
+    parser.add_argument("--jobs", type=int, default=40)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument("--duration", type=float, default=2.0)
+    parser.add_argument("--converge-timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    # -- Arm 1: healthy fleet, plane on -- zero false breaches -------------
+    on = _run(args, extra=["--slo", "--profile"])
+    verdicts = on.get("slo_verdicts") or {}
+    prof = on.get("profile_top") or {}
+    attribution = (prof.get("span_attribution") or {}).get("ratio")
+    overhead = prof.get("overhead_ratio")
+    print(f"healthy: converged={on['converged']} "
+          f"breaches={verdicts.get('breaches_total')} "
+          f"profiler_samples={prof.get('samples_total')} "
+          f"attribution={attribution} overhead={overhead}")
+    if not on["converged"] or on["violations"]:
+        print("healthy plane-on run did not converge cleanly:\n"
+              + "\n".join(on["violations"][:10]), file=sys.stderr)
+        return 1
+    if verdicts.get("breaches_total") != 0:
+        print(f"healthy fleet raised {verdicts.get('breaches_total')} "
+              f"breach(es) -- false positive: {verdicts.get('slos')}",
+              file=sys.stderr)
+        return 1
+    if not prof.get("samples_total"):
+        print("profiler collected no samples", file=sys.stderr)
+        return 1
+    if attribution is None or attribution < 0.9:
+        print(f"span attribution {attribution} < 0.9: the profiler lost "
+              f"the reconcile path (top: {prof.get('top')})",
+              file=sys.stderr)
+        return 1
+    if overhead is None or overhead >= 0.05:
+        print(f"profiler overhead {overhead} >= 5% of wall",
+              file=sys.stderr)
+        return 1
+
+    # -- Arm 2: same seeds, plane off -- the plane must not perturb --------
+    off = _run(args)
+    if (on["chaos"]["plan_digest"] != off["chaos"]["plan_digest"]
+            or on["phase_counts"] != off["phase_counts"]):
+        print("SLO plane perturbed the fleet:\n"
+              f"  digest  on={on['chaos']['plan_digest']}\n"
+              f"          off={off['chaos']['plan_digest']}\n"
+              f"  phases  on={on['phase_counts']}\n"
+              f"          off={off['phase_counts']}", file=sys.stderr)
+        return 1
+
+    # -- Arm 3: degraded fleet -- the alarm must actually fire -------------
+    # 250 ms injected per controller write vs a 100 ms event->visible
+    # objective; fast sweep/eval cadence and sub-second burn windows so
+    # multi-window confirmation lands inside the run.
+    degraded = _run(
+        args, extra=["--slo", "--api-latency", "0.25"], jobs=20,
+        env_overrides={
+            "TRAININGJOB_SLO_EVENT_P99_MS": "100",
+            "TRAININGJOB_TSDB_INTERVAL_S": "0.1",
+            "TRAININGJOB_SLO_EVAL_S": "0.2",
+            "TRAININGJOB_SLO_WINDOWS": "0.5:1.5",
+        })
+    dv = degraded.get("slo_verdicts") or {}
+    print(f"degraded: converged={degraded['converged']} "
+          f"breaches={dv.get('breaches_total')} "
+          f"breach_events={dv.get('breach_events')} "
+          f"stamped_bundles={dv.get('stamped_bundles')}")
+    if not dv.get("breaches_total"):
+        print(f"degraded fleet raised no breach -- the engine is blind: "
+              f"{dv.get('slos')}", file=sys.stderr)
+        return 1
+    if not dv.get("breach_events"):
+        print("breach fired but no SLOBreach event reached the recorder",
+              file=sys.stderr)
+        return 1
+    if not dv.get("stamped_bundles"):
+        print("breach fired but no incident bundle carries slo_breaches",
+              file=sys.stderr)
+        return 1
+
+    print(f"slo smoke ok: plan {on['chaos']['plan_digest'][:12]} "
+          f"healthy breaches=0 degraded breaches="
+          f"{dv['breaches_total']} phase_counts={on['phase_counts']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,33 @@ SERVE_SCALE_UP_QUEUE_ENV = "TRAININGJOB_SERVE_SCALE_UP_QUEUE"
 SERVE_SCALE_DOWN_QUEUE_ENV = "TRAININGJOB_SERVE_SCALE_DOWN_QUEUE"
 SERVE_SCALE_COOLDOWN_ENV = "TRAININGJOB_SERVE_SCALE_COOLDOWN_S"
 
+# --- Fleet SLO plane (obs/tsdb.py, obs/slo.py, obs/profiler.py) -------------
+# In-process time-series store: snapshot cadence (seconds), ring length
+# (points retained per series), and the series-cardinality cap past which
+# new label sets are rejected -- counted via
+# trainingjob_tsdb_series_dropped_total, never silently.
+TSDB_INTERVAL_ENV = "TRAININGJOB_TSDB_INTERVAL_S"
+TSDB_POINTS_ENV = "TRAININGJOB_TSDB_POINTS"
+TSDB_MAX_SERIES_ENV = "TRAININGJOB_TSDB_MAX_SERIES"
+# Burn-rate engine (docs/SLO.md): evaluation cadence, the "short:long"
+# alerting-window pair (seconds, multi-window multi-burn-rate style), the
+# burn-rate threshold both windows must exceed before a breach fires, and
+# per-objective thresholds for the built-in SLO inventory.
+SLO_EVAL_ENV = "TRAININGJOB_SLO_EVAL_S"
+SLO_WINDOWS_ENV = "TRAININGJOB_SLO_WINDOWS"
+SLO_BURN_ENV = "TRAININGJOB_SLO_BURN"
+SLO_EVENT_P99_MS_ENV = "TRAININGJOB_SLO_EVENT_P99_MS"
+SLO_RESTART_P99_S_ENV = "TRAININGJOB_SLO_RESTART_P99_S"
+SLO_GOODPUT_FLOOR_ENV = "TRAININGJOB_SLO_GOODPUT_FLOOR"
+SLO_SERVE_P99_MS_ENV = "TRAININGJOB_SLO_SERVE_P99_MS"
+# Sampling stack profiler: base sampling interval (milliseconds; each
+# actual gap is jittered off a seeded random.Random so samples don't alias
+# the controller's periodic loops) and the jitter seed.  Distinct names
+# from TRAININGJOB_PROFILE_DIR/STEPS above -- those drive the *workload*
+# jax.profiler; these drive the in-operator span profiler.
+PROFILE_INTERVAL_MS_ENV = "TRAININGJOB_PROFILE_INTERVAL_MS"
+PROFILE_SEED_ENV = "TRAININGJOB_PROFILE_SEED"
+
 #: Env vars that are part of the contract but *user-set* (pod template or
 #: operator environment), never injected by the controller: workload tuning
 #: knobs.  TJA011 env-contract treats membership here as the injection
@@ -309,6 +336,18 @@ USER_ENV_KNOBS = frozenset((
     SERVE_SCALE_UP_QUEUE_ENV,
     SERVE_SCALE_DOWN_QUEUE_ENV,
     SERVE_SCALE_COOLDOWN_ENV,
+    TSDB_INTERVAL_ENV,
+    TSDB_POINTS_ENV,
+    TSDB_MAX_SERIES_ENV,
+    SLO_EVAL_ENV,
+    SLO_WINDOWS_ENV,
+    SLO_BURN_ENV,
+    SLO_EVENT_P99_MS_ENV,
+    SLO_RESTART_P99_S_ENV,
+    SLO_GOODPUT_FLOOR_ENV,
+    SLO_SERVE_P99_MS_ENV,
+    PROFILE_INTERVAL_MS_ENV,
+    PROFILE_SEED_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --
@@ -397,6 +436,14 @@ STEP_RESUMED_REASON = "StepResumed"
 # /debug/incidents.
 INCIDENT_RECORDED_REASON = "IncidentRecorded"
 
+# Fleet SLO plane (obs/slo.py): a declared objective's burn rate crossed
+# its threshold in both alerting windows (SLOBreach) / the short window's
+# burn dropped back to zero (SLORecovered).  Fleet-scoped -- recorded
+# against a synthetic FleetSLO object, not any one job, so per-job event
+# streams are not polluted by fleet-wide verdicts.
+SLO_BREACH_REASON = "SLOBreach"
+SLO_RECOVERED_REASON = "SLORecovered"
+
 # Action-trail reasons (previously inline literals at call sites).
 VALIDATION_FAILED_REASON = "ValidationFailed"
 SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
@@ -430,6 +477,8 @@ EVENT_REASONS = frozenset((
     STEP_STALLED_REASON,
     STEP_RESUMED_REASON,
     INCIDENT_RECORDED_REASON,
+    SLO_BREACH_REASON,
+    SLO_RECOVERED_REASON,
     VALIDATION_FAILED_REASON,
     SUCCESSFUL_CREATE_POD_REASON,
     SUCCESSFUL_DELETE_POD_REASON,
@@ -528,6 +577,18 @@ SHARD_STATE_REGISTRY = {
     # port cursor are process-scoped by construction).
     "obs.trace.TRACER": SHARD_STATE_LOCK_GUARDED,
     "utils.metrics.METRICS": SHARD_STATE_LOCK_GUARDED,
+    # SLO plane (docs/SLO.md): the tsdb samples the process-local METRICS
+    # registry, the burn-rate engine reads the process-local tsdb, and the
+    # profiler samples the process's own threads -- one instance per shard
+    # is the correct shape, coordinated by their own locks.
+    "obs.tsdb.TSDB": SHARD_STATE_LOCK_GUARDED,
+    "obs.slo.SLOS": SHARD_STATE_LOCK_GUARDED,
+    "obs.profiler.PROFILER": SHARD_STATE_LOCK_GUARDED,
+    # Profiler's active-span map: thread ident -> innermost open Span.
+    # Each thread writes only its own key (GIL-atomic dict ops), the same
+    # per-thread locality the tracer's contextvar gives -- shard-local by
+    # thread, not cross-shard state.
+    "obs.trace._THREAD_SPANS": SHARD_STATE_LOCAL,
     "obs.telemetry._published": SHARD_STATE_LOCK_GUARDED,
     "runtime.localproc._port_cursor": SHARD_STATE_LOCK_GUARDED,
     # The event sequence counter total-orders events across every job in

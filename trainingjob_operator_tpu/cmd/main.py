@@ -88,6 +88,11 @@ def main(argv: Optional[list] = None) -> int:
                         help="On shutdown, write the reconcile trace ring as "
                              "Chrome trace_event JSON to this path "
                              "(load in Perfetto / chrome://tracing).")
+    parser.add_argument("--slo-plane", action="store_true",
+                        help="Run the fleet SLO plane (docs/SLO.md): tsdb "
+                             "sweeper, burn-rate engine and sampling span "
+                             "profiler; served at /debug/timeseries, "
+                             "/debug/slo and /debug/profile.")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     opt = OperatorOptions.from_args(args)
@@ -116,18 +121,29 @@ def main(argv: Optional[list] = None) -> int:
     metrics_server = None
     if args.metrics_port:
         from trainingjob_operator_tpu.obs.incident import INCIDENTS
+        from trainingjob_operator_tpu.obs.profiler import PROFILER
+        from trainingjob_operator_tpu.obs.slo import SLOS
         from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
         from trainingjob_operator_tpu.obs.trace import TRACER
+        from trainingjob_operator_tpu.obs.tsdb import TSDB
         from trainingjob_operator_tpu.utils.metrics import serve_metrics
 
         metrics_server = serve_metrics(
             args.metrics_port, tracer=TRACER,
             events_fn=lambda: clientset.events.list(None),
             ready_fn=controller.ready, telemetry=TELEMETRY,
-            incidents=INCIDENTS)
+            incidents=INCIDENTS, tsdb=TSDB, slos=SLOS, profiler=PROFILER)
         print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():
+        if args.slo_plane:
+            from trainingjob_operator_tpu.obs.profiler import PROFILER
+            from trainingjob_operator_tpu.obs.slo import SLOS
+            from trainingjob_operator_tpu.obs.tsdb import TSDB
+
+            TSDB.start()
+            SLOS.start()
+            PROFILER.start()
         runtime.start()
         controller.run()
         applied = []
@@ -145,6 +161,14 @@ def main(argv: Optional[list] = None) -> int:
         finally:
             controller.stop()
             runtime.stop()
+            if args.slo_plane:
+                from trainingjob_operator_tpu.obs.profiler import PROFILER
+                from trainingjob_operator_tpu.obs.slo import SLOS
+                from trainingjob_operator_tpu.obs.tsdb import TSDB
+
+                SLOS.stop()
+                PROFILER.stop()
+                TSDB.stop()
             if metrics_server is not None:
                 metrics_server.shutdown()
             if args.trace_out:

@@ -49,6 +49,7 @@ from trainingjob_operator_tpu.controller.status import StatusManager, update_job
 from trainingjob_operator_tpu.core.objects import Node, OwnerReference, Pod, Service
 from trainingjob_operator_tpu.obs.goodput import GOODPUT
 from trainingjob_operator_tpu.obs.incident import INCIDENTS
+from trainingjob_operator_tpu.obs.slo import SLOS, FleetSLO
 from trainingjob_operator_tpu.obs.telemetry import TELEMETRY, peak_flops_for_accelerator
 from trainingjob_operator_tpu.obs.trace import TRACER, current_context
 from trainingjob_operator_tpu.utils.events import EventRecorder
@@ -282,6 +283,11 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # same event plumbing as IncidentRecorded.
         self.recorder.set_sink(self._incident_event_tap)
         INCIDENTS.set_event_sink(self._telemetry_event)
+        # Fleet SLO plane (docs/SLO.md): burn-rate breach/recovery verdicts
+        # surface as fleet-scoped events through the same recorder.  The
+        # engine itself only runs when something starts it (harness --slo,
+        # cmd --slo-plane); wiring the sink is free.
+        SLOS.set_event_sink(self._slo_event)
         for i in range(n):
             th = threading.Thread(target=self._worker, daemon=True,
                                   name=f"trainingjob-worker-{i}")
@@ -312,6 +318,7 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.metrics.remove_gauge("trainingjob_quarantined_keys")
         TELEMETRY.set_event_sink(None)
         INCIDENTS.set_event_sink(None)
+        SLOS.set_event_sink(None)
         self.recorder.set_sink(None)
         self._ready.clear()
         self._stop.set()
@@ -346,6 +353,17 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
                  else EventRecorder.NORMAL)
         self.recorder.event(job, etype, reason, message)
         self.enqueue_job(job, rate_limited=True)
+
+    def _slo_event(self, slo_name: str, reason: str, message: str) -> None:
+        """SLO engine callback (runs on the engine's timer thread): a
+        breach/recovery transition becomes a fleet-scoped event against a
+        synthetic FleetSLO object -- kubectl-visible without attributing a
+        fleet property to any one job.  The incident tap's KIND filter
+        keeps these out of per-job incident rings."""
+        etype = (EventRecorder.WARNING
+                 if reason == constants.SLO_BREACH_REASON
+                 else EventRecorder.NORMAL)
+        self.recorder.event(FleetSLO(slo_name), etype, reason, message)
 
     def _resync_loop(self) -> None:
         """Periodic full re-enqueue (reference: informer resync, 10 s),

@@ -102,6 +102,9 @@ from trainingjob_operator_tpu.runtime.sim import (
     resolve_kernel,
 )
 from trainingjob_operator_tpu.obs.incident import INCIDENTS
+from trainingjob_operator_tpu.obs.profiler import PROFILER
+from trainingjob_operator_tpu.obs.slo import SLOS, default_slos
+from trainingjob_operator_tpu.obs.tsdb import TSDB
 from trainingjob_operator_tpu.utils.metrics import METRICS
 
 RTYPE = "trainer"
@@ -312,6 +315,13 @@ class FleetReport:
     #: Chaos summary when a chaos profile ran: seed, plan digest, injected
     #: fault counts by kind, informer relists.  None on a clean run.
     chaos: Optional[Dict[str, Any]] = None
+    #: SLO engine verdicts when the plane ran (--slo): per-objective burn
+    #: rates/breach counters plus how many SLOBreach events and stamped
+    #: incident bundles the run produced.  None with the plane off.
+    slo_verdicts: Optional[Dict[str, Any]] = None
+    #: Span profiler summary when it ran (--profile): top span stacks by
+    #: CPU%, worker span-attribution ratio, measured overhead.  None off.
+    profile_top: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -340,6 +350,8 @@ class FleetReport:
             "api_retries_total": self.api_retries_total,
             "restarts_total": self.restarts_total,
             "chaos": self.chaos,
+            "slo_verdicts": self.slo_verdicts,
+            "profile_top": self.profile_top,
         }
 
 
@@ -391,6 +403,7 @@ class FleetHarness:
                  max_wall_seconds: float = 0.0,
                  chaos_profile: Optional[ChaosProfile] = None,
                  nodes_per_slice: int = 4,
+                 slo_plane: bool = False, profiler: bool = False,
                  progress: Optional[Callable[[str], None]] = None):
         self.profile = profile
         self.workers = workers
@@ -420,6 +433,12 @@ class FleetHarness:
         # share one NODE_SLICE_LABEL value, so a plan's domain_down fault
         # kills a correlated group (docs/CHAOS.md).
         self.nodes_per_slice = max(1, nodes_per_slice)
+        # Fleet SLO plane (docs/SLO.md): tsdb sweeper + burn-rate engine
+        # (--slo) and the sampling span profiler (--profile).  Off by
+        # default -- the planes observe the run, never shape it, and the
+        # slo-smoke determinism arm proves exactly that.
+        self.slo_plane = slo_plane
+        self.with_profiler = profiler
         self._progress = progress or (lambda _msg: None)
         self.violations: List[str] = []
 
@@ -488,9 +507,22 @@ class FleetHarness:
                     # stay dead, domain kills down every node in one slice.
                     sim.schedule_node_faults(chaos_plan.node_faults,
                                              on_fault=monkey.record_fault)
+        if self.slo_plane:
+            # Fresh rings per run: the store and engine are process-global
+            # (back-to-back in-process runs would otherwise see each
+            # other's history).
+            TSDB.reset()
+            TSDB.start()
+            SLOS.configure(default_slos())
+            SLOS.start()
+        if self.with_profiler:
+            PROFILER.reset()
+            PROFILER.start()
         started = time.monotonic()
         downtime_phases: Dict[str, Any] = {}
         unattributed = 0.0
+        slo_verdicts: Optional[Dict[str, Any]] = None
+        profile_top: Optional[Dict[str, Any]] = None
         try:
             self._drive(cs, sim, recorder, plans, started)
             # Let every planned node fault fire (and every flap recover)
@@ -506,6 +538,23 @@ class FleetHarness:
             # Harvest incident bundles BEFORE the GC sweep: deleting a
             # finished job makes the next sync forget its incident state.
             downtime_phases, unattributed = self._collect_downtime(plans)
+            if self.slo_plane:
+                # One final sweep + evaluation so short runs still get
+                # verdicts from end-of-run data, then fold in what the run
+                # actually produced: SLOBreach events in the store and
+                # incident bundles stamped with a breached objective.
+                TSDB.sample()
+                SLOS.evaluate()
+                slo_verdicts = SLOS.verdicts()
+                slo_verdicts["breach_events"] = sum(
+                    1 for ev in cs.events.list(None)
+                    if ev.reason == constants.SLO_BREACH_REASON)
+                slo_verdicts["stamped_bundles"] = sum(
+                    1 for plan in plans
+                    for bundle in (INCIDENTS.bundles(plan.key) or [])
+                    if bundle.get("slo_breaches"))
+            if self.with_profiler:
+                profile_top = PROFILER.report(top=10)
             self._gc_sweep(cs, tc)
             wall = time.monotonic() - started
         finally:
@@ -514,6 +563,11 @@ class FleetHarness:
             recorder.close()
             if monkey is not None:
                 monkey.close()
+            if self.slo_plane:
+                SLOS.stop()
+                TSDB.stop()
+            if self.with_profiler:
+                PROFILER.stop()
         if unattributed > 0.0:
             self.violations.append(
                 f"incident recorder left {unattributed:.1f} ms of downtime "
@@ -566,6 +620,8 @@ class FleetHarness:
             api_retries_total=api_retries,
             restarts_total=restarts_total,
             chaos=chaos_report,
+            slo_verdicts=slo_verdicts,
+            profile_top=profile_top,
         )
 
     @staticmethod
@@ -879,6 +935,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="Failure-domain kills (every node in one slice).")
     ap.add_argument("--nodes-per-slice", type=int, default=4,
                     help="Sim nodes per failure domain (slice label).")
+    ap.add_argument("--slo", action="store_true",
+                    help="Run the fleet SLO plane during the run "
+                         "(docs/SLO.md): tsdb sweeper + burn-rate engine; "
+                         "the report gains slo_verdicts.")
+    ap.add_argument("--profile", action="store_true",
+                    help="Run the sampling span profiler during the run; "
+                         "the report gains profile_top (per-span CPU%%, "
+                         "attribution ratio, overhead).")
     ap.add_argument("--quiet", action="store_true",
                     help="Suppress progress lines; print only the report.")
     args = ap.parse_args(argv)
@@ -909,6 +973,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         pods_per_node=args.pods_per_node, with_ports=args.with_ports,
         sim_kernel=args.sim_kernel, max_wall_seconds=args.max_wall_seconds,
         chaos_profile=chaos_profile, nodes_per_slice=args.nodes_per_slice,
+        slo_plane=args.slo, profiler=args.profile,
         progress=progress)
     report = harness.run()
     print(json.dumps(report.to_dict(), indent=2))

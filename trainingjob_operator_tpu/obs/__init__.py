@@ -7,6 +7,10 @@ singletons mirror ``utils.metrics.METRICS``:
 - ``GOODPUT``   -- goodput ledger fed by the status machine;
 - ``TELEMETRY`` -- per-step replica telemetry aggregator (throughput, MFU,
   straggler skew, stall watchdog), fed by the runtimes' sinks;
+- ``TSDB``      -- in-process time-series store sampling the metrics
+  registry into bounded rings (docs/SLO.md);
+- ``SLOS``      -- multi-window burn-rate SLO engine over the tsdb;
+- ``PROFILER``  -- sampling stack profiler with span attribution;
 - structured logging is stateless (``get_logger`` binds context per call).
 
 See docs/OBSERVABILITY.md for the span/metric/event catalogs.
@@ -40,6 +44,15 @@ from trainingjob_operator_tpu.obs.trace import (
     spans_from_jsonl,
     tracer_from_env,
 )
+from trainingjob_operator_tpu.obs.tsdb import TSDB, TimeSeriesStore
+from trainingjob_operator_tpu.obs.slo import (
+    FleetSLO,
+    SLOEngine,
+    SLOSpec,
+    SLOS,
+    default_slos,
+)
+from trainingjob_operator_tpu.obs.profiler import PROFILER, SpanProfiler
 
 __all__ = [
     "GOODPUT",
@@ -65,4 +78,13 @@ __all__ = [
     "group_traces",
     "spans_from_jsonl",
     "tracer_from_env",
+    "TSDB",
+    "TimeSeriesStore",
+    "FleetSLO",
+    "SLOEngine",
+    "SLOSpec",
+    "SLOS",
+    "default_slos",
+    "PROFILER",
+    "SpanProfiler",
 ]

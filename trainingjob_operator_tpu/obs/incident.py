@@ -285,6 +285,7 @@ def _assemble(inc: Dict[str, Any],
                                       Tuple[Tuple[str, float], ...]], ...]
               = (),
               chaos: Tuple[Tuple[str, float, float], ...] = (),
+              slo: Tuple[str, ...] = (),
               ) -> Dict[str, Any]:
     """Ring snapshot -> incident bundle.  Pure and deterministic: the same
     inputs serialize to the same bytes (``reassemble`` asserts this in
@@ -333,7 +334,7 @@ def _assemble(inc: Dict[str, Any],
     timeline.sort(key=lambda e: (e["ts"], e["kind"],
                                  json.dumps(e, sort_keys=True)))
     window_rdv = [r for r in rendezvous if t0 <= r[0] <= t_end]
-    return {
+    out = {
         "id": inc["id"],
         "job": inc["job"],
         "kind": inc["kind"],
@@ -362,6 +363,13 @@ def _assemble(inc: Dict[str, Any],
                      for p, a, b in segments if b > a],
         "timeline": timeline,
     }
+    if slo:
+        # Fleet SLO attribution (obs/slo.py): the objectives whose breach
+        # episode overlapped this incident's window.  Key present only
+        # when a breach was live, like "fallback" on resume entries --
+        # happy-path bundles stay byte-identical to pre-SLO ones.
+        out["slo_breaches"] = list(slo)
+    return out
 
 
 def _canonical(bundle: Dict[str, Any]) -> str:
@@ -424,6 +432,11 @@ class IncidentRecorder:
         #: Global (kind, start, end) chaos-fault windows; bundles assembled
         #: while one overlaps are annotated with the clipped window.
         self._chaos: Deque[Tuple[str, float, float]] = deque(maxlen=1024)
+        #: Fleet SLO breach episodes, (objective, start, end-or-None); an
+        #: open episode (end None) overlaps everything after its start.
+        #: Bundles whose window overlaps one carry the objective name.
+        self._slo: Deque[Tuple[str, float, Optional[float]]] = deque(
+            maxlen=256)
 
     def set_event_sink(self,
                        sink: Optional[Callable[[str, str, str], None]]) -> None:
@@ -455,6 +468,29 @@ class IncidentRecorder:
         previous run's in this process-global recorder)."""
         with self._lock:
             self._chaos.clear()
+
+    def record_slo_breach(self, name: str, start: float) -> None:
+        """An SLO breach episode opened (obs/slo.py engine transition);
+        incident bundles finalized while it is open are stamped with the
+        breached objective."""
+        with self._lock:
+            self._slo.append((str(name), float(start), None))
+
+    def record_slo_recovered(self, name: str, end: float) -> None:
+        """Close the newest open episode of ``name`` (the engine only
+        recovers an objective it breached, so newest-open is the one)."""
+        with self._lock:
+            for i in range(len(self._slo) - 1, -1, -1):
+                n, s, e = self._slo[i]
+                if n == name and e is None:
+                    self._slo[i] = (n, s, float(end))
+                    return
+
+    def clear_slo_breaches(self) -> None:
+        """Drop recorded breach episodes (the SLO engine starting a new
+        run replaces the previous run's state)."""
+        with self._lock:
+            self._slo.clear()
 
     def record_event(self, job: str, reason: str, message: str,
                      ts: Optional[float] = None) -> None:
@@ -661,7 +697,9 @@ class IncidentRecorder:
         chaos = tuple(sorted((k, max(t0, s), min(ended, e))
                              for (k, s, e) in self._chaos
                              if s <= ended and e >= t0))
-        inputs = (inc_dict, events, steps, resumes, rendezvous, chaos)
+        slo = tuple(sorted({n for (n, s, e) in self._slo
+                            if s <= ended and (e is None or e >= t0)}))
+        inputs = (inc_dict, events, steps, resumes, rendezvous, chaos, slo)
         bundle = _assemble(*inputs)
         encoded = _canonical(bundle)
         if st.bundles and st.bundles[-1]["bundle"]["id"] == inc.id:

@@ -1,0 +1,268 @@
+"""Sampling stack profiler: where do 230 reconciles/s of CPU go?
+
+PR 12 measured the controller CPU-bound at ~150-230 reconciles/s on one
+core but produced only the total; ROADMAP item 3 (controller scale-out)
+needs *attribution* before sharding.  This is a dependency-free sampling
+profiler in the py-spy shape, run in-process: a daemon thread wakes on a
+**seeded, jittered** interval (``random.Random(seed)`` -- deterministic
+schedule per seed, and jitter so samples don't alias the controller's own
+periodic loops), grabs ``sys._current_frames()``, and for every operator
+thread records two views of the same sample:
+
+- the collapsed Python stack (``root;...;leaf``), flamegraph.pl-ready via
+  ``/debug/profile?format=collapsed``;
+- the **span stack** live on that thread at sample time, joined through
+  the tracer's per-thread registry (obs/trace.py ``thread_span_stack``)
+  -- so CPU lands on ``sync_job;pods_for_job`` instead of an opaque
+  function name, the same vocabulary the traces and incident bundles
+  already speak.
+
+Threads parked in stdlib wait primitives (Condition.wait, Queue.get,
+selectors) are classified idle and excluded from CPU attribution.  The
+profiler measures its own cost (perf_counter around each sweep, reported
+as ``overhead_ratio`` of wall time) -- the smoke gate holds it under 5%.
+No-op unless started, like every other obs plane.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs import trace
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+#: Thread-name prefixes sampled by default: controller workers/resync/gc,
+#: sim + localproc kubelets, generic runtimes, and the sweeper threads of
+#: the other obs planes (their cost should be visible, not hidden).
+_DEFAULT_PREFIXES = ("trainingjob-", "sim-", "localproc-", "runtime",
+                     "metrics-http")
+
+#: A top-of-stack frame from one of these stdlib modules means the thread
+#: is parked in a wait primitive, not burning CPU.  ``time.sleep`` is
+#: C-level (the top Python frame is the caller) and intentionally NOT
+#: classified idle: a reconcile path sleeping inside a span is a real
+#: latency cost the span table should show.
+_IDLE_BASENAMES = frozenset(("threading.py", "queue.py", "selectors.py",
+                             "socket.py", "socketserver.py"))
+
+#: Caps on distinct keys retained (stacks are finite in practice; these
+#: only bound a pathological churn of unique stacks).
+_MAX_STACKS = 2048
+_MAX_SPAN_KEYS = 1024
+
+
+class SpanProfiler:
+    """Continuous sampling profiler with span attribution.
+
+    All mutable state behind ``_lock``; ``report()``/``collapsed()`` are
+    safe while sampling runs.
+    """
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 interval_ms: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._metrics = metrics if metrics is not None else METRICS
+        raw = os.environ.get(constants.PROFILE_INTERVAL_MS_ENV, "")
+        try:
+            self.interval_ms = (interval_ms if interval_ms is not None
+                                else (float(raw) if raw else 10.0))
+        except ValueError:
+            self.interval_ms = 10.0
+        seed_raw = os.environ.get(constants.PROFILE_SEED_ENV, "")
+        self.seed = (seed if seed is not None
+                     else (int(seed_raw) if seed_raw.isdigit() else 0))
+        self._extra_prefixes: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._started_at: Optional[float] = None
+        self._wall = 0.0
+        self._sample_cpu = 0.0
+        self._samples_total = 0
+        self._idle = 0
+        self._busy = 0
+        self._worker_busy = 0
+        self._worker_attr = 0
+        self._span_counts: Dict[Tuple[str, ...], int] = {}
+        self._stack_counts: Dict[str, int] = {}
+
+    def note_thread_prefix(self, prefix: str) -> None:
+        """Register an extra thread-name prefix of interest (runtimes with
+        custom ``thread_name``s call this so their kubelet threads are
+        sampled without the profiler hard-coding every runtime)."""
+        if not prefix:
+            return
+        with self._lock:
+            if len(self._extra_prefixes) < 64:
+                self._extra_prefixes.add(prefix)
+
+    # -- sampling ------------------------------------------------------------
+
+    @staticmethod
+    def _is_idle(frame) -> bool:
+        return (os.path.basename(frame.f_code.co_filename)
+                in _IDLE_BASENAMES)
+
+    def _sample_once(self) -> int:
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        with self._lock:
+            prefixes = _DEFAULT_PREFIXES + tuple(self._extra_prefixes)
+        sampled = 0
+        results: List[Tuple[str, bool, str, Tuple[str, ...]]] = []
+        for ident, frame in frames.items():
+            name = names.get(ident, "")
+            if ident == me or not name.startswith(prefixes):
+                continue
+            sampled += 1
+            idle = self._is_idle(frame)
+            funcs: List[str] = []
+            f = frame
+            while f is not None and len(funcs) < 48:
+                funcs.append(f.f_code.co_name)
+                f = f.f_back
+            funcs.reverse()
+            spans = trace.thread_span_stack(ident)
+            results.append((name, idle, ";".join(funcs), spans))
+        with self._lock:
+            for name, idle, stack, spans in results:
+                self._samples_total += 1
+                if idle:
+                    self._idle += 1
+                    continue
+                self._busy += 1
+                if len(self._stack_counts) < _MAX_STACKS or stack in self._stack_counts:
+                    self._stack_counts[stack] = (
+                        self._stack_counts.get(stack, 0) + 1)
+                key = spans if spans else ("<no-span>",)
+                if len(self._span_counts) < _MAX_SPAN_KEYS or key in self._span_counts:
+                    self._span_counts[key] = self._span_counts.get(key, 0) + 1
+                if name.startswith("trainingjob-worker"):
+                    self._worker_busy += 1
+                    if spans and spans[0] == "sync_job":
+                        self._worker_attr += 1
+        return sampled
+
+    # -- reporting -----------------------------------------------------------
+
+    def _wall_seconds(self) -> float:
+        wall = self._wall
+        if self._started_at is not None:
+            wall += time.monotonic() - self._started_at
+        return wall
+
+    def overhead_ratio(self) -> float:
+        with self._lock:
+            wall = self._wall_seconds()
+            return (self._sample_cpu / wall) if wall > 0 else 0.0
+
+    def report(self, top: int = 20) -> Dict[str, Any]:
+        """Per-span-stack CPU% table plus the numbers the smoke gates on:
+        worker span-attribution ratio and profiler overhead."""
+        with self._lock:
+            busy = self._busy
+            rows = sorted(self._span_counts.items(),
+                          key=lambda kv: (-kv[1], kv[0]))[:top]
+            table = [{"spans": ";".join(key), "samples": n,
+                      "cpu_pct": round(100.0 * n / busy, 1) if busy else 0.0}
+                     for key, n in rows]
+            wall = self._wall_seconds()
+            attr = (self._worker_attr / self._worker_busy
+                    if self._worker_busy else None)
+            return {
+                "running": self._thread is not None,
+                "interval_ms": self.interval_ms,
+                "seed": self.seed,
+                "wall_seconds": round(wall, 3),
+                "samples_total": self._samples_total,
+                "busy_samples": busy,
+                "idle_samples": self._idle,
+                "overhead_ratio": round(
+                    (self._sample_cpu / wall) if wall > 0 else 0.0, 5),
+                "span_attribution": {
+                    "worker_busy": self._worker_busy,
+                    "worker_attributed": self._worker_attr,
+                    "ratio": round(attr, 4) if attr is not None else None,
+                },
+                "top": table,
+            }
+
+    def collapsed(self) -> str:
+        """flamegraph.pl input: ``func;func;func count`` per line."""
+        with self._lock:
+            rows = sorted(self._stack_counts.items(),
+                          key=lambda kv: (-kv[1], kv[0]))
+        return "\n".join(f"{stack} {n}" for stack, n in rows) + "\n"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self._wall = 0.0
+            self._sample_cpu = 0.0
+            self._samples_total = 0
+            self._idle = 0
+            self._busy = 0
+            self._worker_busy = 0
+            self._worker_attr = 0
+            self._span_counts.clear()
+            self._stack_counts.clear()
+
+    def start(self, interval_ms: Optional[float] = None) -> None:
+        """Spawn the daemon sampler; idempotent while running.  Turns the
+        tracer's per-thread span registry on for the duration."""
+        if self._thread is not None:
+            return
+        if interval_ms is not None:
+            self.interval_ms = interval_ms
+        trace.enable_span_registry()
+        self._stop.clear()
+        with self._lock:
+            self._started_at = time.monotonic()
+        self._metrics.gauge("trainingjob_profiler_overhead_ratio",
+                            self.overhead_ratio)
+        rng = random.Random(self.seed)
+        base = self.interval_ms / 1000.0
+
+        def _loop() -> None:
+            while True:
+                # 0.5x..1.5x the base interval: seeded jitter decorrelates
+                # the sampler from periodic controller loops.
+                if self._stop.wait(base * (0.5 + rng.random())):
+                    return
+                t0 = time.perf_counter()
+                sampled = self._sample_once()
+                with self._lock:
+                    self._sample_cpu += time.perf_counter() - t0
+                if sampled:
+                    self._metrics.inc("trainingjob_profiler_samples_total",
+                                      float(sampled))
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="trainingjob-profiler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        th = self._thread
+        if th is None:
+            return
+        self._stop.set()
+        th.join(timeout=2.0)
+        self._thread = None
+        with self._lock:
+            if self._started_at is not None:
+                self._wall += time.monotonic() - self._started_at
+                self._started_at = None
+        trace.disable_span_registry()
+        self._metrics.remove_gauge("trainingjob_profiler_overhead_ratio")
+
+
+#: Process-global profiler (samples this process's own threads).
+PROFILER = SpanProfiler()

@@ -1,0 +1,307 @@
+"""Declarative SLOs evaluated against the in-process tsdb: multi-window
+multi-burn-rate alerting for the fleet.
+
+An :class:`SLOSpec` names an objective ("99% of sweeps see event->visible
+p99 <= 5s"), the tsdb series it reads (prefix + suffix match, reduced
+across label sets per sweep tick), and the alerting policy: two windows
+(short + long) whose *burn rate* -- the fraction of bad ticks divided by
+the error budget ``1 - target`` -- must BOTH exceed a threshold before a
+breach fires.  The two-window shape is the standard SRE construction: the
+long window proves the budget is really burning, the short window proves
+it is burning *now*, so a breach is neither a blip nor a stale alarm.
+
+Breach/recovery transitions are events, not log lines: the engine calls a
+sink wired by the controller (``recorder.event`` with ``SLOBreach`` /
+``SLORecovered`` against a synthetic fleet-scoped :class:`FleetSLO`
+object) and tells the incident recorder so bundles whose window overlaps
+a breach episode carry the breached objective.  ``/debug/slo`` serves the
+live verdicts; the fleet harness folds them into ``FleetReport``.
+
+One deliberate asymmetry: quantile-fed SLOs (event->visible p99 etc.) read
+*run-cumulative* histogram quantiles, which cannot come back down after a
+degradation inside one process lifetime -- those objectives breach and
+stay breached (correct: the budget is spent).  Gauge-fed SLOs (goodput
+floor) genuinely recover.  docs/SLO.md spells this out.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.core.objects import ObjectMeta
+from trainingjob_operator_tpu.obs.incident import INCIDENTS
+from trainingjob_operator_tpu.obs.tsdb import TSDB, TimeSeriesStore
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+
+class FleetSLO:
+    """Synthetic involved object for fleet-scoped SLO events: the breach
+    is a property of the fleet, not of any one TrainingJob, and the
+    incident tap keys on KIND to keep these out of per-job incident
+    rings."""
+
+    KIND = "FleetSLO"
+
+    def __init__(self, name: str):
+        self.metadata = ObjectMeta(name=name, namespace="fleet-slo")
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One objective.  ``series_prefix``/``series_suffix`` match tsdb ring
+    names (labels live between the two, e.g. prefix
+    ``trainingjob_event_to_visible_ms`` + suffix ``_p99`` matches every
+    ``{kind=...}`` label set); per sweep tick the matched values are
+    reduced (max/min/avg) to one number, good iff ``value op threshold``.
+    """
+
+    name: str
+    objective: str
+    series_prefix: str
+    series_suffix: str = ""
+    reduce: str = "max"          # max | min | avg across matched series
+    op: str = "<="               # good when value op threshold
+    threshold: float = 0.0
+    target: float = 0.99         # objective target; budget = 1 - target
+    min_points: int = 4          # ticks required per window for a verdict
+
+
+def _windows_from_env() -> Tuple[float, float]:
+    raw = os.environ.get(constants.SLO_WINDOWS_ENV, "")
+    if raw:
+        short_raw, _, long_raw = raw.partition(":")
+        try:
+            short, long = float(short_raw), float(long_raw)
+            if 0 < short <= long:
+                return short, long
+        except ValueError:
+            pass
+    return 5.0, 15.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def default_slos() -> Tuple[SLOSpec, ...]:
+    """The built-in fleet inventory (docs/SLO.md).  Thresholds sized for
+    the sim fleet's scale and env-overridable; the degraded smoke arm
+    tightens them to provoke a breach deliberately."""
+    return (
+        SLOSpec(
+            name="event_visible_p99",
+            objective="create/update visible to the controller: p99 under "
+                      "the threshold across every event kind",
+            series_prefix="trainingjob_event_to_visible_ms",
+            series_suffix="_p99",
+            reduce="max", op="<=",
+            threshold=_env_float(constants.SLO_EVENT_P99_MS_ENV, 5000.0)),
+        SLOSpec(
+            name="detect_running_p99",
+            objective="restart downtime (detect -> Running again): p99 "
+                      "under the threshold across every restart scope",
+            series_prefix="trainingjob_restart_downtime_seconds",
+            series_suffix="_p99",
+            reduce="max", op="<=",
+            threshold=_env_float(constants.SLO_RESTART_P99_S_ENV, 60.0)),
+        SLOSpec(
+            name="goodput_floor",
+            objective="mean per-job goodput ratio stays above the floor",
+            series_prefix="trainingjob_goodput_ratio",
+            reduce="avg", op=">=",
+            threshold=_env_float(constants.SLO_GOODPUT_FLOOR_ENV, 0.01)),
+        SLOSpec(
+            name="serve_token_p99",
+            objective="serve-plane p99 token latency under the threshold "
+                      "across serving jobs",
+            series_prefix="trainingjob_serve_token_latency_ms",
+            reduce="max", op="<=",
+            threshold=_env_float(constants.SLO_SERVE_P99_MS_ENV, 2000.0)),
+    )
+
+
+class SLOEngine:
+    """Evaluates specs against the tsdb on a timer (or manually via
+    ``evaluate()``); fires the event sink + incident stamps on breach and
+    recovery transitions.  No-op until ``start()``, like the other obs
+    planes."""
+
+    def __init__(self, tsdb: Optional[TimeSeriesStore] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 incidents=None):
+        self._lock = threading.Lock()
+        self._tsdb = tsdb if tsdb is not None else TSDB
+        self._metrics = metrics if metrics is not None else METRICS
+        self._incidents = incidents if incidents is not None else INCIDENTS
+        self._specs: Tuple[SLOSpec, ...] = ()
+        self._state: Dict[str, Dict[str, Any]] = {}
+        self._sink: Optional[Callable[[str, str, str], None]] = None
+        self.short_s, self.long_s = _windows_from_env()
+        self.burn_threshold = _env_float(constants.SLO_BURN_ENV, 4.0)
+        self.interval = _env_float(constants.SLO_EVAL_ENV, 1.0)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def set_event_sink(self,
+                       sink: Optional[Callable[[str, str, str], None]]) -> None:
+        """``sink(slo_name, reason, message)``; the controller points this
+        at its EventRecorder so breaches surface as kubectl-visible
+        events."""
+        with self._lock:
+            self._sink = sink
+
+    def configure(self, specs: Tuple[SLOSpec, ...]) -> None:
+        with self._lock:
+            self._specs = tuple(specs)
+            self._state = {
+                spec.name: {"breached": False, "breaches": 0,
+                            "recoveries": 0, "burn_short": 0.0,
+                            "burn_long": 0.0, "last": None, "points": 0}
+                for spec in self._specs
+            }
+
+    # -- evaluation ----------------------------------------------------------
+
+    @staticmethod
+    def _reduce(spec: SLOSpec, values: List[float]) -> float:
+        if spec.reduce == "min":
+            return min(values)
+        if spec.reduce == "avg":
+            return sum(values) / len(values)
+        return max(values)
+
+    @staticmethod
+    def _good(spec: SLOSpec, value: float) -> bool:
+        if spec.op == ">=":
+            return value >= spec.threshold
+        return value <= spec.threshold
+
+    def _burn(self, spec: SLOSpec, ticks: List[Tuple[float, float]],
+              start: float) -> Tuple[float, int]:
+        """(burn rate, tick count) over ticks with t >= start."""
+        window = [(t, v) for t, v in ticks if t >= start]
+        if not window:
+            return 0.0, 0
+        bad = sum(1 for _, v in window if not self._good(spec, v))
+        budget = max(1.0 - spec.target, 1e-9)
+        return (bad / len(window)) / budget, len(window)
+
+    def _ticks(self, spec: SLOSpec, start: float) -> List[Tuple[float, float]]:
+        """Per-sweep reduced values for the spec since ``start``.  Sweeps
+        stamp one timestamp across all series, so grouping by exact t is
+        exact, not fuzzy bucketing."""
+        by_tick: Dict[float, List[float]] = {}
+        for name in self._tsdb.match(spec.series_prefix, spec.series_suffix):
+            for t, v in self._tsdb.window(name, start):
+                by_tick.setdefault(t, []).append(v)
+        return [(t, self._reduce(spec, vs))
+                for t, vs in sorted(by_tick.items())]
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        if now is None:
+            now = time.time()
+        with self._lock:
+            specs, sink = self._specs, self._sink
+        fired: List[Tuple[str, str, str]] = []
+        for spec in specs:
+            ticks = self._ticks(spec, now - self.long_s)
+            burn_long, n_long = self._burn(spec, ticks, now - self.long_s)
+            burn_short, n_short = self._burn(spec, ticks, now - self.short_s)
+            with self._lock:
+                st = self._state.get(spec.name)
+                if st is None:
+                    continue
+                st["burn_short"], st["burn_long"] = (round(burn_short, 3),
+                                                     round(burn_long, 3))
+                st["points"] = n_long
+                st["last"] = ticks[-1][1] if ticks else None
+                enough = (n_short >= spec.min_points
+                          and n_long >= spec.min_points)
+                if (not st["breached"] and enough
+                        and burn_short >= self.burn_threshold
+                        and burn_long >= self.burn_threshold):
+                    st["breached"] = True
+                    st["breaches"] += 1
+                    self._metrics.inc("trainingjob_slo_breaches_total",
+                                      slo=spec.name)
+                    self._incidents.record_slo_breach(spec.name, now)
+                    fired.append((spec.name, constants.SLO_BREACH_REASON,
+                                  f"burn {burn_short:.1f}x/{burn_long:.1f}x "
+                                  f"over budget ({spec.objective}; "
+                                  f"last={st['last']})"))
+                elif (st["breached"] and enough and burn_short == 0.0):
+                    st["breached"] = False
+                    st["recoveries"] += 1
+                    self._incidents.record_slo_recovered(spec.name, now)
+                    fired.append((spec.name, constants.SLO_RECOVERED_REASON,
+                                  f"short-window burn back to 0 "
+                                  f"({spec.objective})"))
+        if sink is not None:
+            for name, reason, message in fired:
+                sink(name, reason, message)
+
+    def verdicts(self) -> Dict[str, Any]:
+        with self._lock:
+            slos = {
+                spec.name: dict(self._state.get(spec.name, {}),
+                                objective=spec.objective,
+                                threshold=spec.threshold, op=spec.op,
+                                target=spec.target)
+                for spec in self._specs
+            }
+            return {"windows": {"short_s": self.short_s,
+                                "long_s": self.long_s,
+                                "burn_threshold": self.burn_threshold},
+                    "slos": slos,
+                    "breaches_total": sum(s["breaches"] for s in slos.values()
+                                          if "breaches" in s)}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, interval: Optional[float] = None) -> None:
+        if self._thread is not None:
+            return
+        if interval is not None:
+            self.interval = interval
+        if not self._specs:
+            self.configure(default_slos())
+        self._incidents.clear_slo_breaches()
+        self._stop.clear()
+        for spec in self._specs:
+            self._metrics.gauge(
+                "trainingjob_slo_burn_rate",
+                lambda n=spec.name: self._state.get(n, {}).get("burn_short",
+                                                               0.0),
+                slo=spec.name)
+
+        def _loop() -> None:
+            while not self._stop.wait(self.interval):
+                self.evaluate()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="trainingjob-slo")
+        self._thread.start()
+
+    def stop(self) -> None:
+        th = self._thread
+        if th is None:
+            return
+        self._stop.set()
+        th.join(timeout=2.0)
+        self._thread = None
+        for spec in self._specs:
+            self._metrics.remove_gauge("trainingjob_slo_burn_rate",
+                                       slo=spec.name)
+
+
+#: Process-global engine (one per controller shard, like the tsdb it reads).
+SLOS = SLOEngine()

@@ -41,6 +41,44 @@ ERROR = "error"
 _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "trainingjob_current_span", default=None)
 
+#: Active-span registry for the sampling profiler (obs/profiler.py): thread
+#: ident -> the innermost *open* span on that thread.  A contextvar cannot
+#: be read from another thread, so the profiler needs this side table to
+#: join a stack sample against the span that was live when it fired.  Each
+#: thread writes only its own key (single dict ops, GIL-atomic), and the
+#: whole path is gated off ``_span_registry_on`` so untraced/unprofiled
+#: runs pay exactly one falsy check per span enter/exit.
+_THREAD_SPANS: Dict[int, "Span"] = {}
+_span_registry_on = False
+
+
+def enable_span_registry() -> None:
+    """Turn on per-thread active-span tracking (profiler starting)."""
+    global _span_registry_on
+    _span_registry_on = True
+
+
+def disable_span_registry() -> None:
+    """Turn tracking back off and drop the map (profiler stopped)."""
+    global _span_registry_on
+    _span_registry_on = False
+    _THREAD_SPANS.clear()
+
+
+def thread_span_stack(ident: int) -> "tuple[str, ...]":
+    """Root-first names of the spans open on thread ``ident`` (empty when
+    none).  Racy by design -- the owner may enter/exit concurrently; a
+    sample landing mid-transition sees the previous consistent chain or
+    nothing, never a torn one (the chain links are set before the map
+    write)."""
+    span = _THREAD_SPANS.get(ident)
+    names: List[str] = []
+    while span is not None and len(names) < 32:
+        names.append(span.name)
+        span = span._prev_active
+    names.reverse()
+    return tuple(names)
+
 
 def _new_id() -> str:
     return os.urandom(8).hex()
@@ -71,7 +109,8 @@ class Span:
 
     __slots__ = ("_tracer", "name", "trace_id", "span_id", "parent_id",
                  "attributes", "status", "start_time", "end_time",
-                 "pid", "tid", "thread_name", "_token", "_local_root")
+                 "pid", "tid", "thread_name", "_token", "_local_root",
+                 "_prev_active")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  parent_id: Optional[str], attributes: Dict[str, Any],
@@ -90,6 +129,7 @@ class Span:
         self.thread_name = threading.current_thread().name
         self._token: Optional[contextvars.Token] = None
         self._local_root = local_root
+        self._prev_active: Optional[Span] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -106,6 +146,11 @@ class Span:
     def __enter__(self) -> "Span":
         self.start_time = time.time()
         self._token = _current_span.set(self)
+        if _span_registry_on:
+            # Link before publishing: a profiler sample between the two
+            # writes sees the old head (consistent), never a broken chain.
+            self._prev_active = _THREAD_SPANS.get(self.tid)
+            _THREAD_SPANS[self.tid] = self
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -117,6 +162,12 @@ class Span:
         if self._token is not None:
             _current_span.reset(self._token)
             self._token = None
+        if _span_registry_on and _THREAD_SPANS.get(self.tid) is self:
+            if self._prev_active is None:
+                _THREAD_SPANS.pop(self.tid, None)
+            else:
+                _THREAD_SPANS[self.tid] = self._prev_active
+        self._prev_active = None
         self._tracer._finish(self)
         return False  # never swallow
 

@@ -1,0 +1,251 @@
+"""In-process time-series store: fixed-interval ring buffers over the
+metrics registry.
+
+Every latency gate in the repo today is a point-in-time percentile computed
+after a run; nothing retains *history* inside the process.  This store is
+the missing substrate: a daemon sweeper snapshots the registry
+(``MetricsRegistry.typed_snapshot``) on a fixed cadence and appends one
+point per series into a bounded ring --
+
+- **counters** are deltaified (per-interval rate material, not the
+  cumulative total); a counter that went *backwards* (process restart,
+  registry swap) clamps the delta at zero instead of recording a huge
+  negative spike;
+- **gauges** are sampled as-is;
+- **histograms** materialize count/sum (deltaified like counters) and
+  max/p50/p99 (sampled) as ``<key>_<stat>`` series.
+
+Retention is bounded twice: per-series by the ring length (old points fall
+off a full ring) and across series by a cardinality cap -- a new label set
+past the cap is *rejected and counted* (``trainingjob_tsdb_series_dropped_
+total``, incremented once per unique rejected name so the drop counter
+cannot feed back into its own cardinality), never silently dropped.
+
+The burn-rate engine (obs/slo.py) evaluates windows against these rings;
+``/debug/timeseries`` serves them (JSON + a ``?format=sparkline`` text
+view).  Like GOODPUT/TELEMETRY/INCIDENTS, the store is a no-op unless
+started: no thread, no sampling, zero overhead on the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
+
+#: Deltaified histogram stats (monotone like counters); the rest are
+#: point-in-time and sampled directly.
+_HIST_DELTA_STATS = ("count", "sum")
+_HIST_SAMPLE_STATS = ("max", "p50", "p99")
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw.isdigit() else default
+
+
+class TimeSeriesStore:
+    """Bounded per-series rings fed by registry sweeps.
+
+    All state behind ``_lock``; ``sample()`` may be driven manually (tests,
+    end-of-run flushes) or by the daemon sweeper ``start()`` spawns.
+    """
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 interval: Optional[float] = None,
+                 points: Optional[int] = None,
+                 max_series: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._metrics = metrics if metrics is not None else METRICS
+        self.interval = interval if interval is not None else _env_float(
+            constants.TSDB_INTERVAL_ENV, 0.5)
+        self.points = points if points is not None else _env_int(
+            constants.TSDB_POINTS_ENV, 240)
+        self.max_series = max_series if max_series is not None else _env_int(
+            constants.TSDB_MAX_SERIES_ENV, 2048)
+        self._series: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._last_counters: Dict[str, float] = {}
+        self._rejected: set = set()
+        self.samples_total = 0
+        self.dropped_series = 0
+        self.last_sample_ts: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- ingestion -----------------------------------------------------------
+
+    def _put_locked(self, key: str, now: float, value: float) -> None:
+        ring = self._series.get(key)
+        if ring is None:
+            if len(self._series) >= self.max_series:
+                # Count each unique rejected name exactly once: the drop
+                # counter itself becomes a registry series next sweep, and
+                # re-counting it every interval would make the rejection
+                # path feed its own cardinality pressure forever.
+                if key not in self._rejected:
+                    # Bound the rejection memory too; past it we still
+                    # drop, just without per-name dedup of the count.
+                    if len(self._rejected) < 4 * self.max_series:
+                        self._rejected.add(key)
+                    self.dropped_series += 1
+                    self._metrics.inc("trainingjob_tsdb_series_dropped_total")
+                return
+            ring = self._series[key] = deque(maxlen=self.points)
+        ring.append((now, value))
+
+    def _delta_locked(self, key: str, now: float, value: float) -> None:
+        prev = self._last_counters.get(key)
+        self._last_counters[key] = value
+        if prev is None:
+            # First sighting: the cumulative total is history we did not
+            # watch accrue, not one interval's worth -- start at zero.
+            self._put_locked(key, now, 0.0)
+            return
+        self._put_locked(key, now, max(value - prev, 0.0))
+
+    def sample(self, now: Optional[float] = None) -> None:
+        """One sweep: snapshot the registry, append one point per series.
+
+        A single timestamp is stamped on every point of the sweep so the
+        SLO engine can reduce *across* series per tick without fuzzy
+        time-alignment.
+        """
+        snap = self._metrics.typed_snapshot()
+        if now is None:
+            now = time.time()
+        with self._lock:
+            self.samples_total += 1
+            self.last_sample_ts = now
+            for key, value in snap["counters"].items():
+                self._delta_locked(key, now, value)
+            for key, value in snap["gauges"].items():
+                self._put_locked(key, now, value)
+            for key, stats in snap["hists"].items():
+                for stat in _HIST_DELTA_STATS:
+                    self._delta_locked(f"{key}_{stat}", now, stats[stat])
+                for stat in _HIST_SAMPLE_STATS:
+                    self._put_locked(f"{key}_{stat}", now, stats[stat])
+
+    # -- queries -------------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series(self, name: str) -> Optional[List[Tuple[float, float]]]:
+        """All retained points of one ring, oldest first; None if unknown."""
+        with self._lock:
+            ring = self._series.get(name)
+            return list(ring) if ring is not None else None
+
+    def window(self, name: str, start: float,
+               end: Optional[float] = None) -> List[Tuple[float, float]]:
+        """Points of ``name`` with start <= t (<= end); empty if unknown."""
+        with self._lock:
+            ring = self._series.get(name)
+            if ring is None:
+                return []
+            return [(t, v) for t, v in ring
+                    if t >= start and (end is None or t <= end)]
+
+    def match(self, prefix: str, suffix: str = "") -> List[str]:
+        """Series names with the given name prefix + suffix (the SLO
+        spec's matching primitive: label sets live between the two)."""
+        with self._lock:
+            return sorted(k for k in self._series
+                          if k.startswith(prefix) and k.endswith(suffix))
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            series = {k: {"n": len(ring), "last": ring[-1][1]}
+                      for k, ring in sorted(self._series.items())}
+            return {"interval_s": self.interval, "points": self.points,
+                    "max_series": self.max_series,
+                    "series_count": len(series),
+                    "samples_total": self.samples_total,
+                    "dropped_series": self.dropped_series,
+                    "last_sample_ts": self.last_sample_ts,
+                    "series": series}
+
+    def render_sparklines(self, names: Optional[List[str]] = None,
+                          width: int = 60) -> str:
+        """One line per ring: name, min..max, and the last ``width`` points
+        scaled into unicode block characters."""
+        if names is None:
+            names = self.names()
+        lines: List[str] = []
+        for name in names:
+            points = self.series(name)
+            if not points:
+                continue
+            values = [v for _, v in points[-width:]]
+            lo, hi = min(values), max(values)
+            if hi > lo:
+                chars = "".join(
+                    _SPARK_BLOCKS[min(int((v - lo) / (hi - lo)
+                                          * len(_SPARK_BLOCKS)),
+                                      len(_SPARK_BLOCKS) - 1)]
+                    for v in values)
+            else:
+                chars = _SPARK_BLOCKS[3] * len(values)
+            lines.append(f"{name}  [{lo:g}..{hi:g}]  {chars}")
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reset(self) -> None:
+        """Drop all rings and counters (a fresh harness run starts clean)."""
+        with self._lock:
+            self._series.clear()
+            self._last_counters.clear()
+            self._rejected.clear()
+            self.samples_total = 0
+            self.dropped_series = 0
+            self.last_sample_ts = None
+
+    def start(self, interval: Optional[float] = None) -> None:
+        """Spawn the daemon sweeper; idempotent while running."""
+        if self._thread is not None:
+            return
+        if interval is not None:
+            self.interval = interval
+        self._stop.clear()
+        self._metrics.gauge("trainingjob_tsdb_series",
+                            lambda: float(len(self._series)))
+
+        def _loop() -> None:
+            while not self._stop.wait(self.interval):
+                self.sample()
+                self._metrics.inc("trainingjob_tsdb_samples_total")
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="trainingjob-tsdb")
+        self._thread.start()
+
+    def stop(self) -> None:
+        th = self._thread
+        if th is None:
+            return
+        self._stop.set()
+        th.join(timeout=2.0)
+        self._thread = None
+        self._metrics.remove_gauge("trainingjob_tsdb_series")
+
+
+#: Process-global store (one per controller shard, like METRICS itself).
+TSDB = TimeSeriesStore()

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from trainingjob_operator_tpu.client.clientset import Clientset
 from trainingjob_operator_tpu.client.tracker import ConflictError, NotFoundError
 from trainingjob_operator_tpu.core.objects import Pod
+from trainingjob_operator_tpu.obs.profiler import PROFILER
 
 log = logging.getLogger("trainingjob.runtime")
 
@@ -50,6 +51,11 @@ class PodStateRuntime:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        # Register the kubelet thread's name with the span profiler so a
+        # subclass with a custom ``thread_name`` is still sampled -- the
+        # sim/controller CPU split is exactly what the profiler exists to
+        # measure (obs/profiler.py; no-op unless the profiler runs).
+        PROFILER.note_thread_prefix(self.thread_name)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.thread_name)
         self._thread.start()

@@ -136,6 +136,29 @@ class MetricsRegistry:
                 out[f"{k}_{stat}"] = v
         return out
 
+    def typed_snapshot(self) -> Dict[str, Any]:
+        """Type-separated snapshot for the time-series store (obs/tsdb.py):
+        counters raw (the store deltaifies them), gauges evaluated,
+        histogram stats materialized.  The flat ``snapshot()`` cannot tell
+        a counter from a gauge, and deltaifying a gauge would be wrong."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = {k: fn for k, fn in self._gauges.items()}
+            hists = {
+                k: {"count": float(h.count), "sum": h.total, "max": h.vmax,
+                    "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
+                for k, h in self._hists.items()
+            }
+        evaluated: Dict[str, float] = {}
+        for k, fn in gauges.items():
+            try:
+                evaluated[k] = float(fn())
+            # analyzer: allow[broad-except]: gauge callbacks are arbitrary
+            # component code; one bad gauge must not fail the whole sweep.
+            except Exception:
+                continue
+        return {"counters": counters, "gauges": evaluated, "hists": hists}
+
     def render_prometheus(self) -> str:
         lines: List[str] = []
         with self._lock:
@@ -191,21 +214,32 @@ def thread_dump() -> str:
     return "\n".join(parts)
 
 
-def _render_traces(tracer, params: Dict[str, List[str]]) -> Tuple[str, str]:
-    """(content-type, body) for /debug/traces: JSON trace list by default,
-    Chrome trace_event JSON with ?format=chrome (Perfetto-loadable)."""
+def _render_traces(tracer, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/traces: JSON trace list by
+    default, Chrome trace_event JSON with ?format=chrome (Perfetto-loadable).
+    A non-numeric ?limit or unknown ?format -> explicit 400: silently
+    ignoring a typo'd knob serves the wrong answer with a 200 on it."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "chrome"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or chrome\n"
     limit_raw = params.get("limit", [""])[0]
-    limit = int(limit_raw) if limit_raw.isdigit() else None
+    if limit_raw and not limit_raw.isdigit():
+        return 400, "text/plain", f"bad limit {limit_raw!r}; use a non-negative integer\n"
+    limit = int(limit_raw) if limit_raw else None
     traces = tracer.traces(limit)
-    if params.get("format", [""])[0] == "chrome":
-        return "application/json", tracer.export_chrome(traces)
-    return "application/json", json.dumps(
+    if fmt == "chrome":
+        return 200, "application/json", tracer.export_chrome(traces)
+    return 200, "application/json", json.dumps(
         {"count": len(traces), "traces": traces}, indent=2)
 
 
-def _render_events(events_fn, params: Dict[str, List[str]]) -> str:
-    """/debug/events: the durable event store, newest last, filterable with
-    ?job=<namespace/name> (or bare name) on the involved object."""
+def _render_events(events_fn, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/events: the durable event
+    store, newest last, filterable with ?job=<namespace/name> (or bare
+    name) on the involved object.  Unknown ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json\n"
     events = list(events_fn())
     job = params.get("job", [""])[0]
     if job:
@@ -214,14 +248,19 @@ def _render_events(events_fn, params: Dict[str, List[str]]) -> str:
                     or ev.involved_name == job)
         events = [ev for ev in events if matches(ev)]
     events.sort(key=lambda ev: ev.timestamp or 0.0)
-    return json.dumps({"count": len(events),
-                       "events": [ev.to_dict() for ev in events]}, indent=2)
+    return 200, "application/json", json.dumps(
+        {"count": len(events),
+         "events": [ev.to_dict() for ev in events]}, indent=2)
 
 
 def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
     """(status, content-type, body) for /debug/steps: per-replica live step
     table for ?job=<namespace/name> (text with ?format=text), or the list of
-    jobs with telemetry when no job is given.  Unknown job -> 404."""
+    jobs with telemetry when no job is given.  Unknown job -> 404; unknown
+    ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "text"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or text\n"
     job = params.get("job", [""])[0]
     if not job:
         jobs = telemetry.jobs()
@@ -230,7 +269,7 @@ def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     table = telemetry.job_table(job)
     if table is None:
         return 404, "text/plain", ""
-    if params.get("format", [""])[0] == "text":
+    if fmt == "text":
         return 200, "text/plain", telemetry.render_table(job)
     return 200, "application/json", json.dumps(table, indent=2)
 
@@ -240,7 +279,10 @@ def _render_serve(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     serving-plane snapshot (queue depth, batch occupancy, token-latency
     percentiles, tokens/s) for ?job=<namespace/name>, or the list of jobs
     that have ever served when no job is given.  Unknown / never-served
-    job -> 404."""
+    job -> 404; unknown ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json\n"
     job = params.get("job", [""])[0]
     if not job:
         jobs = [j for j in telemetry.jobs()
@@ -294,13 +336,62 @@ def _render_incidents(incidents,
          "incidents": bundles}, indent=2)
 
 
+def _render_timeseries(tsdb, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/timeseries: the in-process
+    tsdb (obs/tsdb.py).  No ?series= -> the store summary (every ring with
+    its last value); with one, that ring's points.  ?format=sparkline ->
+    a text view, one scaled unicode sparkline per ring.  Unknown series ->
+    404; unknown ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "sparkline"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or sparkline\n"
+    name = params.get("series", [""])[0]
+    if name:
+        points = tsdb.series(name)
+        if points is None:
+            return 404, "text/plain", ""
+        if fmt == "sparkline":
+            return 200, "text/plain", tsdb.render_sparklines([name])
+        return 200, "application/json", json.dumps(
+            {"series": name, "interval_s": tsdb.interval,
+             "points": [[round(t, 3), v] for t, v in points]}, indent=2)
+    if fmt == "sparkline":
+        return 200, "text/plain", tsdb.render_sparklines()
+    return 200, "application/json", json.dumps(tsdb.summary(), indent=2)
+
+
+def _render_slo(slos, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/slo: the burn-rate engine's
+    current verdicts (obs/slo.py) -- per-objective burn rates, breach
+    state and counters.  Unknown ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json\n"
+    return 200, "application/json", json.dumps(slos.verdicts(), indent=2)
+
+
+def _render_profile(profiler, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/profile: the sampling span
+    profiler (obs/profiler.py) -- per-span-stack CPU% table and overhead
+    by default, flamegraph-ready collapsed stacks with ?format=collapsed.
+    Unknown ?format -> explicit 400."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "collapsed"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or collapsed\n"
+    if fmt == "collapsed":
+        return 200, "text/plain", profiler.collapsed()
+    return 200, "application/json", json.dumps(profiler.report(), indent=2)
+
+
 def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                   host: str = "127.0.0.1", tracer=None, events_fn=None,
                   ready_fn: Optional[Callable[[], bool]] = None,
-                  telemetry=None, incidents=None):
+                  telemetry=None, incidents=None, tsdb=None, slos=None,
+                  profiler=None):
     """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
-    /debug/threads, /debug/traces, /debug/events, /debug/steps,
-    /debug/serve and /debug/incidents on a daemon thread; ``.shutdown()``
+    /debug (route index), /debug/threads, /debug/traces, /debug/events,
+    /debug/steps, /debug/serve, /debug/incidents, /debug/timeseries,
+    /debug/slo and /debug/profile on a daemon thread; ``.shutdown()``
     stops it and closes the socket.
 
     - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
@@ -312,6 +403,13 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
       /debug/steps and /debug/serve (404 without).
     - ``incidents``: an obs.incident.IncidentRecorder; enables
       /debug/incidents (404 without).
+    - ``tsdb``: an obs.tsdb.TimeSeriesStore; enables /debug/timeseries.
+    - ``slos``: an obs.slo.SLOEngine; enables /debug/slo.
+    - ``profiler``: an obs.profiler.SpanProfiler; enables /debug/profile.
+
+    ``/debug`` itself serves an index of every debug route with a one-line
+    description and whether its provider is wired -- endpoint discovery
+    without reading docs/OBSERVABILITY.md.
 
     Binds loopback by default -- /debug/threads exposes live stacks, the
     pprof convention (expose beyond localhost only deliberately via
@@ -322,6 +420,34 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
     from urllib.parse import parse_qs
 
     reg = registry or METRICS
+
+    # The /debug index: (path, one-line description, provider wired?).
+    # Built once per server so the index always reflects what *this*
+    # process can actually serve, not the theoretical full set.
+    routes = (
+        ("/metrics", "Prometheus text exposition", True),
+        ("/metrics.json", "flat registry snapshot as JSON", True),
+        ("/healthz", "liveness", True),
+        ("/readyz", "readiness (503 until informers sync)", ready_fn is not None),
+        ("/debug", "this index", True),
+        ("/debug/threads", "all live thread stacks (pprof/goroutine analogue)", True),
+        ("/debug/traces", "finished traces; ?limit=N, ?format=chrome",
+         tracer is not None),
+        ("/debug/events", "durable event store; ?job=<ns/name>",
+         events_fn is not None),
+        ("/debug/steps", "per-replica live step table; ?job=, ?format=text",
+         telemetry is not None),
+        ("/debug/serve", "serving-plane snapshot; ?job=",
+         telemetry is not None),
+        ("/debug/incidents", "incident bundles; ?job=, ?id=N, ?format=chrome",
+         incidents is not None),
+        ("/debug/timeseries", "in-process tsdb rings; ?series=, ?format=sparkline",
+         tsdb is not None),
+        ("/debug/slo", "SLO burn rates + breach verdicts",
+         slos is not None),
+        ("/debug/profile", "sampling span profiler; ?format=collapsed",
+         profiler is not None),
+    )
 
     class Handler(BaseHTTPRequestHandler):
         timeout = 5  # settimeout on the connection: drop stuck clients
@@ -342,13 +468,17 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                     body = "ok\n"
                 else:
                     status, body = 503, "not ready\n"
+            elif path == "/debug":
+                ctype, body = "application/json", json.dumps(
+                    {"count": len(routes),
+                     "routes": [{"path": p, "description": d, "enabled": e}
+                                for p, d, e in routes]}, indent=2)
             elif path == "/debug/threads":
                 body = thread_dump()
             elif path == "/debug/traces" and tracer is not None:
-                ctype, body = _render_traces(tracer, params)
+                status, ctype, body = _render_traces(tracer, params)
             elif path == "/debug/events" and events_fn is not None:
-                ctype, body = "application/json", _render_events(events_fn,
-                                                                params)
+                status, ctype, body = _render_events(events_fn, params)
             elif path == "/debug/steps" and telemetry is not None:
                 status, ctype, body = _render_steps(telemetry, params)
                 if status == 404:
@@ -361,6 +491,14 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                 status, ctype, body = _render_incidents(incidents, params)
                 if status == 404:
                     body = None
+            elif path == "/debug/timeseries" and tsdb is not None:
+                status, ctype, body = _render_timeseries(tsdb, params)
+                if status == 404:
+                    body = None
+            elif path == "/debug/slo" and slos is not None:
+                status, ctype, body = _render_slo(slos, params)
+            elif path == "/debug/profile" and profiler is not None:
+                status, ctype, body = _render_profile(profiler, params)
             if body is None:
                 self.send_response(404)
                 self.end_headers()
