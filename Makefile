@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke
+.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -143,7 +143,16 @@ resize-smoke:
 slo-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.slo_smoke
 
+# Request-lifecycle plane (docs/SERVING.md): a real DecodeService over the
+# TCP telemetry wire (completed + rejected + drain-evicted, zero orphans
+# after reconcile); a churn fleet with scale-in deletes and exit-137
+# restarts must converge with zero orphaned requests and every restart
+# incident bundle carrying a requests stanza; the same seeds with the
+# plane off must produce byte-identical plan digest + phase counts.
+request-obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.request_obs_smoke
+
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke
+ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke

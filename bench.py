@@ -786,6 +786,57 @@ def bench_slo_plane(jobs=80):
     }
 
 
+def bench_request_plane(jobs=80):
+    """Request-lifecycle plane on/off A/B on one seeded chaos schedule
+    (docs/SERVING.md): the dropped-request audit at fleet scale.
+
+    Same churn + chaos profile both arms; the ``plane`` arm additionally
+    annotates every job with synthetic request traffic and runs the
+    ledger + reconcile audit.  Gates:
+
+    - zero orphaned requests after the drain-boundary reconcile (every
+      id submitted before a scale-in delete or exit-137 kill reached an
+      explicit terminal outcome -- completed or audibly evicted);
+    - every restart incident bundle that overlapped in-flight requests
+      carries the ``requests`` stanza (request downtime is attributed,
+      not implied);
+    - phase counts and the chaos plan digest byte-identical plane-on vs
+      plane-off (auditing the fleet cannot perturb it).
+    """
+    from trainingjob_operator_tpu.fleet.chaos import ChaosProfile
+    from trainingjob_operator_tpu.fleet.churn import ChurnProfile
+    from trainingjob_operator_tpu.fleet.harness import FleetHarness
+
+    profile = ChurnProfile(jobs=jobs, duration=3.0, seed=0, replicas=(1, 3),
+                           run_seconds=(0.05, 0.25))
+    runs = {}
+    for arm in ("off", "plane"):
+        harness = FleetHarness(
+            profile, workers=8, resync_period=30.0, gc_interval=30.0,
+            converge_timeout=300.0,
+            chaos_profile=ChaosProfile(seed=profile.seed, duration=5.0),
+            request_obs=(arm == "plane"))
+        runs[arm] = harness.run()
+    off, on = runs["off"], runs["plane"]
+    req = on.requests or {}
+    bundles = req.get("incident_bundles") or 0
+    stanzaed = req.get("bundles_with_requests") or 0
+    return {
+        "jobs": jobs,
+        "records_total": req.get("records_total"),
+        "orphaned_after_reconcile": req.get("orphaned_after_reconcile"),
+        "gate_zero_orphans": req.get("orphaned_after_reconcile") == 0,
+        "sampled_dropped_total": req.get("sampled_dropped_total"),
+        "incident_bundles": bundles,
+        "bundles_with_requests": stanzaed,
+        "gate_restart_bundles_stanzaed": not on.violations,
+        "phase_counts_identical": on.phase_counts == off.phase_counts,
+        "plan_digest_identical": ((on.chaos or {}).get("plan_digest")
+                                  == (off.chaos or {}).get("plan_digest")),
+        "converged": off.converged and on.converged,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Part 2c: fleet sim kernel -- scan-vs-event A/B at 1k jobs
 # ---------------------------------------------------------------------------
@@ -1602,6 +1653,11 @@ def main() -> int:
     except Exception as exc:
         out["slo_plane"] = {"error": f"{type(exc).__name__}: "
                                      f"{str(exc)[:300]}"}
+    try:
+        out["request_plane"] = bench_request_plane()
+    except Exception as exc:
+        out["request_plane"] = {"error": f"{type(exc).__name__}: "
+                                         f"{str(exc)[:300]}"}
     try:
         out["fleet_sim"] = bench_fleet_sim()
     except Exception as exc:

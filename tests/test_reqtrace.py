@@ -1,0 +1,379 @@
+"""Request-lifecycle plane (docs/SERVING.md): the per-request ledger's
+monotonic-id audit, tail-sampling retention, the request wire shape's
+validation at the aggregator, the incident ``requests`` stanza, and the
+/debug/requests + /debug/serve endpoint surface.
+
+Unit layer first with private instances (a RequestLedger with explicit
+ring/window knobs, driven with explicit timestamps -- the audit and the
+sampler are pure functions of the records, so the tests pin the
+contig/sparse/hwm arithmetic and the slowest-k policy exactly), then the
+aggregator's malformed-record hygiene, then the render handlers (called
+directly with parse_qs-shaped params, like the slo-plane endpoint tests).
+"""
+
+import json
+
+import pytest
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.incident import IncidentRecorder
+from trainingjob_operator_tpu.obs.reqtrace import (
+    REQTRACE,
+    REQUEST_OUTCOMES,
+    RequestLedger,
+)
+from trainingjob_operator_tpu.obs.telemetry import TelemetryAggregator
+from trainingjob_operator_tpu.utils.metrics import (
+    METRICS,
+    MetricsRegistry,
+    _render_requests,
+    _render_serve,
+)
+
+JOB = "default/reqjob"
+
+
+def _ledger(ring=4, window=16):
+    led = RequestLedger(ring=ring, window=window)
+    led.start()
+    return led
+
+
+def _rec(rid, outcome="completed", epoch="e0", hwm=None, ttft=40.0,
+         tpot=5.0, arrival=100.0, ts=101.0, phase_ms=None):
+    """One already-validated terminal record, ledger-shaped."""
+    return {
+        "request_outcome": outcome,
+        "request_id": rid,
+        "request_epoch": epoch,
+        "submitted_hwm": rid if hwm is None else hwm,
+        "ttft_ms": ttft,
+        "tpot_ms": tpot,
+        "tokens": 8,
+        "arrival": arrival,
+        "ts": ts,
+        "phase_ms": phase_ms or {"queued": 10.0, "prefill": 30.0,
+                                 "decode": 35.0},
+    }
+
+
+# -- the dropped-request audit ------------------------------------------------
+
+class TestAudit:
+    def test_contiguous_terminals_leave_no_orphans(self):
+        led = _ledger()
+        for rid in range(5):
+            led.record(JOB, _rec(rid))
+        assert led.reconcile(now=200.0) == 0
+        s = led.job_summary(JOB)
+        assert s["records_total"] == 5
+        assert s["outcomes"] == {"completed": 5}
+        assert s["open_ids"] == 0
+
+    def test_hwm_gap_is_filed_as_orphaned(self):
+        led = _ledger()
+        # ids 0 and 4 reached terminal state; the record for 4 proves ids
+        # 1-3 were submitted (submitted_hwm) but they never reported.
+        led.record(JOB, _rec(0))
+        led.record(JOB, _rec(4, hwm=4))
+        s = led.job_summary(JOB)
+        assert s["open_ids"] == 3
+        assert led.reconcile(now=200.0) == 3
+        s = led.job_summary(JOB)
+        assert s["orphaned"] == 3
+        assert s["open_ids"] == 0
+        # Idempotent: filed orphans joined the terminal set.
+        assert led.reconcile(now=201.0) == 0
+
+    def test_hwm_alone_orphans_a_never_reporting_stream(self):
+        led = _ledger()
+        # The only record says hwm=2: ids 0-1 died with their replica.
+        led.record(JOB, _rec(2, hwm=2))
+        assert led.reconcile(now=200.0) == 2
+
+    def test_epochs_are_separate_streams(self):
+        led = _ledger()
+        # Same ids in a new epoch (post-restart id reset) are a NEW
+        # stream, not duplicates and not a regression.
+        led.record(JOB, _rec(0, epoch="e0"))
+        led.record(JOB, _rec(0, epoch="e1"))
+        led.record(JOB, _rec(1, epoch="e1"))
+        s = led.job_summary(JOB)
+        assert s["streams"] == 2
+        assert s["records_total"] == 3
+        assert led.reconcile(now=200.0) == 0
+
+    def test_duplicate_terminal_first_record_wins(self):
+        led = _ledger()
+        led.record(JOB, _rec(0, outcome="completed"))
+        led.record(JOB, _rec(0, outcome="evicted"))
+        s = led.job_summary(JOB)
+        assert s["outcomes"] == {"completed": 1}
+        assert s["records_total"] == 1
+
+    def test_plane_off_is_a_strict_noop(self):
+        led = RequestLedger(ring=4, window=16)  # never started
+        assert led.record(JOB, _rec(0)) is False
+        assert led.jobs() == []
+        assert led.reconcile(now=200.0) == 0
+        assert led.job_summary(JOB) is None
+
+    def test_orphan_filing_survives_stop(self):
+        # The harness stops the plane, then reconciles + reports: retained
+        # state must stay readable and auditable after stop().
+        led = _ledger()
+        led.record(JOB, _rec(3, hwm=3))
+        led.stop()
+        assert led.reconcile(now=200.0) == 3
+        assert led.job_summary(JOB)["orphaned"] == 3
+
+
+# -- tail-sampling retention --------------------------------------------------
+
+class TestRetention:
+    def test_ring_at_exactly_full_drops_nothing(self):
+        led = _ledger(ring=3)
+        for rid in range(3):
+            led.record(JOB, _rec(rid))
+        s = led.job_summary(JOB)
+        assert s["retained"] == 3
+        assert s["sampled_dropped"] == 0
+
+    def test_overflow_keeps_the_slowest_and_counts_the_drop(self):
+        job = "default/reqring"
+        key = ('trainingjob_reqtrace_sampled_dropped_total'
+               '{job="default/reqring"}')
+        before = METRICS.snapshot().get(key, 0)
+        led = _ledger(ring=2)
+        led.record(job, _rec(0, phase_ms={"decode": 10.0}))
+        led.record(job, _rec(1, phase_ms={"decode": 500.0}))
+        led.record(job, _rec(2, phase_ms={"decode": 200.0}))
+        spans = led.retained_list(job)
+        assert [r["request_id"] for r in spans] == [1, 2]  # slowest two
+        s = led.job_summary(job)
+        assert s["retained"] == 2
+        assert s["sampled_dropped"] == 1
+        # The drop is audible on the metric surface, not just in-object.
+        assert METRICS.snapshot().get(key, 0) == before + 1
+        # The percentile window still saw ALL three records.
+        assert s["ttft_ms_p50"] == 40.0
+
+    def test_orphans_outrank_any_slow_request(self):
+        led = _ledger(ring=2)
+        led.record(JOB, _rec(0, phase_ms={"decode": 9999.0}))
+        led.record(JOB, _rec(1, phase_ms={"decode": 9998.0}, hwm=3))
+        led.reconcile(now=200.0)  # files ids 2-3 as orphaned
+        outcomes = [r["request_outcome"] for r in led.retained_list(JOB)]
+        assert outcomes.count("orphaned") == 2  # evidence beats latency
+
+    def test_percentiles_absent_until_a_record_carries_them(self):
+        led = _ledger()
+        assert led.ttft_percentiles(JOB) is None          # never seen
+        led.record(JOB, _rec(0, ttft=None, tpot=None))
+        assert led.ttft_percentiles(JOB) is None          # no TTFT yet
+        assert "ttft_ms_p50" not in led.job_summary(JOB)  # absent, not 0
+        led.record(JOB, _rec(1, ttft=80.0, tpot=6.0))
+        assert led.ttft_percentiles(JOB) == (80.0, 80.0)
+        assert led.tpot_percentiles(JOB) == (6.0, 6.0)
+
+
+# -- incident stanza + chrome export ------------------------------------------
+
+class TestWindowAndExport:
+    def test_window_overlap_and_worst_ttft(self):
+        led = _ledger()
+        led.record(JOB, _rec(0, arrival=100.0, ts=101.0, ttft=40.0))
+        led.record(JOB, _rec(1, arrival=150.0, ts=151.0, ttft=90.0))
+        stanza = led.window(JOB, 100.5, 120.0)
+        assert stanza["in_flight"] == 1
+        assert stanza["outcomes"] == {"completed": 1}
+        assert stanza["worst_ttft_ms"] == 40.0
+        assert led.window(JOB, 500.0, 600.0) == {}  # absent, not zeros
+
+    def test_evictions_bind_to_a_late_opening_incident(self):
+        led = _ledger()
+        # The kill flushed this eviction at t=101; detection latency
+        # (watch drop -> relist) opened the incident at t=103.  A plain
+        # interval overlap would miss the failure's own footprint.
+        led.record(JOB, _rec(0, outcome="evicted", arrival=100.0, ts=101.0))
+        stanza = led.window(JOB, 103.0, 110.0)
+        assert stanza["outcomes"] == {"evicted": 1}
+        # Completed records get NO such grace: they are traffic, not
+        # failure evidence.
+        led.record(JOB, _rec(1, outcome="completed", arrival=100.0,
+                             ts=101.0))
+        assert led.window(JOB, 103.0, 110.0)["in_flight"] == 1
+
+    def test_chrome_export_is_perfetto_shaped(self):
+        led = _ledger()
+        led.record(JOB, _rec(0, arrival=100.0, phase_ms={
+            "queued": 10.0, "prefill": 30.0, "decode": 60.0}))
+        seq = led.retained_list(JOB)[0]["seq"]
+        doc = led.export_chrome(JOB, seq)
+        assert doc["displayTimeUnit"] == "ms"
+        names = [ev["name"] for ev in doc["traceEvents"]]
+        assert names == ["queued", "prefill", "decode"]
+        assert all(ev["ph"] == "X" for ev in doc["traceEvents"])
+        # Phases are consecutive on the request's track: each event
+        # starts exactly where the previous one ended.
+        evs = doc["traceEvents"]
+        for prev, cur in zip(evs, evs[1:]):
+            assert cur["ts"] == pytest.approx(prev["ts"] + prev["dur"])
+        assert led.export_chrome(JOB, 999) is None
+
+    def test_restart_bundle_carries_requests_stanza(self):
+        REQTRACE.reset()
+        REQTRACE.start()
+        try:
+            REQTRACE.record(JOB, _rec(0, outcome="evicted",
+                                      arrival=99.0, ts=99.8, ttft=70.0))
+            rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64,
+                                   keep=4)
+            rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON,
+                                now=100.0)
+            rec.on_running(JOB, now=102.0)
+            rec.record_step(JOB, step=5, ms=100.0, now=103.0)
+            (bundle,) = rec.bundles(JOB)
+            assert bundle["requests"]["in_flight"] == 1
+            assert bundle["requests"]["outcomes"] == {"evicted": 1}
+            assert bundle["requests"]["worst_ttft_ms"] == 70.0
+            first = rec.bundle_json(JOB)
+            # The stanza was frozen at assembly: byte-stable re-assembly
+            # even after the live ledger is wiped.
+            REQTRACE.reset()
+            assert rec.reassemble(JOB) == first
+            assert rec.reassemble(JOB) == first
+        finally:
+            REQTRACE.stop()
+            REQTRACE.reset()
+
+    def test_plane_off_bundle_has_no_requests_key(self):
+        REQTRACE.reset()  # plane never started: window() is empty
+        rec = IncidentRecorder(metrics=MetricsRegistry(), ring=64, keep=4)
+        rec.on_interruption(JOB, "ALL", constants.RESTARTING_REASON,
+                            now=100.0)
+        rec.on_running(JOB, now=102.0)
+        (bundle,) = rec.bundles(JOB)
+        assert "requests" not in bundle
+
+
+# -- the wire shape at the aggregator -----------------------------------------
+
+class TestWireValidation:
+    def _agg(self):
+        reg = MetricsRegistry()
+        led = _ledger()
+        return TelemetryAggregator(metrics=reg, reqtrace=led), reg, led
+
+    def _malformed(self, reg):
+        return reg.snapshot().get("trainingjob_telemetry_malformed_total", 0)
+
+    def test_valid_record_feeds_metrics_and_ledger(self):
+        agg, reg, led = self._agg()
+        assert agg.ingest({"job": JOB, "request_outcome": "completed",
+                           "request_id": 0, "request_epoch": "e0",
+                           "submitted_hwm": 0, "tokens": 8,
+                           "ttft_ms": 40.0, "tpot_ms": 5.0,
+                           "arrival": 100.0,
+                           "phase_ms": {"queued": 10.0}}, now=101.0)
+        snap = reg.snapshot()
+        assert snap[('trainingjob_requests_total'
+                     '{job="default/reqjob",outcome="completed"}')] == 1
+        assert snap[('trainingjob_request_ttft_ms'
+                     '{job="default/reqjob"}_count')] == 1
+        assert led.job_summary(JOB)["records_total"] == 1
+        assert self._malformed(reg) == 0
+
+    @pytest.mark.parametrize("bad", [
+        {"request_outcome": "completed"},                   # no job/id/epoch
+        {"job": JOB, "request_outcome": "vanished",         # unknown outcome
+         "request_id": 0, "request_epoch": "e0"},
+        {"job": JOB, "request_outcome": "completed",        # id not an int
+         "request_id": "zero", "request_epoch": "e0"},
+        {"job": JOB, "request_outcome": "completed",        # empty epoch
+         "request_id": 0, "request_epoch": ""},
+        {"job": JOB, "request_outcome": "completed",        # hwm < id
+         "request_id": 5, "request_epoch": "e0", "submitted_hwm": 3},
+        {"job": JOB, "request_outcome": "completed",        # negative ttft
+         "request_id": 0, "request_epoch": "e0", "ttft_ms": -1.0},
+        {"job": JOB, "request_outcome": "completed",        # negative phase
+         "request_id": 0, "request_epoch": "e0",
+         "phase_ms": {"queued": -5.0}},
+        {"job": "nonamespace", "request_outcome": "completed",
+         "request_id": 0, "request_epoch": "e0"},           # not ns/name
+    ])
+    def test_malformed_is_counted_not_crashed(self, bad):
+        agg, reg, led = self._agg()
+        assert agg.ingest(bad, now=101.0) is False
+        assert self._malformed(reg) == 1
+        assert led.jobs() == []  # nothing reached the ledger
+
+    def test_orphaned_is_reconcile_only_on_the_wire_too(self):
+        # A live client claiming "orphaned" is lying: only reconcile()
+        # files that outcome (REQUEST_OUTCOMES documents it; the wire
+        # accepts it since the shape is valid -- but the audit invariant
+        # is that schedulers never send it).
+        assert "orphaned" in REQUEST_OUTCOMES
+
+
+# -- endpoint surface ---------------------------------------------------------
+
+class TestEndpoints:
+    def test_requests_unknown_job_is_404(self):
+        led = _ledger()
+        status, _, _ = _render_requests(led, {"job": ["default/ghost"]})
+        assert status == 404
+
+    def test_requests_bad_format_is_400(self):
+        led = _ledger()
+        status, _, body = _render_requests(led, {"format": ["xml"]})
+        assert status == 400
+        assert "xml" in body
+
+    def test_requests_bad_id_is_400(self):
+        led = _ledger()
+        led.record(JOB, _rec(0))
+        status, _, body = _render_requests(
+            led, {"job": [JOB], "id": ["latest"]})
+        assert status == 400
+        assert "latest" in body
+
+    def test_requests_sampled_away_id_is_404(self):
+        led = _ledger()
+        led.record(JOB, _rec(0))
+        status, _, _ = _render_requests(led, {"job": [JOB], "id": ["999"]})
+        assert status == 404
+
+    def test_requests_summary_job_and_span_views(self):
+        led = _ledger()
+        led.record(JOB, _rec(0))
+        status, ctype, body = _render_requests(led, {})
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body)["jobs_reporting"] == 1
+        status, _, body = _render_requests(led, {"job": [JOB]})
+        doc = json.loads(body)
+        assert doc["summary"]["records_total"] == 1
+        seq = doc["retained"][0]["seq"]
+        status, _, body = _render_requests(
+            led, {"job": [JOB], "id": [str(seq)], "format": ["chrome"]})
+        assert status == 200
+        assert json.loads(body)["displayTimeUnit"] == "ms"
+
+    def test_serve_columns_absent_is_dash_never_zero(self):
+        agg = TelemetryAggregator(metrics=MetricsRegistry())
+        agg.ingest({"job": JOB, "serve_queue_depth": 2.0,
+                    "serve_slots": 4.0}, now=100.0)
+        led = _ledger()  # ledger never saw this job
+        status, _, body = _render_serve(
+            agg, {"job": [JOB], "format": ["text"]}, reqtrace=led)
+        assert status == 200
+        row = next(ln for ln in body.splitlines() if "ttft_ms_p99" in ln)
+        assert row.split()[-1] == "-"
+        status, _, body = _render_serve(agg, {"job": [JOB]}, reqtrace=led)
+        assert json.loads(body)["serve"]["ttft_ms_p99"] is None
+        # Once the ledger reports, the columns materialize.
+        led.record(JOB, _rec(0, ttft=40.0, tpot=5.0))
+        status, _, body = _render_serve(agg, {"job": [JOB]}, reqtrace=led)
+        doc = json.loads(body)["serve"]
+        assert doc["ttft_ms_p99"] == 40.0
+        assert doc["tpot_ms_p50"] == 5.0

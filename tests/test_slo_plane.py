@@ -193,7 +193,7 @@ class TestSLOEngine:
     def test_default_slos_shape(self):
         names = [s.name for s in default_slos()]
         assert names == ["event_visible_p99", "detect_running_p99",
-                         "goodput_floor", "serve_token_p99"]
+                         "goodput_floor", "serve_token_p99", "ttft_p99"]
 
 
 # -- incident stamping --------------------------------------------------------

@@ -2160,6 +2160,54 @@ class TestChangedSinceMode:
         assert "no AST-changed files" in proc.stderr
 
 
+class TestResultCache:
+    """Full-run memoization (tools/analyze/cache.py): an unchanged tree
+    replays its findings from .analyze-cache.json; any file edit -- and
+    ``--no-cache`` -- forces a fresh analysis."""
+
+    BAD = ("def f():\n    try:\n        g()\n"
+           "    except Exception:\n        pass\n")
+
+    def _run(self, tmp_path, *extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analyze", str(tmp_path),
+             "--no-baseline", *extra],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+
+    def test_warm_run_replays_findings_and_says_so(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.BAD)
+        cold = self._run(tmp_path)
+        warm = self._run(tmp_path)
+        assert cold.returncode == 1 and warm.returncode == 1
+        assert "(cached)" not in cold.stderr
+        assert "(cached)" in warm.stderr
+        assert warm.stdout == cold.stdout       # identical findings
+        assert (tmp_path / ".analyze-cache.json").exists()
+
+    def test_file_edit_invalidates(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.BAD)
+        self._run(tmp_path)
+        (tmp_path / "mod.py").write_text("def f():\n    return 1\n")
+        fresh = self._run(tmp_path)
+        assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+        assert "(cached)" not in fresh.stderr
+        assert "0 finding(s)" in fresh.stderr
+
+    def test_no_cache_flag_bypasses(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.BAD)
+        first = self._run(tmp_path, "--no-cache")
+        second = self._run(tmp_path, "--no-cache")
+        assert "(cached)" not in first.stderr + second.stderr
+        assert not (tmp_path / ".analyze-cache.json").exists()
+
+    def test_scoped_runs_are_not_cached(self, tmp_path):
+        (tmp_path / "mod.py").write_text(self.BAD)
+        scoped = self._run(tmp_path, "--checks", "broad-except")
+        assert scoped.returncode == 1
+        assert not (tmp_path / ".analyze-cache.json").exists()
+
+
 # -- TJA024-027: the determinism layer ----------------------------------------
 
 PKG_INIT = {

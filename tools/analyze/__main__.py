@@ -122,6 +122,11 @@ def main(argv=None) -> int:
                     help="fail (exit 1) when the analysis itself takes longer "
                          "than S wall-clock seconds -- a CI budget proving "
                          "the whole-program layer stays cheap")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not update the incremental result "
+                         "cache (tools/analyze/cache.py); full runs over an "
+                         "unchanged tree otherwise replay their findings "
+                         "from .analyze-cache.json")
     args = ap.parse_args(argv)
 
     if args.list_checks:
@@ -163,8 +168,26 @@ def main(argv=None) -> int:
                   "incremental scoping, re-running project passes "
                   "tree-wide", file=sys.stderr)
             report_only = None
-    findings = runner.run_checks(paths, root=root, only=only,
-                                 report_only=report_only)
+
+    # Plain full runs (the ``make lint`` shape) replay cached findings when
+    # no analyzed file -- nor the analyzer itself -- changed since the last
+    # run.  Scoped or snapshot runs always analyze (cache.py).
+    cacheable = not (args.no_cache or only or report_only is not None
+                     or args.changed_since or args.write_baseline)
+    cached = False
+    fp = ""
+    if cacheable:
+        from tools.analyze import cache
+        fp = cache.fingerprint(runner.iter_py_files(paths, root), root)
+        hit = cache.load(root, paths, fp)
+        if hit is not None:
+            findings, cached = hit, True
+    if not cached:
+        findings = runner.run_checks(paths, root=root, only=only,
+                                     report_only=report_only)
+        if cacheable:
+            from tools.analyze import cache
+            cache.store(root, paths, fp, findings)
     elapsed = time.monotonic() - started
 
     if args.write_baseline:
@@ -189,6 +212,8 @@ def main(argv=None) -> int:
     if suppressed:
         summary += f", {suppressed} baselined"
     summary += f" in {elapsed:.2f}s"
+    if cached:
+        summary += " (cached)"
     print(summary, file=sys.stderr)
     if args.max_seconds is not None and elapsed > args.max_seconds:
         print(f"analysis took {elapsed:.2f}s, over the --max-seconds "

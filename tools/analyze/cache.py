@@ -1,0 +1,144 @@
+"""Incremental result cache for full analyzer runs.
+
+The analyzer is a pure function of the analyzed files: same bytes in, same
+findings out.  A full run over this tree costs most of the ``--max-seconds``
+CI budget, and the common invocation (``make lint``) re-analyzes a tree that
+has not changed since the last run.  So full runs memoize their findings on
+disk keyed by a fingerprint of every analyzed file -- ``(relpath, size,
+mtime_ns)`` per file, hashed -- plus the same triple for every file of the
+analyzer package itself, so editing a check invalidates entries even when
+``tools/`` is not among the analyzed roots (tests analyze temp trees).
+
+Only the plain full-run shape is cached (no ``--checks`` subset, no
+``--changed-since`` scoping, no baseline snapshot): those paths are either
+already incremental or explicitly want a fresh run.  Findings are cached
+*raw*, before baseline suppression and formatting, so baseline or format
+changes take effect on warm hits.  The cache is best-effort: any read,
+parse, or write failure silently degrades to a cold run.  ``--no-cache``
+bypasses it entirely.
+
+The cache file lives at ``<root>/.analyze-cache.json`` (gitignored), holds a
+handful of entries (one per distinct root set, e.g. the real tree and test
+temp trees), and is rewritten atomically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Iterable, List, Optional
+
+from tools.analyze.findings import Finding
+
+#: Cache file name, relative to the analysis root.
+CACHE_BASENAME = ".analyze-cache.json"
+
+#: Schema version: bump when the entry layout changes.
+_VERSION = 1
+
+#: Entries kept per cache file (distinct analyzed root sets).
+_MAX_ENTRIES = 8
+
+_FIELDS = ("check_id", "check_name", "path", "line", "col", "severity",
+           "message")
+
+
+def _stat_line(rel: str, path: str) -> Optional[str]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return f"{rel}\x00{st.st_size}\x00{st.st_mtime_ns}"
+
+
+def fingerprint(files: Iterable[str], root: str) -> str:
+    """Hash of (relpath, size, mtime_ns) for every analyzed file plus every
+    file of the analyzer package itself."""
+    h = hashlib.sha256()
+    lines: List[str] = []
+    for path in files:
+        line = _stat_line(os.path.relpath(path, root), path)
+        if line is None:
+            return ""          # racing deletion: don't cache this run
+        lines.append(line)
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith((".py", ".json")):
+                ap = os.path.join(dirpath, fn)
+                line = _stat_line(os.path.relpath(ap, pkg), ap)
+                if line is not None:
+                    lines.append("@" + line)
+    for line in sorted(lines):
+        h.update(line.encode("utf-8", "replace"))
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def _key(paths: List[str]) -> str:
+    return hashlib.sha256(
+        "\x00".join(sorted(paths)).encode("utf-8", "replace")).hexdigest()
+
+
+def _cache_path(root: str) -> str:
+    return os.path.join(root, CACHE_BASENAME)
+
+
+def load(root: str, paths: List[str], fp: str) -> Optional[List[Finding]]:
+    """Cached findings for this (root set, fingerprint), or None."""
+    if not fp:
+        return None
+    try:
+        with open(_cache_path(root), "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("v") != _VERSION:
+            return None
+        entry = doc.get("entries", {}).get(_key(paths))
+        if entry is None or entry.get("fp") != fp:
+            return None
+        return [Finding(**{f: row[i] for i, f in enumerate(_FIELDS)})
+                for row in entry["findings"]]
+    except (OSError, ValueError, KeyError, TypeError, IndexError):
+        return None
+
+
+def store(root: str, paths: List[str], fp: str,
+          findings: List[Finding]) -> None:
+    """Best-effort write-through; never raises."""
+    if not fp:
+        return
+    path = _cache_path(root)
+    try:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            if doc.get("v") != _VERSION or not isinstance(
+                    doc.get("entries"), dict):
+                doc = {"v": _VERSION, "entries": {}}
+        except (OSError, ValueError):
+            doc = {"v": _VERSION, "entries": {}}
+        entries = doc["entries"]
+        entries.pop(_key(paths), None)
+        while len(entries) >= _MAX_ENTRIES:
+            entries.pop(next(iter(entries)))
+        entries[_key(paths)] = {
+            "fp": fp,
+            "findings": [[getattr(f, name) for name in _FIELDS]
+                         for f in findings],
+        }
+        fd, tmp = tempfile.mkstemp(
+            prefix=CACHE_BASENAME, dir=os.path.dirname(path) or ".")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    except OSError:
+        pass

@@ -12,7 +12,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Set
 
-from tools.analyze.findings import FileContext, _LOCAL_BARRIERS
+from tools.analyze.findings import FileContext, _LOCAL_BARRIERS, _TOKEN_NODES
 from tools.analyze.project import LOCK_FACTORIES
 
 #: Method names that block unconditionally (socket/HTTP/process I/O).
@@ -68,6 +68,7 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
         # visible slice of the analyzer's wall-clock budget.
         cached = []
         isinst, AST, barriers = isinstance, ast.AST, _LOCAL_BARRIERS
+        tokens = _TOKEN_NODES       # same prune as FileContext._build_walk
         stack = []
         push, pop, keep = stack.append, stack.pop, cached.append
         d = root.__dict__            # root itself: descend but do not yield
@@ -75,9 +76,9 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
             v = d.get(name)
             if v.__class__ is list:
                 for item in v:
-                    if isinst(item, AST):
+                    if isinst(item, AST) and item.__class__ not in tokens:
                         push(item)
-            elif isinst(v, AST):
+            elif isinst(v, AST) and v.__class__ not in tokens:
                 push(v)
         while stack:
             node = pop()
@@ -89,25 +90,33 @@ def walk_local(root: ast.AST) -> Iterator[ast.AST]:
                 v = d.get(name)
                 if v.__class__ is list:
                     for item in v:
-                        if isinst(item, AST):
+                        if isinst(item, AST) and item.__class__ not in tokens:
                             push(item)
-                elif isinst(v, AST):
+                elif isinst(v, AST) and v.__class__ not in tokens:
                     push(v)
         root._tja_local_walk = cached
     return iter(cached)
 
 
 def call_dotted(call: ast.Call) -> Optional[str]:
-    """'time.sleep' / 'server.accept' / 'open' for a call's func chain."""
+    """'time.sleep' / 'server.accept' / 'open' for a call's func chain.
+    Memoized on the Call node: the blocking/backoff classifiers re-ask for
+    the same calls across passes (~60% repeat rate under the lint budget)."""
+    try:
+        return call._tja_dotted
+    except AttributeError:
+        pass
     parts: List[str] = []
     node = call.func
     while isinstance(node, ast.Attribute):
         parts.append(node.attr)
         node = node.value
+    out = None
     if isinstance(node, ast.Name):
         parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+        out = ".".join(reversed(parts))
+    call._tja_dotted = out
+    return out
 
 
 def _has_timeout(call: ast.Call) -> bool:

@@ -45,9 +45,12 @@ def _load_constants(repo_root: str) -> Tuple[Dict[str, str], Set[str]]:
     """(value -> constant name) plus the set of every defined string value
     (including non-contract-shaped ones, for pattern check 2)."""
     path = os.path.join(repo_root, CONSTANTS_REL)
-    if not os.path.exists(path):
+    # One stat, not an exists + getmtime pair: this runs once per analyzed
+    # file and stat latency is a visible slice of the --max-seconds budget.
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
         return {}, set()
-    mtime = os.path.getmtime(path)
     cached = _cache.get(path)
     if cached and cached[0] == mtime:
         return cached[1], cached[2]

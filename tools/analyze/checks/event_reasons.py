@@ -42,9 +42,11 @@ def _load_registry(repo_root: str) -> Tuple[Set[str], Set[str]]:
     """(registered constant names, registered string values) from the
     ``EVENT_REASONS`` frozenset in api/constants.py (mtime-cached)."""
     path = os.path.join(repo_root, CONSTANTS_REL)
-    if not os.path.exists(path):
+    # One stat, not an exists + getmtime pair (see constant_drift.py).
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
         return set(), set()
-    mtime = os.path.getmtime(path)
     cached = _cache.get(path)
     if cached and cached[0] == mtime:
         return cached[1], cached[2]

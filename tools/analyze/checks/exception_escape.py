@@ -105,6 +105,8 @@ class _Escapes:
         return cur
 
     def index(self) -> None:
+        if self.sites:
+            return  # already indexed (check() indexes before solving)
         for rel, ctx in self.pc.files.items():
             if ctx.tree is None:
                 continue
@@ -174,16 +176,25 @@ class _Escapes:
                     return [mi.functions[leaf]]
         return out
 
-    def solve(self) -> None:
-        """One AST sweep precomputes, per function, the constant escapes
-        (own raises/asserts, handler-filtered) and the call dependencies
-        (callee fn id + caught filter at the site); the fixpoint then
-        iterates only that structure -- no re-walking per round."""
+    def solve(self, entry_ids: Optional[Set[int]] = None) -> None:
+        """Precompute, per function, the constant escapes (own raises and
+        asserts, handler-filtered) and the call dependencies (callee fn id
+        + caught filter at the site); the fixpoint then iterates only that
+        structure -- no re-walking per round.
+
+        Findings only ever read the escape sets of thread *entry points*,
+        and a function's set depends only on its (transitive) callees -- so
+        with ``entry_ids`` the prep and fixpoint run over just the call
+        closure of those functions.  On this tree that is a few hundred of
+        several thousand defs; the rest never influence a finding."""
         self.index()
+        site_of = {id(fn): (fn, parents, mod_name, cls_name)
+                   for fn, parents, mod_name, cls_name in self.sites}
         const: Dict[int, Set[str]] = {}
         deps: Dict[int, List[Tuple[int, Set[str]]]] = {}
-        for fn, parents, mod_name, cls_name in self.sites:
-            fid = id(fn)
+
+        def prep(fid: int) -> None:
+            fn, parents, mod_name, cls_name = site_of[fid]
             const[fid] = set()
             deps[fid] = []
             for node in self._interesting.get(fid, ()):
@@ -211,12 +222,29 @@ class _Escapes:
                                if t not in caught and t not in EXEMPT}
                 for callee in callees:
                     deps[fid].append((id(callee), caught))
+
+        if entry_ids is None:
+            work = set(site_of)
+            for fid in work:
+                prep(fid)
+        else:
+            work = set()
+            stack = [fid for fid in entry_ids if fid in site_of]
+            while stack:
+                fid = stack.pop()
+                if fid in work:
+                    continue
+                work.add(fid)
+                prep(fid)
+                stack.extend(cid for cid, _ in deps[fid]
+                             if cid in site_of and cid not in work)
         changed = True
         rounds = 0
         while changed and rounds < 50:
             changed = False
             rounds += 1
-            for fid, acc in self.sets.items():
+            for fid in work:
+                acc = self.sets[fid]
                 before = len(acc)
                 acc |= const.get(fid, set())
                 for callee_id, caught in deps.get(fid, ()):
@@ -276,10 +304,12 @@ def _target_functions(pc: ProjectContext, esc: _Escapes
 @register_project("TJA017", "exception-escape")
 def check(pc: ProjectContext) -> List[Finding]:
     esc = _Escapes(pc)
-    esc.solve()
+    esc.index()
+    targets = _target_functions(pc, esc)
+    esc.solve(entry_ids={id(fn) for _, _, _, fn in targets})
     findings: List[Finding] = []
     seen: Set[Tuple[str, int]] = set()
-    for rel, line, label, fn in _target_functions(pc, esc):
+    for rel, line, label, fn in targets:
         types = sorted(esc.sets.get(id(fn), set()) - EXEMPT)
         if not types or (rel, line) in seen:
             continue

@@ -100,18 +100,25 @@ def check(ctx: FileContext) -> List[Finding]:
         if cur is None:
             continue
         counts = counts_by_fn.setdefault(id(cur), {})
-        counts[tgt] = counts.get(tgt, 0) + 1
+        entry = counts.setdefault(tgt, [0, 0])
+        entry[0] += 1
+        if _is_sentinel(node.value):
+            entry[1] += 1
     for fn in functions_of(ctx):
         if fn.name == "__init__":
             continue
         counts = counts_by_fn.get(id(fn), {})
-        if not any(c >= 2 for c in counts.values()):
+        # A finding needs a *sentinel* set (the only gen) plus a second
+        # assignment to the same target (the restore): plain rebind pairs
+        # (``x = f(); x = g(x)``) can never fire, and they are the common
+        # case -- requiring the sentinel cuts ~80% of the CFG+solve work.
+        if not any(c[0] >= 2 and c[1] for c in counts.values()):
             continue
         cfg = ctx.cfg(fn)
         sol = dataflow.solve(cfg, analysis)
         stuck = sol.in_of(cfg.exc_exit) - sol.in_of(cfg.exit)
         for tgt, _sid, line in sorted(stuck, key=lambda f: f[2]):
-            if counts.get(tgt, 0) < 2:
+            if counts.get(tgt, (0, 0))[0] < 2:
                 continue  # no restore anywhere: init, not a toggle pair
             findings.append(Finding(
                 "TJA019", "finally-state-restore", ctx.path, line, 0,

@@ -47,10 +47,12 @@ EMIT_CALLEE_RE = re.compile(
 def _doc_registry(pc: ProjectContext) -> Dict[str, int]:
     """metric name -> line number of its catalog row."""
     path = os.path.join(pc.root, DOC_REL.replace("/", os.sep))
-    if not os.path.exists(path):
-        return {}
     out: Dict[str, int] = {}
-    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+    try:
+        fh = open(path, "r", encoding="utf-8", errors="replace")
+    except OSError:
+        return out
+    with fh:
         for i, line in enumerate(fh, start=1):
             m = ROW_RE.match(line.strip())
             if m:

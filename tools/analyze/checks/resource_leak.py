@@ -34,9 +34,10 @@ import ast
 from typing import Dict, Iterator, List, Set, Tuple
 
 from tools.analyze import dataflow
-from tools.analyze.findings import ERROR, FileContext, Finding, walk_fast
+from tools.analyze.findings import (ERROR, FileContext, Finding,
+                                    _LOCAL_BARRIERS, walk_fast)
 from tools.analyze.runner import register
-from tools.analyze.checks._flow import call_dotted, functions_of, walk_local
+from tools.analyze.checks._flow import call_dotted, functions_of
 from tools.analyze.cfg import stmt_expressions
 
 #: factory (bare or dotted callee name) -> resource kind.
@@ -157,11 +158,26 @@ def check(ctx: FileContext) -> List[Finding]:
         return []
     findings: List[Finding] = []
     analysis = _Live()
+    # Factory-bearing functions from one sweep of the file's Assign bucket
+    # (gen only fires at ``name = <factory>(...)``, so only assignment
+    # values can matter), attributed to the owning def by parent-chain
+    # (#assigns x depth) instead of a walk_local sweep per function
+    # (#all-nodes): the sweeps dominated this pass on factory-free files,
+    # i.e. nearly all of them.  Owner == nearest barrier reproduces
+    # walk_local's membership exactly.
+    parents = ctx.parents
+    barriers = _LOCAL_BARRIERS
+    has_factory = set()
+    for stmt in ctx.by_type(ast.Assign):
+        if len(stmt.targets) == 1 and stmt.targets[0].__class__ is ast.Name \
+                and _factory_kind(stmt.value):
+            cur = parents.get(id(stmt))
+            while cur is not None and cur.__class__ not in barriers:
+                cur = parents.get(id(cur))
+            if cur is not None:
+                has_factory.add(id(cur))
     for fn in functions_of(ctx):
-        for n in walk_local(fn):
-            if n.__class__ is ast.Call and _factory_kind(n):
-                break
-        else:
+        if id(fn) not in has_factory:
             continue  # no factory anywhere: skip the CFG build entirely
         cfg = ctx.cfg(fn)
         sol = dataflow.solve(cfg, analysis)

@@ -38,14 +38,34 @@ class Finding:
                 _SEVERITY_RANK.get(self.severity, 9), self.check_id)
 
 
+#: Grammar-token singletons (expr_context/operator/boolop/unaryop/cmpop):
+#: childless nodes CPython's parser interns as shared instances -- ~35% of
+#: ``ast.walk``'s yield on this tree.  Every pass reads them as attributes
+#: of their owner (``node.ctx``, ``node.op``), never out of a walk, and
+#: their shared identity already made per-instance ``parents``/bucket
+#: entries meaningless.  Every walk this module builds skips them.
+_TOKEN_NODES = frozenset(
+    cls
+    for base in (ast.expr_context, ast.boolop, ast.operator, ast.unaryop,
+                 ast.cmpop)
+    for cls in base.__subclasses__())
+
+
 def walk_fast(root) -> list:
-    """``ast.walk`` equivalent returning a list (same BFS order), with the
-    per-node iter_child_nodes generator pair inlined away.  The passes call
-    this on tens of thousands of small subtrees (handlers, with-items,
-    statement bodies); the generator resumption overhead of the stdlib
-    version was a visible slice of the lint budget."""
+    """``ast.walk`` equivalent returning a list (same BFS order, minus the
+    ``_TOKEN_NODES`` singletons), with the per-node iter_child_nodes
+    generator pair inlined away.  The passes call this on tens of thousands
+    of small subtrees (handlers, with-items, statement bodies); the
+    generator resumption overhead of the stdlib version was a visible slice
+    of the lint budget.  The list is cached on ``root``: the path-sensitive
+    passes re-walk the same handlers and statements (~40% repeat rate), and
+    the callers are all read-only scans."""
+    cached = getattr(root, "_tja_walk", None)
+    if cached is not None:
+        return cached
     out = [root]
     isinst, AST = isinstance, ast.AST
+    tokens = _TOKEN_NODES
     push = out.append
     i = 0
     while i < len(out):
@@ -56,10 +76,11 @@ def walk_fast(root) -> list:
             v = d.get(name)
             if v.__class__ is list:
                 for item in v:
-                    if isinst(item, AST):
+                    if isinst(item, AST) and item.__class__ not in tokens:
                         push(item)
-            elif isinst(v, AST):
+            elif isinst(v, AST) and v.__class__ not in tokens:
                 push(v)
+    root._tja_walk = out
     return out
 
 
@@ -104,7 +125,8 @@ class FileContext:
 
     @property
     def nodes(self) -> list:
-        """Every AST node in the file (``ast.walk`` order), computed once and
+        """Every AST node in the file (``ast.walk`` order, minus the
+        ``_TOKEN_NODES`` singletons), computed once and
         shared by all passes.  With a dozen passes each re-walking every
         tree, the walk itself dominates analyzer wall-clock; passes that
         scan the whole file iterate this list instead."""
@@ -141,6 +163,7 @@ class FileContext:
         if self.tree is not None:
             isinst, AST = isinstance, ast.AST
             barriers = _LOCAL_BARRIERS
+            tokens = _TOKEN_NODES
             push = nodes.append
             push(self.tree)
             # owners[i] is the _tja_local_walk list of nodes[i]'s nearest
@@ -174,11 +197,12 @@ class FileContext:
                     v = d.get(name)
                     if v.__class__ is list:
                         for item in v:
-                            if isinst(item, AST):
+                            if isinst(item, AST) \
+                                    and item.__class__ not in tokens:
                                 parents[id(item)] = n
                                 push(item)
                                 opush(own)
-                    elif isinst(v, AST):
+                    elif isinst(v, AST) and v.__class__ not in tokens:
                         parents[id(v)] = n
                         push(v)
                         opush(own)

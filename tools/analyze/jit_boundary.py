@@ -49,7 +49,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.analyze.findings import FileContext
+from tools.analyze.findings import FileContext, _TOKEN_NODES, walk_fast
 from tools.analyze.project import ProjectContext, _dotted
 
 #: jit-like wrappers: first positional arg (or the decorated def) is traced.
@@ -432,6 +432,11 @@ _LEAF_NODES = frozenset({
     ast.Load, ast.Store, ast.Del, ast.alias,
 })
 
+#: Leaves plus the grammar-token singletons: visiting any of these is a
+#: guaranteed no-op, so ``_children`` skips the dispatch call entirely --
+#: they are the majority of all child visits.
+_SKIP_NODES = _LEAF_NODES | _TOKEN_NODES
+
 
 class _ScopeWalker:
     """Fill one FnRec from its body, stopping at nested function scopes
@@ -486,14 +491,16 @@ class _ScopeWalker:
         # Inlined iter_child_nodes: two generator resumptions per node add
         # up over ~150k visits (same trick as findings._build_walk).
         visit = self.visit
+        skip = _SKIP_NODES
         d = node.__dict__
         for name in node._fields:
             v = d.get(name)
             if v.__class__ is list:
                 for item in v:
-                    if isinstance(item, ast.AST):
+                    if item.__class__ not in skip \
+                            and isinstance(item, ast.AST):
                         visit(item, loops, targets)
-            elif isinstance(v, ast.AST):
+            elif v.__class__ not in skip and isinstance(v, ast.AST):
                 visit(v, loops, targets)
 
     def visit(self, node: ast.AST, loops: tuple, targets: tuple) -> None:
@@ -570,7 +577,7 @@ class _ScopeWalker:
         if cls is ast.Return:
             if node.value is not None:
                 rec.return_name_refs.update(
-                    n.id for n in ast.walk(node.value)
+                    n.id for n in walk_fast(node.value)
                     if isinstance(n, ast.Name))
             if isinstance(node.value, ast.Name):
                 rec.returns_names.add(node.value.id)
@@ -868,8 +875,54 @@ class _Builder:
 
     # -- dispatch fixpoint ----------------------------------------------------
 
+    def _settle_never_dispatch(self) -> None:
+        """Pre-settle name-calls the fixpoint can never flip to device.
+
+        A ``("name", n)`` call dispatches only if (a) it hits a jit binding
+        (``site_for_call`` -- static once sites are collected), (b) ``n``
+        lands in ``dispatch_names``/``param_taint`` of its scope chain, or
+        (c) its resolved callee joins ``dispatching``.  ``dispatch_names``
+        only ever receives *assignment targets of calls* in a scope and
+        ``param_taint`` only that scope's *parameters*, so when ``n`` is
+        neither anywhere on the chain and resolution is static-None, the
+        verdict is False forever -- settle it now.  This covers the builtin
+        /stdlib calls (len, sorted, print, ...) that otherwise dominate
+        every fixpoint round's re-check."""
+        b = self.b
+        possible: Dict[str, Set[str]] = {}
+
+        def chain_names(qual: str) -> Set[str]:
+            got = possible.get(qual)
+            if got is None:
+                rec = b.fns[qual]
+                got = set(rec.params)
+                for cr in rec.calls:
+                    for t in cr.targets:
+                        if isinstance(t, str):
+                            got.add(t)
+                if rec.parent and rec.parent in b.fns:
+                    got |= chain_names(rec.parent)
+                possible[qual] = got
+            return got
+
+        for qual, rec in b.fns.items():
+            for cr in rec.calls:
+                ref = cr.ref
+                if ref is None or ref[0] != "name":
+                    continue
+                cid = id(cr)
+                if cid in b._device_true or cid in b._device_false:
+                    continue
+                if b.site_for_call(rec, cr) is not None:
+                    continue
+                if b.resolve_callee(rec, ref) is not None:
+                    continue
+                if ref[1] not in chain_names(qual):
+                    b._device_false.add(cid)
+
     def dispatch_fixpoint(self) -> None:
         b = self.b
+        self._settle_never_dispatch()
         changed = True
         rounds = 0
         while changed and rounds < 20:
@@ -909,7 +962,7 @@ class _Builder:
                 if not ret:
                     retnames = rec.returns_names
                     if (retnames & names
-                            or retnames & set(rec.jit_bindings)
+                            or retnames & rec.jit_bindings.keys()
                             or retnames & b.param_taint.get(qual, set())
                             or any(n in b.dispatching
                                    for n in rec.returns_nested)):
@@ -972,7 +1025,9 @@ class _Builder:
             if not b.is_device_call(rec, cr):
                 continue
             produced.update(cr.targets)
-            for arg in ast.walk(cr.node):
+            # walk_fast: memoized on the Call node -- every loop pass over
+            # a scope re-walks the same device-call expressions.
+            for arg in walk_fast(cr.node):
                 if isinstance(arg, ast.Name):
                     consumed.add(arg.id)
                 elif (isinstance(arg, ast.Attribute)

@@ -38,7 +38,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.analyze.findings import FileContext
+from tools.analyze.findings import FileContext, _TOKEN_NODES
 
 #: threading factories whose assignment makes an attribute "a lock".
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
@@ -183,6 +183,11 @@ _WALK_LEAVES = frozenset({
     ast.Load, ast.Store, ast.Del, ast.alias,
 })
 
+#: Leaves plus the grammar-token singletons (operators, comparators, ...):
+#: visiting any of these is a guaranteed no-op, so the child loop skips the
+#: dispatch call entirely -- they are ~60% of all child visits.
+_WALK_SKIP = _WALK_LEAVES | _TOKEN_NODES
+
 
 class _BodyWalker:
     """One pass over a function body collecting the MethodSummary facts,
@@ -258,15 +263,15 @@ class _BodyWalker:
         iter_child_nodes/iter_fields generator resumptions over every method
         body in the tree are a visible slice of the lint budget."""
         visit = self.visit
-        isinst, AST = isinstance, ast.AST
+        isinst, AST, skip = isinstance, ast.AST, _WALK_SKIP
         d = node.__dict__
         for name in node._fields:
             v = d.get(name)
             if v.__class__ is list:
                 for item in v:
-                    if isinst(item, AST):
+                    if item.__class__ not in skip and isinst(item, AST):
                         visit(item, held)
-            elif isinst(v, AST):
+            elif v.__class__ not in skip and isinst(v, AST):
                 visit(v, held)
 
     def visit(self, node: ast.AST, held: List[str]) -> None:

@@ -1,0 +1,197 @@
+"""Seeded request-plane smoke: zero orphans, stanza coverage, zero footprint.
+
+The ``make request-obs-smoke`` driver (wired into ``make ci``): one
+in-process serving arm plus two subprocess fleet arms exercising the
+request-lifecycle plane (obs/reqtrace.py, docs/SERVING.md).
+
+- **wire**: a real DecodeService pushes request records over the TCP
+  telemetry wire -- completed requests, an over-capacity rejection, and a
+  mid-flight ``drain_abort`` eviction.  Every submitted id must reach a
+  terminal outcome: ``reconcile()`` files ZERO orphans, and TTFT/TPOT
+  percentiles materialize from the completed spans.
+- **fleet / plane on** (``--chaos --request-obs``): churn includes
+  mid-flight CR deletes (scale-in drain) and exit-137 pod kills with
+  restart; the run must converge with zero violations -- which bundles in
+  the two plane invariants: zero orphaned requests after reconcile, and
+  every restart incident's bundle carrying a ``requests`` stanza.
+- **fleet / plane off**: same churn + chaos seeds without the plane.  The
+  chaos plan digest and final phase counts must be byte-identical to the
+  plane-on arm, and the report's ``requests`` field must be null --
+  auditing the fleet must not perturb it.
+
+Usage::
+
+    python -m tools.request_obs_smoke [--jobs 24] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _wire_arm() -> int:
+    """DecodeService -> TCP sink -> aggregator -> ledger, end to end."""
+    import jax
+
+    from trainingjob_operator_tpu.api import constants
+    from trainingjob_operator_tpu.models import llama
+    from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
+    from trainingjob_operator_tpu.obs.telemetry import (
+        TelemetryEmitter,
+        TelemetrySink,
+    )
+    from trainingjob_operator_tpu.workloads import serve
+
+    job = "smoke/reqobs"
+    os.environ[constants.JOB_NAMESPACE_ENV] = "smoke"
+    os.environ[constants.JOB_NAME_ENV] = "reqobs"
+    REQTRACE.reset()
+    REQTRACE.start()
+    sink = TelemetrySink(publish=False).start()
+    try:
+        emitter = TelemetryEmitter(addr=sink.address)
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        svc = serve.DecodeService(params, cfg, slots=2, prefill_chunk=4,
+                                  queue_cap=6, emitter=emitter)
+        for _ in range(4):
+            svc.submit([1, 2, 3, 4], 3)
+        for _ in range(6):
+            svc.step()
+        # Overflow: fill whatever queue room is left, then one more must
+        # be rejected -- a terminal outcome on the wire, not a lost id.
+        try:
+            for _ in range(svc.queue_cap + 1 - len(svc.queue)):
+                svc.submit([1, 2, 3], 2)
+        except serve.QueueFull:
+            pass
+        else:
+            print("overflow never raised QueueFull", file=sys.stderr)
+            return 1
+        # Scale-in analogue: abort everything still queued or decoding.
+        evicted = svc.drain_abort()
+        submitted = svc._next_rid
+        deadline = time.monotonic() + 10.0
+        summary = None
+        while time.monotonic() < deadline:
+            summary = REQTRACE.job_summary(job)
+            if summary and summary["records_total"] >= submitted:
+                break
+            time.sleep(0.05)
+        if not summary or summary["records_total"] < submitted:
+            print(f"wire arm: only {summary and summary['records_total']} "
+                  f"of {submitted} records reached the ledger",
+                  file=sys.stderr)
+            return 1
+        orphans = REQTRACE.reconcile(time.time())
+        summary = REQTRACE.job_summary(job) or {}
+        outcomes = summary.get("outcomes", {})
+        print(f"wire: submitted={submitted} outcomes={outcomes} "
+              f"evicted_by_drain={len(evicted)} orphans={orphans} "
+              f"ttft_p99={summary.get('ttft_ms_p99')}")
+        if orphans:
+            print(f"wire arm: {orphans} orphaned request(s) despite every "
+                  f"id reaching a terminal state", file=sys.stderr)
+            return 1
+        for outcome in ("completed", "rejected", "evicted"):
+            if not outcomes.get(outcome):
+                print(f"wire arm: no {outcome!r} outcome recorded",
+                      file=sys.stderr)
+                return 1
+        if summary.get("ttft_ms_p99") is None:
+            print("wire arm: completed spans but no TTFT percentiles",
+                  file=sys.stderr)
+            return 1
+    finally:
+        sink.stop()
+        REQTRACE.stop()
+    return 0
+
+
+def _run(args: argparse.Namespace, extra=()) -> dict:
+    cmd = [sys.executable, "-m", "trainingjob_operator_tpu.fleet.harness",
+           "--jobs", str(args.jobs),
+           "--seed", str(args.seed),
+           "--duration", str(args.duration),
+           "--replicas-min", "1", "--replicas-max", "3",
+           "--workers", "4", "--chaos",
+           "--chaos-seed", str(args.chaos_seed),
+           "--converge-timeout", str(args.converge_timeout), "--quiet"]
+    cmd += list(extra)
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+        raise SystemExit("request-obs fleet run failed (rc=%d):\n%s"
+                         % (proc.returncode, "\n".join(tail)))
+    return json.loads(proc.stdout)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("request-obs-smoke")
+    parser.add_argument("--jobs", type=int, default=24)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument("--duration", type=float, default=1.5)
+    parser.add_argument("--converge-timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    # -- Arm 1: real service over the real wire ----------------------------
+    rc = _wire_arm()
+    if rc:
+        return rc
+
+    # -- Arm 2: fleet churn with the plane on ------------------------------
+    on = _run(args, extra=["--request-obs"])
+    req = on.get("requests") or {}
+    print(f"fleet on: converged={on['converged']} "
+          f"records={req.get('records_total')} "
+          f"orphans={req.get('orphaned_after_reconcile')} "
+          f"bundles={req.get('incident_bundles')} "
+          f"with_stanza={req.get('bundles_with_requests')}")
+    if not on["converged"] or on["violations"]:
+        print("plane-on fleet run did not converge cleanly:\n"
+              + "\n".join(on["violations"][:10]), file=sys.stderr)
+        return 1
+    if not req.get("records_total"):
+        print("plane on but no request records reached the ledger",
+              file=sys.stderr)
+        return 1
+    if req.get("orphaned_after_reconcile") != 0:
+        print(f"{req.get('orphaned_after_reconcile')} orphaned request(s) "
+              f"after scale-in drains and exit-137 restarts",
+              file=sys.stderr)
+        return 1
+    if not req.get("bundles_with_requests"):
+        print("no incident bundle carries a requests stanza",
+              file=sys.stderr)
+        return 1
+
+    # -- Arm 3: same seeds, plane off -- the plane must not perturb --------
+    off = _run(args)
+    if (on["chaos"]["plan_digest"] != off["chaos"]["plan_digest"]
+            or on["phase_counts"] != off["phase_counts"]):
+        print("request plane perturbed the fleet:\n"
+              f"  digest  on={on['chaos']['plan_digest']}\n"
+              f"          off={off['chaos']['plan_digest']}\n"
+              f"  phases  on={on['phase_counts']}\n"
+              f"          off={off['phase_counts']}", file=sys.stderr)
+        return 1
+    if off.get("requests") is not None:
+        print("plane-off report unexpectedly carries a requests rollup",
+              file=sys.stderr)
+        return 1
+
+    print(f"request-obs smoke ok: plan {on['chaos']['plan_digest'][:12]} "
+          f"records={req['records_total']} orphans=0 "
+          f"stanza_bundles={req['bundles_with_requests']} "
+          f"phase_counts={on['phase_counts']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,7 +57,19 @@ def main(argv=None) -> int:
         print("queue over capacity did not raise QueueFull", file=sys.stderr)
         return 1
     except serve.QueueFull:
-        print("backpressure ok: QueueFull at capacity 4")
+        pass
+    # The rejection must be visible on the wire too, not just as an
+    # exception the caller happened to catch (docs/OBSERVABILITY.md).
+    from trainingjob_operator_tpu.utils.metrics import METRICS
+    rejected = METRICS.snapshot().get(
+        'trainingjob_serve_rejected_total'
+        '{job="local/serve",reason="QueueFull"}', 0)
+    if not rejected:
+        print("QueueFull raised but trainingjob_serve_rejected_total "
+              "did not count it", file=sys.stderr)
+        return 1
+    print(f"backpressure ok: QueueFull at capacity 4 "
+          f"(rejected_total={rejected:.0f})")
 
     traffic = serve.synthetic_traffic(
         args.requests, seed=11, rate=1.5, vocab=cfg.vocab_size,

@@ -274,6 +274,7 @@ SLO_EVENT_P99_MS_ENV = "TRAININGJOB_SLO_EVENT_P99_MS"
 SLO_RESTART_P99_S_ENV = "TRAININGJOB_SLO_RESTART_P99_S"
 SLO_GOODPUT_FLOOR_ENV = "TRAININGJOB_SLO_GOODPUT_FLOOR"
 SLO_SERVE_P99_MS_ENV = "TRAININGJOB_SLO_SERVE_P99_MS"
+SLO_TTFT_P99_MS_ENV = "TRAININGJOB_SLO_TTFT_P99_MS"
 # Sampling stack profiler: base sampling interval (milliseconds; each
 # actual gap is jittered off a seeded random.Random so samples don't alias
 # the controller's periodic loops) and the jitter seed.  Distinct names
@@ -281,6 +282,14 @@ SLO_SERVE_P99_MS_ENV = "TRAININGJOB_SLO_SERVE_P99_MS"
 # jax.profiler; these drive the in-operator span profiler.
 PROFILE_INTERVAL_MS_ENV = "TRAININGJOB_PROFILE_INTERVAL_MS"
 PROFILE_SEED_ENV = "TRAININGJOB_PROFILE_SEED"
+
+# --- Request-lifecycle plane (obs/reqtrace.py, docs/SERVING.md) -------------
+# Tail-sampling retention: full spans kept per job (the slowest-k ring --
+# the rest drop with trainingjob_reqtrace_sampled_dropped_total, never
+# silently) and the bounded recent window feeding incident overlap
+# queries and TTFT/TPOT percentiles.
+REQTRACE_RING_ENV = "TRAININGJOB_REQTRACE_RING"
+REQTRACE_WINDOW_ENV = "TRAININGJOB_REQTRACE_WINDOW"
 
 #: Env vars that are part of the contract but *user-set* (pod template or
 #: operator environment), never injected by the controller: workload tuning
@@ -346,8 +355,11 @@ USER_ENV_KNOBS = frozenset((
     SLO_RESTART_P99_S_ENV,
     SLO_GOODPUT_FLOOR_ENV,
     SLO_SERVE_P99_MS_ENV,
+    SLO_TTFT_P99_MS_ENV,
     PROFILE_INTERVAL_MS_ENV,
     PROFILE_SEED_ENV,
+    REQTRACE_RING_ENV,
+    REQTRACE_WINDOW_ENV,
 ))
 
 #: Env vars the controller injects for consumers *outside* this codebase --
@@ -572,6 +584,9 @@ SHARD_STATE_REGISTRY = {
     "obs.incident.INCIDENTS": SHARD_STATE_LOCAL,
     "obs.goodput.GOODPUT": SHARD_STATE_LOCAL,
     "obs.telemetry.TELEMETRY": SHARD_STATE_LOCAL,
+    # Request ledger: keyed by job like the incident recorder -- a shard
+    # owning a job's serve replicas owns its whole request audit.
+    "obs.reqtrace.REQTRACE": SHARD_STATE_LOCAL,
     # Process-wide, lock-coordinated: one per shard is the correct shape
     # (metrics and traces are scraped per process; the sink address and
     # port cursor are process-scoped by construction).

@@ -93,6 +93,12 @@ def main(argv: Optional[list] = None) -> int:
                              "sweeper, burn-rate engine and sampling span "
                              "profiler; served at /debug/timeseries, "
                              "/debug/slo and /debug/profile.")
+    parser.add_argument("--request-obs", action="store_true",
+                        help="Run the request-lifecycle plane "
+                             "(docs/SERVING.md): record per-request terminal "
+                             "states arriving over the telemetry wire into "
+                             "the ledger behind /debug/requests and the "
+                             "/debug/serve TTFT/TPOT columns.")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     opt = OperatorOptions.from_args(args)
@@ -122,6 +128,7 @@ def main(argv: Optional[list] = None) -> int:
     if args.metrics_port:
         from trainingjob_operator_tpu.obs.incident import INCIDENTS
         from trainingjob_operator_tpu.obs.profiler import PROFILER
+        from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
         from trainingjob_operator_tpu.obs.slo import SLOS
         from trainingjob_operator_tpu.obs.telemetry import TELEMETRY
         from trainingjob_operator_tpu.obs.trace import TRACER
@@ -132,7 +139,8 @@ def main(argv: Optional[list] = None) -> int:
             args.metrics_port, tracer=TRACER,
             events_fn=lambda: clientset.events.list(None),
             ready_fn=controller.ready, telemetry=TELEMETRY,
-            incidents=INCIDENTS, tsdb=TSDB, slos=SLOS, profiler=PROFILER)
+            incidents=INCIDENTS, tsdb=TSDB, slos=SLOS, profiler=PROFILER,
+            reqtrace=REQTRACE)
         print(f"metrics on :{args.metrics_port}/metrics")
 
     def run_operator():
@@ -144,6 +152,10 @@ def main(argv: Optional[list] = None) -> int:
             TSDB.start()
             SLOS.start()
             PROFILER.start()
+        if args.request_obs:
+            from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
+
+            REQTRACE.start()
         runtime.start()
         controller.run()
         applied = []
@@ -169,6 +181,10 @@ def main(argv: Optional[list] = None) -> int:
                 SLOS.stop()
                 PROFILER.stop()
                 TSDB.stop()
+            if args.request_obs:
+                from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
+
+                REQTRACE.stop()
             if metrics_server is not None:
                 metrics_server.shutdown()
             if args.trace_out:

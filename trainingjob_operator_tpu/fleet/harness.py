@@ -97,12 +97,16 @@ from trainingjob_operator_tpu.fleet.churn import (
 )
 from trainingjob_operator_tpu.runtime.sim import (
     EXIT_CODE_ANNOTATION,
+    REQ_RATE_ANNOTATION,
+    REQ_TPOT_ANNOTATION,
+    REQ_TTFT_ANNOTATION,
     RUN_SECONDS_ANNOTATION,
     SimRuntime,
     resolve_kernel,
 )
 from trainingjob_operator_tpu.obs.incident import INCIDENTS
 from trainingjob_operator_tpu.obs.profiler import PROFILER
+from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
 from trainingjob_operator_tpu.obs.slo import SLOS, default_slos
 from trainingjob_operator_tpu.obs.tsdb import TSDB
 from trainingjob_operator_tpu.utils.metrics import METRICS
@@ -322,6 +326,12 @@ class FleetReport:
     #: Span profiler summary when it ran (--profile): top span stacks by
     #: CPU%, worker span-attribution ratio, measured overhead.  None off.
     profile_top: Optional[Dict[str, Any]] = None
+    #: Request-plane audit when it ran (--request-obs): the ledger rollup
+    #: (records, outcomes, orphans after reconcile, tail-sampling drops)
+    #: plus incident-bundle ``requests`` stanza coverage.  None with the
+    #: plane off; nonzero orphans file a violation, mirroring
+    #: ``unattributed_downtime_ms``.
+    requests: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -352,11 +362,13 @@ class FleetReport:
             "chaos": self.chaos,
             "slo_verdicts": self.slo_verdicts,
             "profile_top": self.profile_top,
+            "requests": self.requests,
         }
 
 
 def build_job(plan: JobPlan, with_ports: bool = False,
-              node_fail_restart: bool = False) -> TPUTrainingJob:
+              node_fail_restart: bool = False,
+              request_obs: bool = False) -> TPUTrainingJob:
     """A sim-runnable job from a plan.  No container ports by default: the
     service reconciler then creates nothing, which keeps a 100k-replica run
     about pods (ports=True doubles the object count for DNS realism).
@@ -365,14 +377,23 @@ def build_job(plan: JobPlan, with_ports: bool = False,
     ``ON_NODE_FAIL_WITH_EXIT_CODE`` restart semantics -- the realistic TPU
     training config: a dead node restarts the group instead of terminally
     failing the job, so node faults are survivable and restart counts
-    measure the controller's damping (docs/CHAOS.md)."""
+    measure the controller's damping (docs/CHAOS.md).
+
+    ``request_obs`` adds the request-synthesis annotations (sim opens and
+    completes request ids per tick) -- only then does the run produce
+    request records, which is what keeps the plane-off arm byte-identical."""
     ports = ([ContainerPort(name="aitj-7777", container_port=7777)]
              if with_ports else [])
+    annotations = {
+        RUN_SECONDS_ANNOTATION: f"{plan.run_seconds:.3f}",
+        EXIT_CODE_ANNOTATION: "0",
+    }
+    if request_obs:
+        annotations[REQ_RATE_ANNOTATION] = "2"
+        annotations[REQ_TTFT_ANNOTATION] = "40"
+        annotations[REQ_TPOT_ANNOTATION] = "5"
     template = PodTemplateSpec(
-        metadata=ObjectMeta(annotations={
-            RUN_SECONDS_ANNOTATION: f"{plan.run_seconds:.3f}",
-            EXIT_CODE_ANNOTATION: "0",
-        }),
+        metadata=ObjectMeta(annotations=annotations),
         spec=PodSpec(containers=[Container(name="aitj-main", ports=ports)]))
     job = TPUTrainingJob(metadata=ObjectMeta(
         name=plan.name, namespace=plan.namespace))
@@ -404,6 +425,7 @@ class FleetHarness:
                  chaos_profile: Optional[ChaosProfile] = None,
                  nodes_per_slice: int = 4,
                  slo_plane: bool = False, profiler: bool = False,
+                 request_obs: bool = False,
                  progress: Optional[Callable[[str], None]] = None):
         self.profile = profile
         self.workers = workers
@@ -439,6 +461,11 @@ class FleetHarness:
         # slo-smoke determinism arm proves exactly that.
         self.slo_plane = slo_plane
         self.with_profiler = profiler
+        # Request-lifecycle plane (docs/SERVING.md): jobs get the request-
+        # synthesis annotations and the audit ledger runs; at the end the
+        # harness reconciles submitted vs terminal ids and files a
+        # violation for any orphan.
+        self.request_obs = request_obs
         self._progress = progress or (lambda _msg: None)
         self.violations: List[str] = []
 
@@ -518,11 +545,16 @@ class FleetHarness:
         if self.with_profiler:
             PROFILER.reset()
             PROFILER.start()
+        if self.request_obs:
+            # Fresh ledger per run, same reasoning as the tsdb above.
+            REQTRACE.reset()
+            REQTRACE.start()
         started = time.monotonic()
         downtime_phases: Dict[str, Any] = {}
         unattributed = 0.0
         slo_verdicts: Optional[Dict[str, Any]] = None
         profile_top: Optional[Dict[str, Any]] = None
+        requests_report: Optional[Dict[str, Any]] = None
         try:
             self._drive(cs, sim, recorder, plans, started)
             # Let every planned node fault fire (and every flap recover)
@@ -538,6 +570,14 @@ class FleetHarness:
             # Harvest incident bundles BEFORE the GC sweep: deleting a
             # finished job makes the next sync forget its incident state.
             downtime_phases, unattributed = self._collect_downtime(plans)
+            if self.request_obs:
+                # Drain boundary: evict every batch still open on a live
+                # pod (steady jobs keep serving until shutdown), THEN
+                # reconcile submitted vs terminal ids.  Residue after that
+                # means a death path dropped requests on the floor.
+                sim.flush_open_requests()
+                orphans = REQTRACE.reconcile(time.time())
+                requests_report = self._collect_requests(plans, orphans)
             if self.slo_plane:
                 # One final sweep + evaluation so short runs still get
                 # verdicts from end-of-run data, then fold in what the run
@@ -568,6 +608,8 @@ class FleetHarness:
                 TSDB.stop()
             if self.with_profiler:
                 PROFILER.stop()
+            if self.request_obs:
+                REQTRACE.stop()
         if unattributed > 0.0:
             self.violations.append(
                 f"incident recorder left {unattributed:.1f} ms of downtime "
@@ -622,6 +664,7 @@ class FleetHarness:
             chaos=chaos_report,
             slo_verdicts=slo_verdicts,
             profile_top=profile_top,
+            requests=requests_report,
         )
 
     @staticmethod
@@ -667,6 +710,40 @@ class FleetHarness:
         }
         return report, unattributed
 
+    def _collect_requests(self, plans: List[JobPlan],
+                          orphans: int) -> Dict[str, Any]:
+        """Request-plane verdict: the ledger rollup plus incident-bundle
+        ``requests`` stanza coverage.  Nonzero orphans file a violation
+        (mirror of ``unattributed_downtime_ms``); so does a restart
+        incident whose window the ledger can still prove overlapped
+        requests (re-running the finalizer's own overlap query) while its
+        bundle carries no stanza.  A pod killed before its first serve
+        tick genuinely overlapped nothing -- no stanza is correct there,
+        not a hole."""
+        if orphans > 0:
+            self.violations.append(
+                f"request audit ledger found {orphans} orphaned request(s) "
+                f"(submitted but never terminal)")
+        bundles_total = 0
+        bundles_with_requests = 0
+        for plan in plans:
+            for bundle in (INCIDENTS.bundles(plan.key) or []):
+                bundles_total += 1
+                if bundle.get("requests"):
+                    bundles_with_requests += 1
+                elif plan.fate == FATE_POD_FAIL and REQTRACE.window(
+                        plan.key, bundle["started"],
+                        bundle["started"] + bundle["downtime_ms"] / 1e3):
+                    self.violations.append(
+                        f"{plan.key}: restart incident #{bundle['id']} "
+                        f"overlapped in-flight requests but its bundle "
+                        f"carries no requests stanza")
+        report = REQTRACE.summary()
+        report["orphaned_after_reconcile"] = orphans
+        report["incident_bundles"] = bundles_total
+        report["bundles_with_requests"] = bundles_with_requests
+        return report
+
     @staticmethod
     def _sync_count() -> int:
         return int(METRICS.snapshot().get(
@@ -711,7 +788,8 @@ class FleetHarness:
                 recorder.mark_create(plan.key)
                 cs.trainingjobs.create(build_job(
                     plan, self.with_ports,
-                    node_fail_restart=self._node_faults_planned()))
+                    node_fail_restart=self._node_faults_planned(),
+                    request_obs=self.request_obs))
             elif kind == FATE_PREEMPT:
                 self._fire_preempt(cs, recorder, plan)
             elif kind == FATE_DELETE:
@@ -943,6 +1021,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="Run the sampling span profiler during the run; "
                          "the report gains profile_top (per-span CPU%%, "
                          "attribution ratio, overhead).")
+    ap.add_argument("--request-obs", action="store_true",
+                    help="Run the request-lifecycle plane (docs/SERVING.md): "
+                         "jobs synthesize per-request records, the audit "
+                         "ledger reconciles submitted vs terminal ids, and "
+                         "the report gains a requests rollup (orphans file "
+                         "violations).")
     ap.add_argument("--quiet", action="store_true",
                     help="Suppress progress lines; print only the report.")
     args = ap.parse_args(argv)
@@ -974,6 +1058,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         sim_kernel=args.sim_kernel, max_wall_seconds=args.max_wall_seconds,
         chaos_profile=chaos_profile, nodes_per_slice=args.nodes_per_slice,
         slo_plane=args.slo, profiler=args.profile,
+        request_obs=args.request_obs,
         progress=progress)
     report = harness.run()
     print(json.dumps(report.to_dict(), indent=2))

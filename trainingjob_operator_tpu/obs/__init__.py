@@ -11,6 +11,8 @@ singletons mirror ``utils.metrics.METRICS``:
   registry into bounded rings (docs/SLO.md);
 - ``SLOS``      -- multi-window burn-rate SLO engine over the tsdb;
 - ``PROFILER``  -- sampling stack profiler with span attribution;
+- ``REQTRACE``  -- per-request lifecycle ledger with TTFT/TPOT attribution
+  and a dropped-request audit (docs/SERVING.md);
 - structured logging is stateless (``get_logger`` binds context per call).
 
 See docs/OBSERVABILITY.md for the span/metric/event catalogs.
@@ -53,6 +55,11 @@ from trainingjob_operator_tpu.obs.slo import (
     default_slos,
 )
 from trainingjob_operator_tpu.obs.profiler import PROFILER, SpanProfiler
+from trainingjob_operator_tpu.obs.reqtrace import (
+    REQTRACE,
+    REQUEST_OUTCOMES,
+    RequestLedger,
+)
 
 __all__ = [
     "GOODPUT",
@@ -87,4 +94,7 @@ __all__ = [
     "default_slos",
     "PROFILER",
     "SpanProfiler",
+    "REQTRACE",
+    "REQUEST_OUTCOMES",
+    "RequestLedger",
 ]

@@ -52,6 +52,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.obs.reqtrace import REQTRACE
 from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
 
 #: Attribution order.  Control-plane phases (detect/teardown/reschedule/
@@ -276,6 +277,21 @@ def _union_ms(windows: Tuple[Tuple[str, float, float], ...]) -> float:
     return total * 1e3
 
 
+def _freeze_requests(snap: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    """Request-window snapshot (obs/reqtrace.py ``window``) -> hashable
+    sorted tuple, so the frozen ``inputs`` stay reassembly-exact."""
+    out: List[Tuple[str, Any]] = []
+    for k, v in sorted(snap.items()):
+        if isinstance(v, dict):
+            v = tuple(sorted(v.items()))
+        out.append((k, v))
+    return tuple(out)
+
+
+def _thaw_requests(frozen: Tuple[Tuple[str, Any], ...]) -> Dict[str, Any]:
+    return {k: (dict(v) if isinstance(v, tuple) else v) for k, v in frozen}
+
+
 def _assemble(inc: Dict[str, Any],
               events: Tuple[Tuple[float, str, str], ...],
               steps: Tuple[Tuple[float, int, float, Optional[float],
@@ -286,6 +302,7 @@ def _assemble(inc: Dict[str, Any],
               = (),
               chaos: Tuple[Tuple[str, float, float], ...] = (),
               slo: Tuple[str, ...] = (),
+              requests: Tuple[Tuple[str, Any], ...] = (),
               ) -> Dict[str, Any]:
     """Ring snapshot -> incident bundle.  Pure and deterministic: the same
     inputs serialize to the same bytes (``reassemble`` asserts this in
@@ -369,6 +386,12 @@ def _assemble(inc: Dict[str, Any],
         # when a breach was live, like "fallback" on resume entries --
         # happy-path bundles stay byte-identical to pre-SLO ones.
         out["slo_breaches"] = list(slo)
+    if requests:
+        # Request-plane attribution (obs/reqtrace.py): the requests whose
+        # lifecycle overlapped this window -- in-flight count, per-outcome
+        # split, orphans, worst TTFT.  Key present only when the request
+        # plane observed overlap, so plane-off bundles stay byte-identical.
+        out["requests"] = _thaw_requests(requests)
     return out
 
 
@@ -699,7 +722,9 @@ class IncidentRecorder:
                              if s <= ended and e >= t0))
         slo = tuple(sorted({n for (n, s, e) in self._slo
                             if s <= ended and (e is None or e >= t0)}))
-        inputs = (inc_dict, events, steps, resumes, rendezvous, chaos, slo)
+        requests = _freeze_requests(REQTRACE.window(job, t0, ended))
+        inputs = (inc_dict, events, steps, resumes, rendezvous, chaos, slo,
+                  requests)
         bundle = _assemble(*inputs)
         encoded = _canonical(bundle)
         if st.bundles and st.bundles[-1]["bundle"]["id"] == inc.id:

@@ -1,0 +1,446 @@
+"""Request-lifecycle plane: per-request spans + dropped-request audit.
+
+Job- and replica-granular planes (telemetry, incidents, the SLO engine)
+cannot answer the router-tier gate question "did any in-flight request
+silently die during that drain/restart?".  This module is the
+request-granular ledger that makes the question answerable:
+
+- every serving request carries a **monotonically-ordered id** within a
+  ``(job, epoch)`` stream (epoch = one service incarnation, so an id
+  reset after restart is a new stream, not a regression) and a bounded
+  record of per-phase wall attribution (``queued`` -> ``prefill`` ->
+  ``decode``), mirroring the incident recorder's downtime phases;
+- every wire record also carries ``submitted_hwm`` -- the highest id
+  *submitted* so far in its stream.  That is what makes the audit sound:
+  a replica that dies without flushing leaves ids that never produced a
+  terminal record, and terminal-record gap detection alone cannot see an
+  id that was never reported.  The high-water mark can.
+- ``reconcile()`` is the **dropped-request audit**: per stream, every id
+  in ``[contig+1, hwm]`` without a terminal record is filed as an
+  explicit ``orphaned`` record (never silently lost).  The fleet harness
+  harvests the count into ``FleetReport`` and files a nonzero count as
+  an invariant violation, exactly like ``unattributed_downtime_ms``.
+- retention is **tail-sampling**: the slowest ``ring`` requests per job
+  keep their full span (``/debug/requests?id=``, ``?format=chrome``);
+  the rest are dropped with an audible
+  ``trainingjob_reqtrace_sampled_dropped_total`` counter -- never
+  silent truncation.  A separate bounded recent window answers incident
+  overlap queries (the ``requests`` bundle stanza) and percentiles.
+
+The plane is strictly no-op unless ``start()`` ran (the PR 17 contract:
+plane-off runs are byte-identical in digests and phase counts).  Stdlib
+only; imports nothing above :mod:`utils.metrics` so the telemetry
+aggregator and the incident recorder can both reach the singleton
+without a cycle.  See docs/SERVING.md (request lifecycle) and
+docs/OBSERVABILITY.md (wire shape + metric rows).
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.utils.metrics import METRICS
+
+#: Terminal states a request can reach.  ``orphaned`` is never emitted by
+#: a live scheduler -- only ``reconcile()`` files it, which is what makes
+#: a nonzero count evidence of a dropped request rather than traffic.
+REQUEST_OUTCOMES = ("completed", "rejected", "evicted", "orphaned")
+
+#: Per-stream cap on *explicitly enumerated* orphan records; the counter
+#: carries the full count either way (bounded memory, audible total).
+_MAX_ORPHAN_RECORDS = 100
+
+#: Evictions/orphans bind to an incident that OPENS up to this many
+#: seconds after them.  A pod kill flushes its in-flight requests as
+#: ``evicted`` records synchronously, but the incident's ``started``
+#: stamp is the *controller's detection* -- under chaos a dropped watch
+#: stream delays that past the eviction, and a plain interval overlap
+#: would miss the failure's own footprint.
+_EVICTION_BIND_S = 10.0
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return max(floor, int(raw)) if raw else default
+    except ValueError:
+        return default
+
+
+class _Stream:
+    """Audit state for one ``(job, epoch)`` id stream.
+
+    ``contig`` is the contiguous-prefix watermark (every id <= contig has
+    a terminal record); ``sparse`` holds terminal ids above it and is
+    compacted TCP-SACK style; ``hwm`` is the highest id known to have
+    been *submitted* (terminal ids and ``submitted_hwm`` fields both
+    advance it).  Missing = ids in ``[contig+1, hwm]`` not in sparse.
+    """
+
+    __slots__ = ("contig", "sparse", "hwm")
+
+    def __init__(self) -> None:
+        self.contig = -1
+        self.sparse: set = set()
+        self.hwm = -1
+
+    def terminal(self, rid: int) -> None:
+        if rid <= self.contig or rid in self.sparse:
+            return  # duplicate terminal; first record wins
+        self.sparse.add(rid)
+        while (self.contig + 1) in self.sparse:
+            self.contig += 1
+            self.sparse.discard(self.contig)
+        self.hwm = max(self.hwm, rid)
+
+    def submitted(self, hwm: int) -> None:
+        self.hwm = max(self.hwm, hwm)
+
+    def missing(self) -> List[int]:
+        return [rid for rid in range(self.contig + 1, self.hwm + 1)
+                if rid not in self.sparse]
+
+
+class _JobState:
+    __slots__ = ("streams", "outcomes", "retained", "recent", "ttfts",
+                 "tpots", "seq", "dropped")
+
+    def __init__(self, window: int) -> None:
+        self.streams: Dict[str, _Stream] = {}
+        self.outcomes: Dict[str, int] = {}
+        #: Slowest-k min-heap of (score, seq, record) -- tail sampling.
+        self.retained: List[Tuple[float, int, Dict[str, Any]]] = []
+        #: Bounded recent window for overlap queries and percentiles.
+        self.recent: Deque[Dict[str, Any]] = deque(maxlen=window)
+        self.ttfts: Deque[float] = deque(maxlen=window)
+        self.tpots: Deque[float] = deque(maxlen=window)
+        self.seq = 0
+        self.dropped = 0
+
+
+def _score(rec: Dict[str, Any]) -> float:
+    """Slowness score for tail-sampling: total attributed wall, falling
+    back to TTFT when the record carries no phase breakdown."""
+    phases = rec.get("phase_ms") or {}
+    total = sum(v for v in phases.values() if isinstance(v, (int, float)))
+    if total > 0.0:
+        return float(total)
+    ttft = rec.get("ttft_ms")
+    return float(ttft) if isinstance(ttft, (int, float)) else 0.0
+
+
+def _pct(values: List[float], q: float) -> float:
+    ordered = sorted(values)
+    idx = min(int(q * len(ordered)), len(ordered) - 1)
+    return round(ordered[idx], 3)
+
+
+class RequestLedger:
+    """Bounded per-job request ledger with a monotonic-id audit.
+
+    Strictly no-op unless ``start()`` ran.  ``ring``/``window`` default
+    from TRAININGJOB_REQTRACE_RING / _WINDOW at ``reset()`` time so tests
+    and the harness can re-knob between in-process runs.
+    """
+
+    def __init__(self, ring: Optional[int] = None,
+                 window: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._started = False
+        self._ring_arg = ring
+        self._window_arg = window
+        self._ring = 0
+        self._window = 0
+        self._jobs: Dict[str, _JobState] = {}
+        self._apply_knobs()
+
+    def _apply_knobs(self) -> None:
+        self._ring = (self._ring_arg if self._ring_arg is not None
+                      else _env_int(constants.REQTRACE_RING_ENV, 64))
+        self._window = (self._window_arg if self._window_arg is not None
+                        else _env_int(constants.REQTRACE_WINDOW_ENV, 512))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            self._started = True
+
+    def stop(self) -> None:
+        """Stop accepting records; retained state stays readable (the
+        harness builds its report after stopping the plane)."""
+        with self._lock:
+            self._started = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._jobs = {}
+            self._apply_knobs()
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    # -- ingest ---------------------------------------------------------------
+
+    def record(self, job: str, rec: Dict[str, Any]) -> bool:
+        """One terminal-state record (validated upstream by the telemetry
+        aggregator).  Returns False when the plane is off."""
+        with self._lock:
+            if not self._started:
+                return False
+            st = self._jobs.get(job)
+            if st is None:
+                st = self._jobs[job] = _JobState(self._window)
+            self._record_locked(job, st, rec)
+            return True
+
+    def _record_locked(self, job: str, st: _JobState,
+                       rec: Dict[str, Any]) -> None:
+        epoch = str(rec.get("request_epoch", ""))
+        stream = st.streams.get(epoch)
+        if stream is None:
+            stream = st.streams[epoch] = _Stream()
+        rid = int(rec["request_id"])
+        if rid <= stream.contig or rid in stream.sparse:
+            return  # duplicate terminal for an already-settled id
+        stream.terminal(rid)
+        hwm = rec.get("submitted_hwm")
+        if isinstance(hwm, int):
+            stream.submitted(hwm)
+        outcome = rec["request_outcome"]
+        st.outcomes[outcome] = st.outcomes.get(outcome, 0) + 1
+        seq = st.seq
+        st.seq += 1
+        kept = dict(rec)
+        kept["seq"] = seq
+        st.recent.append(kept)
+        ttft = rec.get("ttft_ms")
+        if isinstance(ttft, (int, float)):
+            st.ttfts.append(float(ttft))
+        tpot = rec.get("tpot_ms")
+        if isinstance(tpot, (int, float)):
+            st.tpots.append(float(tpot))
+        # Tail-sampling: keep the slowest ``ring`` full spans; everything
+        # else is dropped AUDIBLY.
+        entry = (_score(kept), seq, kept)
+        if len(st.retained) < self._ring:
+            heapq.heappush(st.retained, entry)
+        else:
+            heapq.heappushpop(st.retained, entry)
+            st.dropped += 1
+            METRICS.inc("trainingjob_reqtrace_sampled_dropped_total",
+                        job=job)
+
+    # -- the audit ------------------------------------------------------------
+
+    def reconcile(self, now: float) -> int:
+        """File every submitted-but-never-terminal id as an explicit
+        ``orphaned`` record.  Idempotent: filed ids join their stream's
+        terminal set, so a second reconcile finds nothing new.  Returns
+        the number of orphans filed by THIS call."""
+        with self._lock:
+            if not self._started and not self._jobs:
+                return 0
+            filed = 0
+            for job, st in self._jobs.items():
+                for epoch, stream in st.streams.items():
+                    missing = stream.missing()
+                    for i, rid in enumerate(missing):
+                        stream.terminal(rid)
+                        st.outcomes["orphaned"] = (
+                            st.outcomes.get("orphaned", 0) + 1)
+                        METRICS.inc("trainingjob_requests_total",
+                                    job=job, outcome="orphaned")
+                        filed += 1
+                        if i >= _MAX_ORPHAN_RECORDS:
+                            continue  # counted above, not enumerated
+                        rec = {
+                            "request_outcome": "orphaned",
+                            "request_id": rid,
+                            "request_epoch": epoch,
+                            "ts": now,
+                            "seq": st.seq,
+                        }
+                        st.seq += 1
+                        st.recent.append(rec)
+                        heapq.heappush(
+                            st.retained, (float("inf"), rec["seq"], rec))
+                        while len(st.retained) > self._ring:
+                            heapq.heappop(st.retained)
+            return filed
+
+    # -- queries --------------------------------------------------------------
+
+    def jobs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def window(self, job: str, start: float, end: float) -> Dict[str, Any]:
+        """Requests whose [arrival, final] interval overlaps [start, end]
+        -- the incident ``requests`` stanza.  Empty dict when nothing
+        overlaps (absent stanza, not a zero-filled one)."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return {}
+            overlapping: List[Dict[str, Any]] = []
+            for rec in st.recent:
+                final = rec.get("ts")
+                if not isinstance(final, (int, float)):
+                    continue
+                arrival = rec.get("arrival")
+                if not isinstance(arrival, (int, float)):
+                    arrival = final  # orphans have no known arrival
+                # Failure-caused terminals land BEFORE the incident opens
+                # (detection latency); bind them within _EVICTION_BIND_S.
+                lead = (_EVICTION_BIND_S
+                        if rec.get("request_outcome") in ("evicted",
+                                                          "orphaned")
+                        else 0.0)
+                if arrival <= end and final >= start - lead:
+                    overlapping.append(rec)
+            if not overlapping:
+                return {}
+            by_outcome: Dict[str, int] = {}
+            worst_ttft = None
+            for rec in overlapping:
+                oc = rec.get("request_outcome", "unknown")
+                by_outcome[oc] = by_outcome.get(oc, 0) + 1
+                ttft = rec.get("ttft_ms")
+                if isinstance(ttft, (int, float)):
+                    if worst_ttft is None or ttft > worst_ttft:
+                        worst_ttft = float(ttft)
+            out: Dict[str, Any] = {
+                "in_flight": len(overlapping),
+                "outcomes": dict(sorted(by_outcome.items())),
+                "orphaned": by_outcome.get("orphaned", 0),
+            }
+            if worst_ttft is not None:
+                out["worst_ttft_ms"] = round(worst_ttft, 3)
+            return out
+
+    def ttft_percentiles(self, job: str
+                         ) -> Optional[Tuple[float, float]]:
+        """(p50, p99) TTFT ms, or None for a never-reporting job --
+        absence is not zero (the PR 8 convention)."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or not st.ttfts:
+                return None
+            vals = list(st.ttfts)
+            return _pct(vals, 0.50), _pct(vals, 0.99)
+
+    def tpot_percentiles(self, job: str
+                         ) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None or not st.tpots:
+                return None
+            vals = list(st.tpots)
+            return _pct(vals, 0.50), _pct(vals, 0.99)
+
+    def job_summary(self, job: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return None
+            return self._summary_locked(st)
+
+    def _summary_locked(self, st: _JobState) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "records_total": st.seq,
+            "outcomes": dict(sorted(st.outcomes.items())),
+            "orphaned": st.outcomes.get("orphaned", 0),
+            "streams": len(st.streams),
+            "retained": len(st.retained),
+            "sampled_dropped": st.dropped,
+            "open_ids": sum(len(s.missing()) for s in st.streams.values()),
+        }
+        if st.ttfts:
+            vals = list(st.ttfts)
+            out["ttft_ms_p50"] = _pct(vals, 0.50)
+            out["ttft_ms_p99"] = _pct(vals, 0.99)
+        if st.tpots:
+            vals = list(st.tpots)
+            out["tpot_ms_p50"] = _pct(vals, 0.50)
+            out["tpot_ms_p99"] = _pct(vals, 0.99)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Fleet-level rollup for ``FleetReport.requests``."""
+        with self._lock:
+            jobs = {job: self._summary_locked(st)
+                    for job, st in sorted(self._jobs.items())}
+            return {
+                "jobs_reporting": len(jobs),
+                "records_total": sum(j["records_total"]
+                                     for j in jobs.values()),
+                "orphaned_total": sum(j["orphaned"] for j in jobs.values()),
+                "sampled_dropped_total": sum(j["sampled_dropped"]
+                                             for j in jobs.values()),
+                "by_job": jobs,
+            }
+
+    def retained_list(self, job: str) -> Optional[List[Dict[str, Any]]]:
+        """Retained spans (slowest-k plus orphans) seq-ascending, each with
+        its ledger ``seq`` -- the /debug/requests?id= handle -- merged in.
+        None for a job the ledger has never seen."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return None
+            out: List[Dict[str, Any]] = []
+            for _, s, rec in sorted(st.retained, key=lambda t: t[1]):
+                d = dict(rec)
+                d["seq"] = s
+                out.append(d)
+            return out
+
+    def request(self, job: str, seq: int) -> Optional[Dict[str, Any]]:
+        """Full retained span by ledger seq, or None (sampled away or
+        never existed -- the endpoint 404s either way)."""
+        with self._lock:
+            st = self._jobs.get(job)
+            if st is None:
+                return None
+            for _, s, rec in st.retained:
+                if s == seq:
+                    return dict(rec)
+            return None
+
+    def export_chrome(self, job: str, seq: int
+                      ) -> Optional[Dict[str, Any]]:
+        """One retained request as a chrome://tracing / Perfetto trace:
+        consecutive ``ph:"X"`` complete events, one per lifecycle phase,
+        on a (job, request) track.  ts/dur are microseconds."""
+        rec = self.request(job, seq)
+        if rec is None:
+            return None
+        base_us = float(rec.get("arrival", rec.get("ts", 0.0))) * 1e6
+        events: List[Dict[str, Any]] = []
+        cursor = base_us
+        for phase, ms in (rec.get("phase_ms") or {}).items():
+            if not isinstance(ms, (int, float)) or ms < 0.0:
+                continue
+            events.append({
+                "name": phase,
+                "ph": "X",
+                "ts": round(cursor, 3),
+                "dur": round(float(ms) * 1000.0, 3),
+                "pid": job,
+                "tid": f"request-{rec.get('request_id', seq)}",
+                "args": {"outcome": rec.get("request_outcome"),
+                         "epoch": rec.get("request_epoch")},
+            })
+            cursor += float(ms) * 1000.0
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: Process-global request ledger, mirroring METRICS / INCIDENTS / TSDB.
+REQTRACE = RequestLedger()

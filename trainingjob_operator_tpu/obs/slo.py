@@ -126,6 +126,14 @@ def default_slos() -> Tuple[SLOSpec, ...]:
             series_prefix="trainingjob_serve_token_latency_ms",
             reduce="max", op="<=",
             threshold=_env_float(constants.SLO_SERVE_P99_MS_ENV, 2000.0)),
+        SLOSpec(
+            name="ttft_p99",
+            objective="request plane time-to-first-token: p99 under the "
+                      "threshold across serving jobs",
+            series_prefix="trainingjob_request_ttft_ms",
+            series_suffix="_p99",
+            reduce="max", op="<=",
+            threshold=_env_float(constants.SLO_TTFT_P99_MS_ENV, 2000.0)),
     )
 
 

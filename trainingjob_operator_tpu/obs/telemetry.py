@@ -50,6 +50,11 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.obs.goodput import GOODPUT, GoodputTracker
 from trainingjob_operator_tpu.obs.incident import INCIDENTS, IncidentRecorder
+from trainingjob_operator_tpu.obs.reqtrace import (
+    REQTRACE,
+    REQUEST_OUTCOMES,
+    RequestLedger,
+)
 from trainingjob_operator_tpu.utils.metrics import METRICS, MetricsRegistry
 
 #: Step-time histogram bucket upper bounds (milliseconds): sim steps run
@@ -63,6 +68,17 @@ STEP_TIME_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
 #: serialization setup, hundreds of ms to tens of seconds at 100B scale.
 CKPT_STALL_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                         1000.0, 5000.0, 30000.0)
+
+#: Time-to-first-token histogram bucket upper bounds (milliseconds): sim
+#: synthesis scripts tens of ms, CPU-test decode runs hundreds, a cold
+#: queue under load reaches seconds.
+REQUEST_TTFT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                           500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+#: Per-output-token decode gap buckets (milliseconds): steady-state TPOT
+#: sits well under TTFT -- one batched step per token.
+REQUEST_TPOT_BUCKETS_MS = (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                           250.0, 1000.0)
 
 #: Peak dense bf16 FLOP/s per chip by accelerator-type substring, first
 #: match wins ("v5-lite" before "v5" would matter if a bare "v5" entry
@@ -201,13 +217,18 @@ class TelemetryAggregator:
                  goodput: Optional[GoodputTracker] = None,
                  stall_factor: float = 8.0, stall_floor: float = 2.0,
                  window: int = 128,
-                 incidents: Optional[IncidentRecorder] = None):
+                 incidents: Optional[IncidentRecorder] = None,
+                 reqtrace: Optional[RequestLedger] = None):
         self._metrics = metrics or METRICS
         self._goodput = goodput or GOODPUT
         # Deliberately NOT defaulted to the INCIDENTS singleton: private
         # test aggregators must not pollute the process-global flight
         # recorder.  The TELEMETRY singleton below passes it explicitly.
         self._incidents = incidents
+        # Same contract for the request ledger (obs/reqtrace.py): only the
+        # singleton feeds REQTRACE; the metrics above are observed either
+        # way (the ledger no-ops unless its plane was started).
+        self._reqtrace = reqtrace
         self.stall_factor = stall_factor
         self.stall_floor = stall_floor
         self.window = window
@@ -317,6 +338,59 @@ class TelemetryAggregator:
                 jt.serve = snap
                 if first:
                     self._register_serve_gauges_locked(job, jt)
+            return True
+        if isinstance(record, dict) and "request_outcome" in record:
+            # Request terminal-state record (workloads/serve.py
+            # emit_request, docs/SERVING.md): one per request reaching a
+            # terminal outcome, carrying the per-phase wall breakdown and
+            # the stream's submitted high-water mark for the dropped-
+            # request audit.  No step/ms fields -- detect it BEFORE step
+            # validation, like the serve snapshot.
+            try:
+                job = str(record["job"])
+                outcome = str(record["request_outcome"])
+                rid = int(record["request_id"])
+                epoch = str(record["request_epoch"])
+                hwm = int(record.get("submitted_hwm", rid))
+                tokens = int(record.get("tokens", 0))
+                raw = record.get("phase_ms") or {}
+                phase_ms = {str(p): float(v) for p, v in raw.items()}
+            except (TypeError, KeyError, ValueError, AttributeError):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            ttft = _as_float(record.get("ttft_ms"))
+            tpot = _as_float(record.get("tpot_ms"))
+            arrival = _as_float(record.get("arrival"))
+            if ("/" not in job or outcome not in REQUEST_OUTCOMES
+                    or rid < 0 or not epoch or hwm < rid or tokens < 0
+                    or (ttft is not None and ttft < 0.0)
+                    or (tpot is not None and tpot < 0.0)
+                    or any(v < 0.0 for v in phase_ms.values())):
+                self._metrics.inc("trainingjob_telemetry_malformed_total")
+                return False
+            self._metrics.inc("trainingjob_requests_total",
+                              job=job, outcome=outcome)
+            if ttft is not None:
+                self._metrics.observe("trainingjob_request_ttft_ms", ttft,
+                                      buckets=REQUEST_TTFT_BUCKETS_MS,
+                                      job=job)
+            if tpot is not None:
+                self._metrics.observe("trainingjob_request_tpot_ms", tpot,
+                                      buckets=REQUEST_TPOT_BUCKETS_MS,
+                                      job=job)
+            if self._reqtrace is not None:
+                self._reqtrace.record(job, {
+                    "request_outcome": outcome,
+                    "request_id": rid,
+                    "request_epoch": epoch,
+                    "submitted_hwm": hwm,
+                    "ttft_ms": ttft,
+                    "tpot_ms": tpot,
+                    "tokens": tokens,
+                    "arrival": arrival if arrival is not None else now,
+                    "phase_ms": phase_ms,
+                    "ts": now,
+                })
             return True
         try:
             job = str(record["job"])
@@ -734,8 +808,8 @@ def _has_gauge(jt: _JobTelemetry, name: str, **labels: str) -> bool:
 
 
 #: Process-global aggregator, mirroring METRICS/TRACER/GOODPUT.  Only the
-#: singleton feeds the global incident flight recorder.
-TELEMETRY = TelemetryAggregator(incidents=INCIDENTS)
+#: singleton feeds the global incident flight recorder and request ledger.
+TELEMETRY = TelemetryAggregator(incidents=INCIDENTS, reqtrace=REQTRACE)
 
 
 # -- sink (controller side) ---------------------------------------------------
@@ -939,6 +1013,37 @@ class TelemetryEmitter:
             "serve_tokens_per_sec": round(tokens_per_sec, 2),
             "serve_completed": completed, "ts": time.time(),
         })
+
+    def emit_request(self, outcome: str, request_id: int, epoch: str,
+                     submitted_hwm: int, *, ttft_ms: Optional[float] = None,
+                     tpot_ms: Optional[float] = None, tokens: int = 0,
+                     arrival: Optional[float] = None,
+                     phase_ms: Optional[Dict[str, float]] = None) -> None:
+        """One request reached a terminal state (completed / rejected /
+        evicted): push its lifecycle record for the request ledger
+        (obs/reqtrace.py).  ``submitted_hwm`` -- the highest id submitted
+        so far in this service incarnation's stream -- is what makes the
+        dropped-request audit sound: ids above the last terminal record
+        are visible to ``reconcile()`` even if this process dies before
+        flushing them."""
+        if not self.enabled or time.monotonic() < self._down_until:
+            return
+        record: Dict[str, Any] = {
+            "v": 1, "job": self.job, "rtype": self.rtype, "rank": self.rank,
+            "request_outcome": outcome, "request_id": request_id,
+            "request_epoch": epoch, "submitted_hwm": submitted_hwm,
+            "tokens": tokens, "ts": time.time(),
+        }
+        if ttft_ms is not None:
+            record["ttft_ms"] = round(ttft_ms, 3)
+        if tpot_ms is not None:
+            record["tpot_ms"] = round(tpot_ms, 3)
+        if arrival is not None:
+            record["arrival"] = arrival
+        if phase_ms:
+            record["phase_ms"] = {p: round(v, 3)
+                                  for p, v in phase_ms.items()}
+        self._send(record)
 
     def _send(self, record: Dict[str, Any]) -> None:
         data = (json.dumps(record, sort_keys=True) + "\n").encode()

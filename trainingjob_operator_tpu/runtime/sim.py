@@ -39,7 +39,7 @@ import json
 import logging
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
@@ -114,6 +114,18 @@ SERVE_SLOTS_ANNOTATION = "sim.tpu.trainingjob.dev/serve-slots"
 SERVE_ACTIVE_ANNOTATION = "sim.tpu.trainingjob.dev/serve-active-slots"
 SERVE_P99_ANNOTATION = "sim.tpu.trainingjob.dev/serve-p99-ms"
 SERVE_TPS_ANNOTATION = "sim.tpu.trainingjob.dev/serve-tokens-per-sec"
+#: Request-lifecycle synthesis (obs/reqtrace.py): a Running pod with
+#: req-rate set "serves requests", opening req-rate new request ids per
+#: kubelet tick and completing the previous tick's batch with TTFT/TPOT
+#: from the annotations.  Every record carries the pod's submitted
+#: high-water mark, so a pod killed mid-flight leaves a gap the ledger's
+#: reconcile() must file as ``orphaned`` -- unless the sim flushes the
+#: open batch as explicit ``evicted`` records on every death path, which
+#: is exactly the audit the request-obs smoke pins (zero orphans through
+#: scale-in drain and exit-137 restarts).
+REQ_RATE_ANNOTATION = "sim.tpu.trainingjob.dev/req-rate"
+REQ_TTFT_ANNOTATION = "sim.tpu.trainingjob.dev/req-ttft-ms"
+REQ_TPOT_ANNOTATION = "sim.tpu.trainingjob.dev/req-tpot-ms"
 
 #: Step records synthesized per pod per tick/step-event batch, at most (a
 #: pod "catching up" after a long scheduler pause must not flood the
@@ -154,6 +166,10 @@ class _PodRuntime:
     frozen_exit_at: Optional[float] = None  # exit deadline saved across a flap
     steps_reported: int = 0
     generation_reported: int = 0  # newest rendezvous generation synthesized
+    req_next: int = 0  # next request id this pod will open
+    # (id, opened_at) batch in flight; completed next tick or flushed as
+    # evicted on the pod's death paths.
+    req_open: List[Tuple[int, float]] = field(default_factory=list)
 
 
 class SimRuntime(PodStateRuntime):
@@ -295,6 +311,9 @@ class SimRuntime(PodStateRuntime):
                 self._pods_cache.pop(key, None)
                 self._active_cache.pop(key, None)
                 self._account_pod_locked(key, None)
+                # Force-deletes skip the grace flush: file any still-open
+                # request batch as evicted before the state is dropped.
+                self._flush_requests(pod, self._state.get(key), time.time())
                 if self._kernel == "event":
                     self._state.pop(key, None)
                     self._pending.discard(key)
@@ -508,6 +527,24 @@ class SimRuntime(PodStateRuntime):
                 if self._kernel == "event":
                     self._arm_now_locked(f"{namespace}/{name}", "exit")
 
+    def flush_open_requests(self) -> int:
+        """Drain boundary: evict every still-open synthesized request batch
+        (the shutdown analogue of a serve drain), so the audit ledger can
+        reconcile submitted vs terminal ids with no in-flight residue.
+        Returns how many requests were flushed."""
+        now = time.time()
+        with self._lock:
+            entries = [(self._pods_cache.get(key), rt)
+                       for key, rt in self._state.items() if rt.req_open]
+        flushed = 0
+        for pod, rt in entries:
+            if pod is None:
+                rt.req_open = []
+                continue
+            flushed += len(rt.req_open)
+            self._flush_requests(pod, rt, now)
+        return flushed
+
     # -- the discrete-event kernel --------------------------------------------
 
     def _arm(self, key: str, kind: str, deadline: float) -> None:
@@ -586,7 +623,8 @@ class SimRuntime(PodStateRuntime):
             if rt.will_exit_at is not None:
                 self._arm(key, "exit", rt.will_exit_at)
             self._arm_step_locked(key, pod, rt)
-            if (pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION)
+            if ((pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION)
+                 or pod.metadata.annotations.get(REQ_RATE_ANNOTATION))
                     and not self._timers.armed(key, "serve")):
                 self._arm(key, "serve", now + self._tick)
 
@@ -776,6 +814,7 @@ class SimRuntime(PodStateRuntime):
                     rt = self._state.get(key)
                     if rt is not None:
                         rt.will_exit_at = None
+                self._flush_requests(pod, rt, now)
             else:
                 self._arm(key, "exit", now + self._tick)  # conflict: retry
 
@@ -795,6 +834,7 @@ class SimRuntime(PodStateRuntime):
                 self._arm(key, "grace", now + remaining)
                 return
             namespace, _, name = key.partition("/")
+        self._flush_requests(pod, rt, now)
         self._cs.tracker.finalize_delete(Pod.KIND, namespace, name)
         self._drop_state(namespace, name)
         self._timers.cancel_all(key)
@@ -828,7 +868,10 @@ class SimRuntime(PodStateRuntime):
                     or not self._node_ready_locked(pod)):
                 return
         self._synthesize_serve(pod, now)
-        if pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION):
+        if rt is not None:
+            self._synthesize_requests(pod, rt, now)
+        if (pod.metadata.annotations.get(SERVE_QUEUE_ANNOTATION)
+                or pod.metadata.annotations.get(REQ_RATE_ANNOTATION)):
             nxt = deadline + self._tick
             self._arm(key, "serve", nxt if nxt > now else now + self._tick)
 
@@ -872,6 +915,7 @@ class SimRuntime(PodStateRuntime):
                     # the GC's deletion-timestamp expiry sweep (30s).
                     rt.terminating_since = now
                 elif now - rt.terminating_since >= self._termination_grace:
+                    self._flush_requests(pod, rt, now)
                     self._cs.tracker.finalize_delete(Pod.KIND, pod.namespace, pod.name)
                     self._drop_state(pod.namespace, pod.name)
                 continue
@@ -911,6 +955,7 @@ class SimRuntime(PodStateRuntime):
                 self._synthesize_rendezvous(pod, rt, now)
                 self._synthesize_steps(pod, rt, now)
                 self._synthesize_serve(pod, now)
+                self._synthesize_requests(pod, rt, now)
 
             if (pod.status.phase == PodPhase.RUNNING
                     and rt.will_exit_at is not None and now >= rt.will_exit_at):
@@ -933,6 +978,7 @@ class SimRuntime(PodStateRuntime):
                         # Only clear after a successful write -- a conflict
                         # retries against a fresh snapshot next tick.
                         rt.will_exit_at = None
+                        self._flush_requests(pod, rt, now)
 
         # The kubelet tick doubles as the step-progress watchdog tick, same
         # as the localproc runtime: a stalled pod above is still Running.
@@ -1125,6 +1171,81 @@ class SimRuntime(PodStateRuntime):
             "serve_p99_ms": p99, "serve_tokens_per_sec": tps,
             "serve_completed": 0, "ts": now,
         }, now=now)
+
+    def _synthesize_requests(self, pod: Pod, rt: _PodRuntime,
+                             now: float) -> None:
+        """Open ``req-rate`` new request ids for this tick and complete the
+        previous tick's batch with TTFT/TPOT from the annotations -- the
+        records a real workloads/serve.py DecodeService emits.  Every
+        record carries the pod's submitted high-water mark, so the batch
+        still open when the pod dies is exactly the gap reconcile() would
+        file as ``orphaned`` -- unless a death path flushes it first
+        (``_flush_requests``)."""
+        ann = pod.metadata.annotations
+        rate_raw = ann.get(REQ_RATE_ANNOTATION)
+        if not rate_raw:
+            return
+        try:
+            rate = int(rate_raw)
+            ttft = float(ann.get(REQ_TTFT_ANNOTATION, "80"))
+            tpot = float(ann.get(REQ_TPOT_ANNOTATION, "10"))
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+        except ValueError:
+            return  # malformed script annotations: no telemetry
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL, "")
+        if not job_name or rate <= 0:
+            return
+        job = f"{pod.namespace}/{job_name}"
+        rtype = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL, "serve")
+        done, rt.req_open = rt.req_open, [(rt.req_next + i, now)
+                                          for i in range(rate)]
+        rt.req_next += rate
+        hwm = rt.req_next - 1
+        tokens = 8
+        for rid, t0 in done:
+            TELEMETRY.ingest({
+                "v": 1, "job": job, "rtype": rtype, "rank": rank,
+                "request_outcome": "completed", "request_id": rid,
+                "request_epoch": rt.uid, "submitted_hwm": hwm,
+                "tokens": tokens, "ttft_ms": ttft, "tpot_ms": tpot,
+                "arrival": t0,
+                "phase_ms": {"queued": round(ttft * 0.25, 3),
+                             "prefill": round(ttft * 0.75, 3),
+                             "decode": round(tpot * (tokens - 1), 3)},
+                "ts": now,
+            }, now=now)
+
+    def _flush_requests(self, pod: Optional[Pod], rt: Optional[_PodRuntime],
+                        now: float) -> None:
+        """Terminal flush for a dying pod: every still-open request id is
+        reported ``evicted`` (attribution: all queued wall) so the audit
+        ledger finds no id gap.  Idempotent -- the batch empties on first
+        flush -- and called from every death path: exit, graceful-delete
+        expiry, the scan kernel's finalize/exit branches, and DELETED."""
+        if pod is None or rt is None or not rt.req_open:
+            return
+        job_name = pod.metadata.labels.get(constants.JOB_NAME_LABEL, "")
+        done, rt.req_open = rt.req_open, []
+        if not job_name:
+            return
+        job = f"{pod.namespace}/{job_name}"
+        rtype = pod.metadata.labels.get(constants.REPLICA_NAME_LABEL, "serve")
+        try:
+            rank = int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, "0") or "0")
+        except ValueError:
+            rank = 0
+        hwm = rt.req_next - 1
+        for rid, t0 in done:
+            TELEMETRY.ingest({
+                "v": 1, "job": job, "rtype": rtype, "rank": rank,
+                "request_outcome": "evicted", "request_id": rid,
+                "request_epoch": rt.uid, "submitted_hwm": hwm,
+                "tokens": 0, "arrival": t0,
+                "phase_ms": {"queued": round(max(0.0, now - t0) * 1000.0, 3)},
+                "ts": now,
+            }, now=now)
 
     def _schedule_gang(self, gang_pods, nodes, pod_count, tpu_used) -> None:
         placements = []

@@ -274,15 +274,18 @@ def _render_steps(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     return 200, "application/json", json.dumps(table, indent=2)
 
 
-def _render_serve(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+def _render_serve(telemetry, params: Dict[str, List[str]],
+                  reqtrace=None) -> Tuple[int, str, str]:
     """(status, content-type, body) for /debug/serve: the latest
     serving-plane snapshot (queue depth, batch occupancy, token-latency
     percentiles, tokens/s) for ?job=<namespace/name>, or the list of jobs
-    that have ever served when no job is given.  Unknown / never-served
-    job -> 404; unknown ?format -> explicit 400."""
+    that have ever served when no job is given.  With the request plane
+    wired the snapshot gains TTFT/TPOT percentile columns -- None (JSON)
+    or ``-`` (text) for a job the ledger has never seen, never a fake
+    zero.  Unknown / never-served job -> 404; unknown ?format -> 400."""
     fmt = params.get("format", [""])[0]
-    if fmt not in ("", "json"):
-        return 400, "text/plain", f"unknown format {fmt!r}; use json\n"
+    if fmt not in ("", "json", "text"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or text\n"
     job = params.get("job", [""])[0]
     if not job:
         jobs = [j for j in telemetry.jobs()
@@ -295,6 +298,19 @@ def _render_serve(telemetry, params: Dict[str, List[str]]) -> Tuple[int, str, st
     slots = snap.get("slots") or 0.0
     snap["occupancy"] = (round(snap.get("active_slots", 0.0) / slots, 3)
                          if slots else 0.0)
+    ttft = reqtrace.ttft_percentiles(job) if reqtrace is not None else None
+    tpot = reqtrace.tpot_percentiles(job) if reqtrace is not None else None
+    snap["ttft_ms_p50"] = round(ttft[0], 3) if ttft else None
+    snap["ttft_ms_p99"] = round(ttft[1], 3) if ttft else None
+    snap["tpot_ms_p50"] = round(tpot[0], 3) if tpot else None
+    snap["tpot_ms_p99"] = round(tpot[1], 3) if tpot else None
+    if fmt == "text":
+        width = max(len(k) for k in snap)
+        lines = [f"serve: {job}"]
+        for k in sorted(snap):
+            v = snap[k]
+            lines.append(f"  {k:<{width}}  {'-' if v is None else v}")
+        return 200, "text/plain", "\n".join(lines) + "\n"
     return 200, "application/json", json.dumps(
         {"job": job, "serve": snap}, indent=2)
 
@@ -334,6 +350,54 @@ def _render_incidents(incidents,
         {"job": job, "count": len(bundles),
          "open": incidents.open_incident(job),
          "incidents": bundles}, indent=2)
+
+
+def _render_requests(reqtrace,
+                     params: Dict[str, List[str]]) -> Tuple[int, str, str]:
+    """(status, content-type, body) for /debug/requests: the request
+    lifecycle ledger (obs/reqtrace.py).  No ?job= -> fleet summary; with
+    one, the job summary plus its retained spans.  ?id=<ledger seq> ->
+    that span (?format=chrome -> Perfetto/chrome://tracing trace_event
+    JSON; without ?id= chrome exports the newest retained span).  Unknown
+    job or sampled-away id -> 404; a non-integer ?id= or unknown ?format
+    -> explicit 400 -- a typo'd knob must not get a 200 with the wrong
+    answer on it."""
+    fmt = params.get("format", [""])[0]
+    if fmt not in ("", "json", "chrome"):
+        return 400, "text/plain", f"unknown format {fmt!r}; use json or chrome\n"
+    id_raw = params.get("id", [""])[0]
+    if id_raw and not id_raw.isdigit():
+        return (400, "text/plain",
+                f"bad id {id_raw!r}; use the integer seq from the job listing\n")
+    job = params.get("job", [""])[0]
+    if not job:
+        return 200, "application/json", json.dumps(reqtrace.summary(),
+                                                   indent=2)
+    spans = reqtrace.retained_list(job)
+    if spans is None:
+        return 404, "text/plain", ""
+    if id_raw:
+        seq = int(id_raw)
+        if fmt == "chrome":
+            trace = reqtrace.export_chrome(job, seq)
+            if trace is None:
+                return 404, "text/plain", ""
+            return 200, "application/json", json.dumps(trace, indent=2)
+        rec = reqtrace.request(job, seq)
+        if rec is None:
+            return 404, "text/plain", ""
+        return 200, "application/json", json.dumps(
+            {"job": job, "seq": seq, "request": rec}, indent=2)
+    if fmt == "chrome":
+        if not spans:
+            return 404, "text/plain", ""
+        trace = reqtrace.export_chrome(job, spans[-1]["seq"])
+        if trace is None:
+            return 404, "text/plain", ""
+        return 200, "application/json", json.dumps(trace, indent=2)
+    return 200, "application/json", json.dumps(
+        {"job": job, "summary": reqtrace.job_summary(job),
+         "retained": spans}, indent=2)
 
 
 def _render_timeseries(tsdb, params: Dict[str, List[str]]) -> Tuple[int, str, str]:
@@ -387,12 +451,12 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                   host: str = "127.0.0.1", tracer=None, events_fn=None,
                   ready_fn: Optional[Callable[[], bool]] = None,
                   telemetry=None, incidents=None, tsdb=None, slos=None,
-                  profiler=None):
+                  profiler=None, reqtrace=None):
     """Serve /metrics (Prometheus text), /metrics.json, /healthz, /readyz,
     /debug (route index), /debug/threads, /debug/traces, /debug/events,
-    /debug/steps, /debug/serve, /debug/incidents, /debug/timeseries,
-    /debug/slo and /debug/profile on a daemon thread; ``.shutdown()``
-    stops it and closes the socket.
+    /debug/steps, /debug/serve, /debug/incidents, /debug/requests,
+    /debug/timeseries, /debug/slo and /debug/profile on a daemon thread;
+    ``.shutdown()`` stops it and closes the socket.
 
     - ``tracer``: an obs.trace.Tracer; enables /debug/traces (404 without).
     - ``events_fn``: zero-arg callable returning Event objects (e.g.
@@ -403,6 +467,8 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
       /debug/steps and /debug/serve (404 without).
     - ``incidents``: an obs.incident.IncidentRecorder; enables
       /debug/incidents (404 without).
+    - ``reqtrace``: an obs.reqtrace.RequestLedger; enables /debug/requests
+      and the TTFT/TPOT columns on /debug/serve (404 / None without).
     - ``tsdb``: an obs.tsdb.TimeSeriesStore; enables /debug/timeseries.
     - ``slos``: an obs.slo.SLOEngine; enables /debug/slo.
     - ``profiler``: an obs.profiler.SpanProfiler; enables /debug/profile.
@@ -441,6 +507,8 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
          telemetry is not None),
         ("/debug/incidents", "incident bundles; ?job=, ?id=N, ?format=chrome",
          incidents is not None),
+        ("/debug/requests", "request lifecycle ledger; ?job=, ?id=N, ?format=chrome",
+         reqtrace is not None),
         ("/debug/timeseries", "in-process tsdb rings; ?series=, ?format=sparkline",
          tsdb is not None),
         ("/debug/slo", "SLO burn rates + breach verdicts",
@@ -484,11 +552,16 @@ def serve_metrics(port: int, registry: Optional[MetricsRegistry] = None,
                 if status == 404:
                     body = None
             elif path == "/debug/serve" and telemetry is not None:
-                status, ctype, body = _render_serve(telemetry, params)
+                status, ctype, body = _render_serve(telemetry, params,
+                                                    reqtrace)
                 if status == 404:
                     body = None
             elif path == "/debug/incidents" and incidents is not None:
                 status, ctype, body = _render_incidents(incidents, params)
+                if status == 404:
+                    body = None
+            elif path == "/debug/requests" and reqtrace is not None:
+                status, ctype, body = _render_requests(reqtrace, params)
                 if status == 404:
                     body = None
             elif path == "/debug/timeseries" and tsdb is not None:

@@ -51,6 +51,7 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from trainingjob_operator_tpu.api import constants
+from trainingjob_operator_tpu.utils.metrics import METRICS
 
 #: Slot states.  FREE slots ride the batched step as masked junk rows
 #: (static shapes); PREFILL slots consume one prompt chunk per tick;
@@ -64,6 +65,12 @@ class QueueFull(Exception):
     service never buffers unboundedly toward OOM."""
 
 
+#: Cap on per-request phase-transition entries: enough for admission,
+#: every prefill chunk of a max-length prompt at default chunking, first
+#: token, and the terminal edge; a ring (oldest dropped) past that.
+PHASE_LOG_CAP = 64
+
+
 @dataclass
 class Request:
     rid: int
@@ -75,10 +82,46 @@ class Request:
     finished: float = 0.0
     slot: int = -1
     tokens: List[int] = field(default_factory=list)
+    #: Bounded ring of (phase, wall-time) lifecycle transitions:
+    #: enqueued -> admitted -> prefill_chunk* -> first_token -> terminal.
+    phase_log: Deque[Tuple[str, float]] = field(
+        default_factory=lambda: deque(maxlen=PHASE_LOG_CAP))
+
+    def mark(self, phase: str, now: float) -> None:
+        self.phase_log.append((phase, now))
 
     @property
     def ttft_ms(self) -> float:
         return max(self.first_token_at - self.arrival, 0.0) * 1000.0
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean inter-token decode gap, ms; None before the second token
+        (absence is not zero)."""
+        if not self.finished or not self.first_token_at \
+                or len(self.tokens) < 2:
+            return None
+        span = max(self.finished - self.first_token_at, 0.0) * 1000.0
+        return span / (len(self.tokens) - 1)
+
+    def phase_attribution(self, now: float) -> Dict[str, float]:
+        """Per-phase wall ms for the lifecycle so far -- the request-level
+        analogue of the incident recorder's downtime phases.  Only phases
+        the request actually entered appear (no zero-filled keys)."""
+        out: Dict[str, float] = {}
+        if self.admitted:
+            out["queued"] = max(self.admitted - self.arrival, 0.0) * 1000.0
+            if self.first_token_at:
+                out["prefill"] = max(
+                    self.first_token_at - self.admitted, 0.0) * 1000.0
+                end = self.finished or now
+                out["decode"] = max(
+                    end - self.first_token_at, 0.0) * 1000.0
+            else:
+                out["prefill"] = max(now - self.admitted, 0.0) * 1000.0
+        elif self.arrival:
+            out["queued"] = max(now - self.arrival, 0.0) * 1000.0
+        return out
 
 
 class _Slot:
@@ -156,6 +199,14 @@ class DecodeService:
 
         self.queue: Deque[Request] = deque()
         self._next_rid = 0
+        #: Request-id stream identity (obs/reqtrace.py): ids are monotonic
+        #: per (job, epoch), and a restarted replica starts a NEW epoch,
+        #: so its id reset can never masquerade as the old stream's gap.
+        self.epoch = f"{os.getpid()}-{id(self):x}"
+        #: Job label for the request plane's counters; the emitter knows
+        #: the real ns/name identity when running under the operator.
+        self.job_label = (emitter.job if emitter is not None
+                          and getattr(emitter, "job", "") else "local/serve")
         self._prefill_rr = 0   # round-robin cursor over PREFILL slots
         self.step_count = 0
         self.completed_total = 0
@@ -199,14 +250,22 @@ class DecodeService:
                 f"max_len {self.max_len}")
         if max_new_tokens < 1 or not prompt:
             raise ValueError("need a non-empty prompt and max_new >= 1")
+        now = time.time() if now is None else now
+        # Ids are assigned BEFORE the capacity check: a rejected request
+        # still consumes one and files a terminal record, so every id in
+        # the stream has exactly one outcome and the audit ledger's gap
+        # detection never mistakes backpressure for a dropped request.
+        req = Request(rid=self._next_rid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, arrival=now)
+        self._next_rid += 1
+        req.mark("enqueued", now)
         if len(self.queue) >= self.queue_cap:
             self.rejected_total += 1
+            METRICS.inc("trainingjob_serve_rejected_total",
+                        job=self.job_label, reason="QueueFull")
+            self._emit_request(req, "rejected", now)
             raise QueueFull(
                 f"queue at capacity {self.queue_cap}; retry or shed")
-        req = Request(rid=self._next_rid, prompt=list(prompt),
-                      max_new_tokens=max_new_tokens,
-                      arrival=time.time() if now is None else now)
-        self._next_rid += 1
         self.queue.append(req)
         return req
 
@@ -256,6 +315,7 @@ class DecodeService:
             sl.prefill_pos = 0
             req.admitted = now
             req.slot = idx
+            req.mark("admitted", now)
 
     def _prefill_one(self, now: float) -> None:
         """Advance at most ONE slot by one prompt chunk per tick: prefill
@@ -278,6 +338,7 @@ class DecodeService:
                 self.params, self.cache, jnp.asarray(chunk, jnp.int32),
                 idx, sl.prefill_pos)
             sl.prefill_pos += valid
+            req.mark("prefill_chunk", now)
             if sl.prefill_pos >= len(req.prompt):
                 # Prompt fully cached: the last VALID chunk offset's logit
                 # is the prompt's next-token distribution.
@@ -291,6 +352,7 @@ class DecodeService:
                 sl.t = len(req.prompt)
                 sl.pending = first
                 req.first_token_at = now
+                req.mark("first_token", now)
                 self._emit_token(sl, first, now)
             self._prefill_rr = (idx + 1) % n
             return
@@ -333,14 +395,14 @@ class DecodeService:
                 # Completed during this tick's prefill phase (single-token
                 # request): the batched step already ran with its row, but
                 # nothing reads its output.
-                done.append(self._release(sl))
+                done.append(self._release(sl, now))
                 continue
             sl.t += 1
             nxt = int(picks[i])
             sl.pending = nxt
             self._emit_token(sl, nxt, now)
             if sl.req.finished:
-                done.append(self._release(sl))
+                done.append(self._release(sl, now))
         return done
 
     def _emit_token(self, sl: _Slot, tok: int, now: float) -> None:
@@ -358,7 +420,7 @@ class DecodeService:
                 or len(req.prompt) + len(req.tokens) >= self.max_len):
             req.finished = now
 
-    def _release(self, sl: _Slot) -> Request:
+    def _release(self, sl: _Slot, now: float) -> Request:
         """Free the slot; the NEXT tick's admission pass may re-page it.
         The K/V rows are left as-is here -- admission's ``reset_slot`` is
         the paging point, so a slot freed and never reused costs nothing."""
@@ -366,7 +428,44 @@ class DecodeService:
         sl.state = FREE
         sl.req = None
         self.completed_total += 1
+        req.mark("completed", now)
+        self._emit_request(req, "completed", now)
         return req
+
+    # -- request-lifecycle plane (obs/reqtrace.py) ----------------------------
+
+    def _emit_request(self, req: Request, outcome: str, now: float) -> None:
+        """Push one terminal-state record over the telemetry wire.  Every
+        record carries ``submitted_hwm`` (the highest id this incarnation
+        handed out) so the audit ledger can see ids this process never
+        got to flush."""
+        if self.emitter is None:
+            return
+        self.emitter.emit_request(
+            outcome, req.rid, self.epoch, self._next_rid - 1,
+            ttft_ms=req.ttft_ms if req.first_token_at else None,
+            tpot_ms=req.tpot_ms, tokens=len(req.tokens),
+            arrival=req.arrival, phase_ms=req.phase_attribution(now))
+
+    def drain_abort(self, now: Optional[float] = None) -> List[Request]:
+        """Abandon all in-flight work at a drain/scale-in/restart boundary:
+        every queued or slotted request files an explicit ``evicted``
+        terminal record (never silently lost -- the audit contract), the
+        slots are freed, and the evicted requests are returned so a router
+        tier could retry them elsewhere."""
+        now = time.time() if now is None else now
+        evicted: List[Request] = []
+        while self.queue:
+            evicted.append(self.queue.popleft())
+        for sl in self.slots:
+            if sl.state != FREE and sl.req is not None:
+                evicted.append(sl.req)
+                sl.state = FREE
+                sl.req = None
+        for req in evicted:
+            req.mark("evicted", now)
+            self._emit_request(req, "evicted", now)
+        return evicted
 
     # -- introspection --------------------------------------------------------
 
