@@ -5,7 +5,7 @@
 PY ?= python
 VDEV ?= 8
 
-.PHONY: lint lint-diff lint-sarif shard-state-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke
+.PHONY: lint lint-diff lint-sarif shard-state-report thread-model-report test test-slow dryrun bench install ci trace-demo telemetry-demo incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke
 
 # AST-based operator lint (docs/STATIC_ANALYSIS.md): runs before the tests
 # so a grammar/race/contract bug fails fast with a file:line annotation
@@ -37,6 +37,15 @@ lint-sarif:
 shard-state-report:
 	$(PY) -m tools.analyze --report shard-state > shard_state.json
 	@echo "wrote shard_state.json"
+
+# Machine-readable concurrency model (TJA028-TJA032): thread roles and
+# closures, the may-happen-in-parallel matrix, and per-singleton access
+# evidence (site, via, roles, lock-set) -- the thread-model companion to
+# the shard-state inventory.  Fails when any of the five concurrency
+# passes has unwaived findings.
+thread-model-report:
+	$(PY) -m tools.analyze --report thread-model > thread_model.json
+	@echo "wrote thread_model.json"
 
 # Fast suite: the 10k-job fleet run (tests/test_fleet.py) hides behind the
 # slow marker; `make test-slow` opts in.
@@ -155,4 +164,4 @@ request-obs-smoke:
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-ci: lint lint-sarif shard-state-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke
+ci: lint lint-sarif shard-state-report thread-model-report test dryrun incident-demo fleet-smoke chaos-smoke node-chaos-smoke recovery-smoke elastic-smoke serve-smoke resize-smoke slo-smoke request-obs-smoke

@@ -1681,7 +1681,7 @@ class TestRunnerMachinery:
         b = Finding("TJA004", "broad-except", "m.py", 9, 0, "warning", "same")
         assert len(fingerprint_all([a, b])) == 2
 
-    def test_all_twenty_seven_checks_registered(self):
+    def test_all_thirty_two_checks_registered(self):
         runner._load_checks()
         assert {cid for cid, _fn in runner.REGISTRY.values()} == {
             "TJA001", "TJA002", "TJA003", "TJA004", "TJA005", "TJA006",
@@ -1689,8 +1689,9 @@ class TestRunnerMachinery:
         assert {cid for cid, _fn in runner.PROJECT_REGISTRY.values()} == {
             "TJA010", "TJA011", "TJA012", "TJA013", "TJA014", "TJA016",
             "TJA017", "TJA020", "TJA021", "TJA022", "TJA023", "TJA024",
-            "TJA025", "TJA026", "TJA027"}
-        assert len(runner.all_checks()) == 27
+            "TJA025", "TJA026", "TJA027", "TJA028", "TJA029", "TJA030",
+            "TJA031", "TJA032"}
+        assert len(runner.all_checks()) == 32
 
     def test_every_check_has_rule_help(self):
         """SARIF rule metadata coverage: every registered ID ships a
@@ -2563,12 +2564,13 @@ class TestShardStateReport:
             names.add(s["name"])
         # The singletons ROADMAP item 3 must split are all inventoried.
         assert {"obs.incident.INCIDENTS", "obs.goodput.GOODPUT",
-                "obs.telemetry.TELEMETRY", "utils.events._seq"} <= names
-        # Exactly one declared shard-hostile write pattern today: the
-        # global event-sequence counter.
+                "obs.telemetry.TELEMETRY", "utils.events.EVENT_SEQ"} <= names
+        # The last shard-hostile entry -- the bare event-sequence counter
+        # -- was retired for the lock-guarded EventSeq (epoch, shard,
+        # seq) API; the registry declares no hostile state any more.
         hostile = [s["name"] for s in doc["singletons"]
                    if s["classification"] == "shard_hostile"]
-        assert hostile == ["utils.events._seq"]
+        assert hostile == []
 
     def test_report_exits_nonzero_on_unclassified_state(self, tmp_path):
         """The CI gate: new module-level mutable state without a registry
@@ -2590,3 +2592,492 @@ class TestShardStateReport:
         doc = json.loads(proc.stdout)
         assert doc["unclassified"] == ["obs.rogue.ROGUE"]
         assert "1 unclassified" in proc.stderr
+
+
+# -- TJA028-TJA032 thread-model concurrency passes ---------------------------
+
+#: The five passes built on the thread-model layer, by check name.
+CONCURRENCY = ["unguarded-shared-state", "check-then-act",
+               "wait-predicate-discipline", "shutdown-ordering",
+               "shard-boundary-discipline"]
+
+#: Minimal registry so shard-state-backed passes see a declared tree.
+BASE_REGISTRY = (
+    "SHARD_STATE_REGISTRY = {\n"
+    '    "api.constants.SHARD_STATE_REGISTRY": "constant",\n'
+)
+
+
+def registry(entries=""):
+    return BASE_REGISTRY + entries + "}\n"
+
+
+class TestUnguardedSharedState:
+    """TJA028: MHP roles touching shared state with disjoint lock-sets."""
+
+    def _tree(self, work_body):
+        return {
+            f"{PKG}/api/constants.py": registry(
+                '    "obs.stream.EVENTS": "lock_guarded_shared",\n'),
+            f"{PKG}/obs/stream.py": (
+                "import threading\n"
+                "\n"
+                "EVENTS = {}\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "class Pool:\n"
+                "    def __init__(self):\n"
+                "        self._workers = []\n"
+                "\n"
+                "    def start(self, n):\n"
+                "        for _ in range(n):\n"
+                "            th = threading.Thread(target=self._work,\n"
+                "                                  daemon=True)\n"
+                "            th.start()\n"
+                "            self._workers.append(th)\n"
+                "\n"
+                "    def _work(self):\n" + work_body),
+        }
+
+    def test_fires_on_unlocked_write_from_pool_role(self, tmp_path):
+        fs = self._tree('        EVENTS["tick"] = 1\n')
+        found = analyze_tree(tmp_path, fs, only=["unguarded-shared-state"])
+        assert ids(found) == ["TJA028"]
+        msg = found[0].message
+        assert "obs.stream.EVENTS" in msg
+        assert "may-happen-in-parallel" in msg
+        assert "spawned" in msg   # the witness names the spawn site
+
+    def test_quiet_when_both_sites_locked(self, tmp_path):
+        fs = self._tree(
+            '        with _lock:\n            EVENTS["tick"] = 1\n')
+        assert analyze_tree(tmp_path, fs,
+                            only=["unguarded-shared-state"]) == []
+
+    def test_fires_on_shared_instance_attr(self, tmp_path):
+        fs = {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/agg.py": (
+                "import threading\n"
+                "\n"
+                "class Agg:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._bins = {}\n"
+                "        self._workers = []\n"
+                "\n"
+                "    def start(self, n):\n"
+                "        for _ in range(n):\n"
+                "            th = threading.Thread(target=self._work,\n"
+                "                                  daemon=True)\n"
+                "            th.start()\n"
+                "            self._workers.append(th)\n"
+                "\n"
+                "    def _work(self):\n"
+                '        self._bins["x"] = 1\n'),
+        }
+        found = analyze_tree(tmp_path, fs, only=["unguarded-shared-state"])
+        assert ids(found) == ["TJA028"]
+        assert "instance attribute" in found[0].message
+        assert "._bins" in found[0].message
+
+    def test_waiver_on_the_line_suppresses(self, tmp_path):
+        fs = self._tree(
+            "        # analyzer: allow[unguarded-shared-state] "
+            "GIL-atomic tick, last-writer-wins by design\n"
+            '        EVENTS["tick"] = 1\n')
+        assert analyze_tree(tmp_path, fs,
+                            only=["unguarded-shared-state"]) == []
+
+
+class TestCheckThenAct:
+    """TJA029: test-then-mutate on MHP-shared state with no spanning lock."""
+
+    def _tree(self, ensure_body):
+        return {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/pending.py": (
+                "import threading\n"
+                "\n"
+                "PENDING = {}\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "class Filler:\n"
+                "    def __init__(self):\n"
+                "        self._workers = []\n"
+                "\n"
+                "    def start(self, n):\n"
+                "        for _ in range(n):\n"
+                "            th = threading.Thread(target=self._fill,\n"
+                "                                  daemon=True)\n"
+                "            th.start()\n"
+                "            self._workers.append(th)\n"
+                "\n"
+                "    def _fill(self):\n"
+                '        ensure("job")\n'
+                "\n"
+                "def ensure(key):\n" + ensure_body),
+        }
+
+    def test_fires_on_unspanned_conditional(self, tmp_path):
+        fs = self._tree(
+            "    if key not in PENDING:\n"
+            "        PENDING[key] = object()\n")
+        found = analyze_tree(tmp_path, fs, only=["check-then-act"])
+        assert ids(found) == ["TJA029"]
+        assert "check-then-act race" in found[0].message
+        assert "obs.pending.PENDING" in found[0].message
+
+    def test_quiet_when_lock_spans_the_conditional(self, tmp_path):
+        fs = self._tree(
+            "    with _lock:\n"
+            "        if key not in PENDING:\n"
+            "            PENDING[key] = object()\n")
+        assert analyze_tree(tmp_path, fs, only=["check-then-act"]) == []
+
+
+class TestWaitDiscipline:
+    """TJA030: Condition.wait in a predicate loop; bounded Event.wait."""
+
+    def _cond_tree(self, take_body):
+        return {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/chan.py": (
+                "import threading\n"
+                "\n"
+                "class Chan:\n"
+                "    def __init__(self):\n"
+                "        self._cond = threading.Condition()\n"
+                "        self._items = []\n"
+                "\n"
+                "    def take(self):\n"
+                "        with self._cond:\n" + take_body),
+        }
+
+    def test_fires_on_if_guarded_condition_wait(self, tmp_path):
+        fs = self._cond_tree(
+            "            if not self._items:\n"
+            "                self._cond.wait()\n"
+            "            return self._items.pop()\n")
+        found = analyze_tree(tmp_path, fs,
+                             only=["wait-predicate-discipline"])
+        assert ids(found) == ["TJA030"]
+        assert found[0].severity == "error"
+        assert "predicate loop" in found[0].message
+
+    def test_quiet_on_while_guarded_condition_wait(self, tmp_path):
+        fs = self._cond_tree(
+            "            while not self._items:\n"
+            "                self._cond.wait()\n"
+            "            return self._items.pop()\n")
+        assert analyze_tree(tmp_path, fs,
+                            only=["wait-predicate-discipline"]) == []
+
+    def _event_tree(self, wait_call):
+        return {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/runner.py": (
+                "import threading\n"
+                "\n"
+                "class Runner:\n"
+                "    def __init__(self):\n"
+                "        self._go = threading.Event()\n"
+                "        self._stop = threading.Event()\n"
+                "        self._thread = None\n"
+                "\n"
+                "    def start(self):\n"
+                "        self._thread = threading.Thread(target=self._loop,\n"
+                "                                        daemon=True)\n"
+                "        self._thread.start()\n"
+                "\n"
+                "    def _loop(self):\n"
+                "        while not self._stop.is_set():\n"
+                f"            {wait_call}\n"
+                "\n"
+                "    def stop(self):\n"
+                "        self._stop.set()\n"
+                "        self._thread.join(timeout=2.0)\n"),
+        }
+
+    def test_warns_on_unbounded_event_wait_in_stoppable_role(self, tmp_path):
+        fs = self._event_tree("self._go.wait()")
+        found = analyze_tree(tmp_path, fs,
+                             only=["wait-predicate-discipline"])
+        assert ids(found) == ["TJA030"]
+        assert found[0].severity == "warning"
+        assert "Event.wait() without a timeout" in found[0].message
+
+    def test_quiet_on_bounded_event_wait(self, tmp_path):
+        fs = self._event_tree("self._go.wait(0.5)")
+        assert analyze_tree(tmp_path, fs,
+                            only=["wait-predicate-discipline"]) == []
+
+
+class TestShutdownOrdering:
+    """TJA031: retained threads joined by stop, never under a shared lock."""
+
+    def _tree(self, stop_body):
+        return {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/looper.py": (
+                "import threading\n"
+                "\n"
+                "class Looper:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._stop = threading.Event()\n"
+                "        self._thread = None\n"
+                "\n"
+                "    def start(self):\n"
+                "        self._thread = threading.Thread(target=self._loop,\n"
+                "                                        daemon=True)\n"
+                "        self._thread.start()\n"
+                "\n"
+                "    def _loop(self):\n"
+                "        while not self._stop.wait(0.5):\n"
+                "            with self._lock:\n"
+                "                pass\n"
+                "\n"
+                "    def stop(self):\n" + stop_body),
+        }
+
+    def test_warns_when_no_stop_path_joins(self, tmp_path):
+        fs = self._tree("        self._stop.set()\n")
+        found = analyze_tree(tmp_path, fs, only=["shutdown-ordering"])
+        assert ids(found) == ["TJA031"]
+        assert found[0].severity == "warning"
+        assert "no stop path" in found[0].message
+        assert "self._thread" in found[0].message
+
+    def test_quiet_when_stop_joins(self, tmp_path):
+        fs = self._tree(
+            "        self._stop.set()\n"
+            "        self._thread.join(timeout=2.0)\n")
+        assert analyze_tree(tmp_path, fs, only=["shutdown-ordering"]) == []
+
+    def test_quiet_when_stop_joins_via_local_alias(self, tmp_path):
+        """The obs-plane idiom: ``th = self._thread; th.join(...)``."""
+        fs = self._tree(
+            "        self._stop.set()\n"
+            "        th = self._thread\n"
+            "        if th is not None:\n"
+            "            th.join(timeout=2.0)\n")
+        assert analyze_tree(tmp_path, fs, only=["shutdown-ordering"]) == []
+
+    def test_errors_on_join_under_shared_lock(self, tmp_path):
+        fs = self._tree(
+            "        self._stop.set()\n"
+            "        with self._lock:\n"
+            "            self._thread.join(timeout=2.0)\n")
+        found = analyze_tree(tmp_path, fs, only=["shutdown-ordering"])
+        assert ids(found) == ["TJA031"]
+        assert found[0].severity == "error"
+        assert "while holding" in found[0].message
+
+
+class TestShardBoundaryDiscipline:
+    """TJA032: registry classifications hold against the thread model."""
+
+    def _tree(self, put_body, classification="lock_guarded_shared"):
+        return {
+            f"{PKG}/api/constants.py": registry(
+                f'    "obs.state.CACHE": "{classification}",\n'),
+            f"{PKG}/obs/state.py": (
+                "import threading\n"
+                "\n"
+                "CACHE = {}\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "def put(k, v):\n" + put_body),
+        }
+
+    def test_fires_on_unlocked_write_to_lock_guarded(self, tmp_path):
+        fs = self._tree("    CACHE[k] = v\n")
+        found = analyze_tree(tmp_path, fs,
+                             only=["shard-boundary-discipline"])
+        assert ids(found) == ["TJA032"]
+        assert "declared lock_guarded_shared" in found[0].message
+
+    def test_quiet_when_write_is_locked(self, tmp_path):
+        fs = self._tree("    with _lock:\n        CACHE[k] = v\n")
+        assert analyze_tree(tmp_path, fs,
+                            only=["shard-boundary-discipline"]) == []
+
+    def test_fires_on_undeclared_global_rebind_in_role(self, tmp_path):
+        fs = {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/flip.py": (
+                "import threading\n"
+                "\n"
+                "MODES = {}\n"
+                "\n"
+                "class Flipper:\n"
+                "    def __init__(self):\n"
+                "        self._workers = []\n"
+                "\n"
+                "    def start(self, n):\n"
+                "        for _ in range(n):\n"
+                "            th = threading.Thread(target=self._work,\n"
+                "                                  daemon=True)\n"
+                "            th.start()\n"
+                "            self._workers.append(th)\n"
+                "\n"
+                "    def _work(self):\n"
+                "        reset()\n"
+                "\n"
+                "def reset():\n"
+                "    global MODES\n"
+                "    MODES = {}\n"),
+        }
+        found = analyze_tree(tmp_path, fs,
+                             only=["shard-boundary-discipline"])
+        assert ids(found) == ["TJA032"]
+        assert "`global MODES` rebind" in found[0].message
+        assert "not classified" in found[0].message
+
+
+class TestThreadModelLayer:
+    """The model itself: built once per run, serves every pass."""
+
+    def test_model_built_once_across_all_five_passes(self, tmp_path):
+        from tools.analyze import threadmodel as tmod
+        for rel, src in {
+            f"{PKG}/api/constants.py": registry(),
+            f"{PKG}/obs/w.py": (
+                "import threading\n\n"
+                "D = {}\n\n"
+                "def go():\n"
+                "    th = threading.Thread(target=work, daemon=True)\n"
+                "    th.start()\n\n"
+                "def work():\n"
+                "    D['k'] = 1\n"),
+        }.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(src)
+        before = tmod.BUILD_COUNT
+        run_checks([str(tmp_path)], root=str(tmp_path), only=CONCURRENCY)
+        assert tmod.BUILD_COUNT - before == 1
+
+    def test_lock_deletion_trips_tja028_and_tja032(self, tmp_path):
+        """End-to-end proof on the *real* event-sequencer source: delete
+        the lock acquisitions and the tree stops being certifiable --
+        TJA032 (the lock_guarded claim breaks) plus TJA028 (the pool-role
+        write races itself)."""
+        events_src = open(
+            os.path.join(REPO_ROOT, PKG, "utils", "events.py")).read()
+        stream = """\
+import threading
+
+EVENTS = {}
+_lock = threading.Lock()
+
+class Pool:
+    def __init__(self):
+        self._workers = []
+
+    def start(self, n):
+        for _ in range(n):
+            th = threading.Thread(target=self._work, daemon=True)
+            th.start()
+            self._workers.append(th)
+
+    def _work(self):
+        with _lock:
+            EVENTS["tick"] = 1
+"""
+        reg = registry(
+            '    "utils.events.EVENT_SEQ": "lock_guarded_shared",\n'
+            '    "obs.stream.EVENTS": "lock_guarded_shared",\n')
+        tree = {
+            f"{PKG}/api/constants.py": reg,
+            f"{PKG}/utils/events.py": events_src,
+            f"{PKG}/obs/stream.py": stream,
+        }
+        for rel, src in tree.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(src)
+        clean = run_checks([str(tmp_path)], root=str(tmp_path),
+                           only=CONCURRENCY)
+        assert clean == [], [f.message for f in clean]
+
+        # Delete every lock acquisition while keeping the AST shape.
+        broken_events = events_src.replace("with self._lock:", "if True:") \
+                                  .replace("with self._created_lock:",
+                                           "if True:")
+        broken_stream = stream.replace("with _lock:", "if True:")
+        (tmp_path / PKG / "utils" / "events.py").write_text(broken_events)
+        (tmp_path / PKG / "obs" / "stream.py").write_text(broken_stream)
+        found = run_checks([str(tmp_path)], root=str(tmp_path),
+                           only=CONCURRENCY)
+        assert {"TJA028", "TJA032"} <= set(ids(found)), \
+            [f"{f.check_id} {f.message}" for f in found]
+
+
+class TestThreadModelReport:
+    """``--report thread-model``: the CI artifact next to shard_state.json."""
+
+    def test_real_tree_report_schema_and_clean_exit(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze",
+             "--report", "thread-model"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert set(doc) == {"version", "generated_by", "package", "roles",
+                            "mhp", "singletons", "violations"}
+        assert doc["version"] == 1
+        assert doc["package"] == PKG
+        # All five concurrency passes are clean (waivers documented in
+        # docs/STATIC_ANALYSIS.md).
+        assert doc["violations"] == {"TJA028": 0, "TJA029": 0, "TJA030": 0,
+                                     "TJA031": 0, "TJA032": 0}
+        names = [r["name"] for r in doc["roles"]]
+        assert "main" in names
+        assert any(n.startswith("_worker@controller.controller:")
+                   for n in names)
+        assert any(n.startswith("_pump_waiting@client.workqueue:")
+                   for n in names)
+        for r in doc["roles"]:
+            assert {"name", "kind", "spawn", "target", "entries", "daemon",
+                    "multi", "domain", "owner", "owner_class", "thread_attr",
+                    "closure_size", "closure"} <= set(r)
+            assert r["closure_size"] == len(r["closure"])
+        # The worker pool is multi-instance: it must MHP with itself.
+        worker = next(n for n in names
+                      if n.startswith("_worker@controller.controller:"))
+        assert worker in doc["mhp"][worker]
+        # MHP is symmetric.
+        for a, partners in doc["mhp"].items():
+            for b in partners:
+                assert a in doc["mhp"][b], (a, b)
+        # Per-singleton access evidence carries roles + lock-sets.
+        by_name = {s["name"]: s for s in doc["singletons"]}
+        seq = by_name["utils.events.EVENT_SEQ"]
+        assert seq["classification"] == "lock_guarded_shared"
+        for site in seq["evidence"]:
+            assert {"path", "line", "via", "write", "roles",
+                    "locks"} == set(site)
+
+    def test_report_exits_nonzero_on_broken_claim(self, tmp_path):
+        for rel, src in {
+            f"{PKG}/api/constants.py": registry(
+                '    "obs.state.CACHE": "lock_guarded_shared",\n'),
+            f"{PKG}/obs/state.py": (
+                "CACHE = {}\n\n"
+                "def put(k, v):\n"
+                "    CACHE[k] = v\n"),
+        }.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(src)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", PKG,
+             "--report", "thread-model"],
+            cwd=tmp_path, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["violations"]["TJA032"] >= 1
+        assert "unwaived concurrency violation" in proc.stderr

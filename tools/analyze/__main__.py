@@ -53,6 +53,36 @@ def _shard_state_report(paths, root) -> int:
     return 0 if ok else 1
 
 
+def _thread_model_report(paths, root) -> int:
+    """``--report thread-model``: build the project context and print the
+    concurrency model JSON (docs/STATIC_ANALYSIS.md documents the
+    schema): thread roles and closures, the MHP matrix, per-singleton
+    access evidence (site, via, roles, lock-set), and unwaived counts
+    for TJA028-TJA032.  Exit 0 only when all five counts are zero."""
+    import json
+
+    from tools.analyze.checks import shard_boundary
+    from tools.analyze.project import ProjectContext
+
+    contexts = {}
+    for abs_path in runner.iter_py_files(paths, root):
+        ctx = runner.make_context(abs_path, root)
+        contexts[ctx.path] = ctx
+    pc = ProjectContext.build(root, contexts)
+    doc, ok = shard_boundary.report(pc)
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    viol = sum(doc["violations"].values())
+    print(f"{len(doc['roles'])} role(s), {len(doc['singletons'])} "
+          f"singleton(s), {viol} unwaived concurrency violation(s)",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _spawns_threads(src: str) -> bool:
+    """Cheap text gate: does this source (old or new) spawn a thread?"""
+    return "Thread(" in src or "ThreadPool" in src
+
+
 def _git_changed_files(root: str, ref: str) -> set:
     """Repo-relative .py files that differ from ``ref`` (committed diff,
     staged, unstaged, and untracked)."""
@@ -112,12 +142,18 @@ def main(argv=None) -> int:
                          "a change to api/constants.py widens project "
                          "passes back to the full tree, since registry "
                          "edits land findings in unchanged files)")
-    ap.add_argument("--report", choices=("shard-state",), default=None,
+    ap.add_argument("--report", choices=("shard-state", "thread-model"),
+                    default=None,
                     help="emit a machine-readable inventory instead of "
                          "findings: 'shard-state' prints the TJA027 "
                          "module-level mutable-singleton inventory as "
                          "JSON and exits nonzero when it is not clean "
-                         "(unclassified/stale/constant-mutated)")
+                         "(unclassified/stale/constant-mutated); "
+                         "'thread-model' prints the whole-program "
+                         "concurrency model (roles, closures, MHP "
+                         "matrix, per-singleton access evidence) and "
+                         "exits nonzero when any of TJA028-TJA032 has "
+                         "unwaived findings")
     ap.add_argument("--max-seconds", type=float, default=None, metavar="S",
                     help="fail (exit 1) when the analysis itself takes longer "
                          "than S wall-clock seconds -- a CI budget proving "
@@ -129,6 +165,14 @@ def main(argv=None) -> int:
                          "from .analyze-cache.json")
     args = ap.parse_args(argv)
 
+    # Run-once batch process over millions of short-lived AST nodes: the
+    # collector's gen-2 sweeps cost a few hundred ms of the --max-seconds
+    # budget and reclaim nothing the process exit won't.  Reference cycles
+    # (AST parent links, ProjectContext cross-references) just stay alive
+    # until exit.
+    import gc
+    gc.disable()
+
     if args.list_checks:
         for cid, name in sorted(runner.all_checks().items()):
             kind = "project" if name in runner.PROJECT_REGISTRY else "file"
@@ -139,8 +183,15 @@ def main(argv=None) -> int:
     paths = args.paths or DEFAULT_PATHS
     root = os.getcwd()
 
+    # Load the check registry before the --max-seconds clock starts: the
+    # budget gates the *analysis*, and the 32 check-module imports are fixed
+    # interpreter startup, not per-tree work.
+    runner._load_checks()
+
     if args.report == "shard-state":
         return _shard_state_report(paths, root)
+    if args.report == "thread-model":
+        return _thread_model_report(paths, root)
 
     started = time.monotonic()
     report_only = None
@@ -168,6 +219,30 @@ def main(argv=None) -> int:
                   "incremental scoping, re-running project passes "
                   "tree-wide", file=sys.stderr)
             report_only = None
+        if report_only is not None:
+            # A Thread-spawn edit (added, removed, or moved) changes the
+            # thread model's roles and MHP relation, which parameterize
+            # TJA028-TJA032 findings in *unchanged* files -- same story
+            # as a registry edit.  Check both sides of the diff so
+            # deleting a spawn also widens.
+            for rel in sorted(report_only):
+                try:
+                    with open(os.path.join(root, rel), "r",
+                              encoding="utf-8", errors="replace") as fh:
+                        new_src = fh.read()
+                except OSError:
+                    new_src = ""
+                show = subprocess.run(
+                    ["git", "show", f"{args.changed_since}:{rel}"],
+                    cwd=root, capture_output=True, text=True)
+                old_src = show.stdout if show.returncode == 0 else ""
+                if _spawns_threads(new_src) or _spawns_threads(old_src):
+                    print(f"{rel} changed and spawns threads: thread-"
+                          "model edits invalidate incremental scoping, "
+                          "re-running project passes tree-wide",
+                          file=sys.stderr)
+                    report_only = None
+                    break
 
     # Plain full runs (the ``make lint`` shape) replay cached findings when
     # no analyzed file -- nor the analyzer itself -- changed since the last

@@ -1,0 +1,187 @@
+"""TJA029 check-then-act: racy test-then-mutate on MHP-shared state.
+
+The classic lost-update shape::
+
+    if key not in pending:        # thread A and thread B both pass
+        pending[key] = make()     # one of the two writes is silently lost
+
+is invisible to the lock passes when *neither* statement takes a lock,
+and invisible to TJA028 when every individual access is a GIL-atomic
+single op -- the race is the *gap between* the test and the act.  This
+pass flags an ``if`` whose test reads an MHP-shared object (a
+module-global bare container or a shared instance container attribute,
+sharedness established by the thread-model layer) and whose body
+mutates the same object, when **no lock region lexically spans the
+whole conditional** -- a lock around only the mutation does not close
+the gap, and correctly-locked code (``with lock: if k not in d: ...``)
+has a non-empty lock-set at the ``if`` and is skipped.
+
+Only conditionals inside a thread role's closure fire: module-level
+init code and unreached helpers prove nothing.  Benign last-writer-wins
+patterns (idempotent cache fills where both computed values are
+equivalent) carry waivers with that reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze import threadmodel
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import ClassInfo, ProjectContext, _self_attr
+from tools.analyze.runner import register_project
+from tools.analyze.threadmodel import ThreadModel, is_read_method
+
+CHECK_ID, CHECK_NAME = "TJA029", "check-then-act"
+
+#: Object tags: ("g", singleton key) | ("a", class qual, attr name).
+Obj = Tuple
+
+
+def _mhp_capable(tm: ThreadModel, roles: Set[str]) -> bool:
+    ordered = sorted(roles)
+    for i, a in enumerate(ordered):
+        for b in ordered[i:]:
+            if tm.mhp(a, b):
+                return True
+    return False
+
+
+def _shared_globals(pc: ProjectContext,
+                    tm: ThreadModel) -> Dict[Tuple[str, str], str]:
+    """(module, name) -> singleton key for bare-container globals whose
+    witnessed accesses span MHP-capable roles."""
+    from tools.analyze.checks import shard_state
+    inventory, _reg, _lines, _rl = shard_state.build(pc)
+    out: Dict[Tuple[str, str], str] = {}
+    for key, s in inventory.items():
+        if s.kind not in threadmodel.BARE_CONTAINER_KINDS:
+            continue
+        roles: Set[str] = set()
+        for p, ln, _via in s.writes + s.reads:
+            roles |= tm.roles_at(p, ln)
+        if _mhp_capable(tm, roles):
+            out[(s.module, s.name)] = key
+    return out
+
+
+def _shared_attrs(tm: ThreadModel) -> Set[Tuple[str, str]]:
+    out: Set[Tuple[str, str]] = set()
+    for (cls_qual, attr), accesses in tm.attr_accesses().items():
+        roles: Set[str] = set()
+        for a in accesses:
+            roles |= tm.roles_of(a.qual)
+        if _mhp_capable(tm, roles):
+            out.add((cls_qual, attr))
+    return out
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    tm = threadmodel.model(pc)
+    if not any(r.kind == "thread" for r in tm.roles.values()):
+        return []
+    shared_globals = _shared_globals(pc, tm)
+    shared_attrs = _shared_attrs(tm)
+    if not shared_globals and not shared_attrs:
+        return []
+    findings: List[Finding] = []
+
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or is_test_path(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        if mod is None:
+            continue
+        # Names in this module resolving to a shared global.
+        local: Dict[str, str] = {}
+        for (m, n), key in shared_globals.items():
+            if m == mod.name:
+                local[n] = key
+        for alias, target in mod.imports.items():
+            m, _, n = target.rpartition(".")
+            key = shared_globals.get((m, n))
+            if key is not None:
+                local[alias] = key
+        if not local and not shared_attrs:
+            continue
+        by_node = {id(ci.node): ci for ci in mod.classes.values()}
+        parents = ctx.parents
+
+        for if_node in ctx.by_type(ast.If):
+            if not tm.roles_at(rel, if_node.lineno):
+                continue   # not witnessed to run on any thread role
+            owner: Optional[ClassInfo] = None
+            anc = parents.get(id(if_node))
+            while anc is not None:
+                if isinstance(anc, ast.ClassDef):
+                    owner = by_node.get(id(anc))
+                    break
+                anc = parents.get(id(anc))
+
+            def obj_of(expr: ast.expr) -> Optional[Obj]:
+                if isinstance(expr, ast.Name):
+                    key = local.get(expr.id)
+                    return ("g", key) if key is not None else None
+                attr = _self_attr(expr)
+                if attr is not None and owner is not None:
+                    defining = tm._defining_class(owner, attr)
+                    if defining is not None \
+                            and (defining, attr) in shared_attrs:
+                        return ("a", defining, attr)
+                return None
+
+            tested: Set[Obj] = set()
+            for n in ast.walk(if_node.test):
+                obj = obj_of(n)
+                if obj is not None:
+                    tested.add(obj)
+            if not tested:
+                continue
+            if tm.lock_set(rel, if_node.lineno):
+                continue   # a lock region spans both the test and the act
+            mutated = _mutation_of(if_node.body, tested, obj_of)
+            if mutated is None:
+                continue
+            obj, via, line = mutated
+            what = (f"module-global {obj[1]!r}" if obj[0] == "g"
+                    else f"instance attribute {obj[1]}.{obj[2]}")
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, rel, if_node.lineno, 0, ERROR,
+                f"check-then-act race on {what}: the test here and the "
+                f"mutation at line {line} ({via}) are not spanned by a "
+                "common lock, so two threads can both pass the test and "
+                "double-apply the act; hold one lock across the whole "
+                "conditional"))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _mutation_of(stmts: List[ast.stmt], tested: Set[Obj],
+                 obj_of) -> Optional[Tuple[Obj, str, int]]:
+    """First mutation of a tested object inside ``stmts``."""
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                if isinstance(fn, ast.Attribute) \
+                        and not is_read_method(fn.attr):
+                    obj = obj_of(fn.value)
+                    if obj in tested:
+                        return obj, f"{fn.attr}()", n.lineno
+                elif isinstance(fn, ast.Name) and fn.id == "next" and n.args:
+                    obj = obj_of(n.args[0])
+                    if obj in tested:
+                        return obj, "next()", n.lineno
+            elif isinstance(n, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = n.targets if isinstance(n, (ast.Assign, ast.Delete))\
+                    else [n.target]
+                for t in targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        obj = obj_of(t) if _self_attr(t) is not None \
+                            else obj_of(t.value)
+                        if obj in tested:
+                            return obj, "store", n.lineno
+    return None

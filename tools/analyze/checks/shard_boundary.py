@@ -1,0 +1,271 @@
+"""TJA032 shard-boundary-discipline: hold the shard-state registry's
+claims against the thread model.
+
+TJA027 checks that ``SHARD_STATE_REGISTRY`` (api/constants.py) is
+*complete* -- every module-level mutable singleton is classified.  This
+pass checks that the classifications are *true*, now that the thread
+model knows which roles touch what under which locks:
+
+- ``lock_guarded_shared`` means "threads coordinate via a witnessed
+  lock".  A bare-container singleton accessed from inside a function
+  with **no lock held at the site** breaks the claim (import-time init
+  runs before any thread exists and is exempt).  A class-instance
+  singleton keeps the claim if the mutating call site either holds a
+  lock or goes through a method whose closure provably acquires one
+  (the usual ``TRACER.record()`` -> ``with self._lock`` shape).
+
+- ``shard_local`` means "each shard owns its keys' slice" -- which
+  presumes *within* a process the keyed accesses are coherent.  When
+  two may-happen-in-parallel roles both mutate the singleton and some
+  mutating site holds no lock, the per-key story needs a witness the
+  model cannot see; the definition gets an ERROR (genuinely per-thread
+  keyed maps carry a waiver naming the keying argument).
+
+- a ``global X`` **rebind** executed inside any spawned role must name
+  classified state: an undeclared process-global written from
+  concurrent code is exactly the drift the registry exists to stop.
+
+``python -m tools.analyze --report thread-model`` (and ``make
+thread-model-report`` in CI) emits the model itself -- roles, closures,
+the MHP matrix, and per-singleton access evidence (site, via, roles,
+lock-set) -- as ``thread_model.json``, the concurrency companion to the
+shard-state inventory.  The report exits nonzero if any of the five
+concurrency passes (TJA028-TJA032) has unwaived findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.analyze import threadmodel
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import ClassInfo, ProjectContext
+from tools.analyze.runner import register_project
+from tools.analyze.threadmodel import PKG, ThreadModel
+
+CHECK_ID, CHECK_NAME = "TJA032", "shard-boundary-discipline"
+REPORT_VERSION = 1
+
+
+def _method_may_lock(pc: ProjectContext, tm: ThreadModel, ci: ClassInfo,
+                     method: str) -> Optional[bool]:
+    """Does ``method`` on (any composite of) ``ci`` transitively acquire
+    a resolvable lock?  None when no summary for it exists anywhere (a
+    dynamic attribute the model cannot reason about)."""
+    found = False
+    for k in tm.resolver.composites(ci):
+        for c in pc.mro_classes(k):
+            s = c.summaries.get(method)
+            if s is None:
+                continue
+            found = True
+            for q in tm._closure((s.qual,)):
+                rec = tm._summaries.get(q)
+                if rec is None:
+                    continue
+                mod, cls, summary = rec
+                for name in summary.acquires:
+                    if tm.resolver.lock_id(mod, cls, name) is not None:
+                        return True
+    return False if found else None
+
+
+#: Lifecycle methods exempt from the lock_guarded evidence rule: start
+#: spawns the coordinating thread (nothing to race yet) and the stop
+#: family joins it (the join is itself the synchronization).
+_LIFECYCLE = frozenset(("start", "run")) | frozenset(
+    threadmodel.STOP_METHOD_NAMES)
+
+
+def _check_lock_guarded(pc: ProjectContext, tm: ThreadModel, key: str,
+                        s) -> List[Finding]:
+    out: List[Finding] = []
+    if s.kind in threadmodel.BARE_CONTAINER_KINDS:
+        for p, ln, via in sorted(s.writes + s.reads):
+            if not tm.owner_qual(p, ln):
+                continue   # import-time init happens-before any thread
+            if threadmodel.locked_by_convention(tm.owner_qual(p, ln)):
+                continue
+            if not tm.lock_set(p, ln):
+                out.append(Finding(
+                    CHECK_ID, CHECK_NAME, p, ln, 0, ERROR,
+                    f"{key!r} is declared lock_guarded_shared but this "
+                    f"access ({via}) holds no lock; take the module lock "
+                    "around it or reclassify the singleton"))
+        return out
+    ci = pc.resolve_class(s.module, s.kind)
+    for p, ln, via in sorted(s.writes):
+        if not tm.owner_qual(p, ln):
+            continue
+        if tm.lock_set(p, ln) \
+                or threadmodel.locked_by_convention(tm.owner_qual(p, ln)):
+            continue
+        method = via[:-2] if via.endswith("()") else None
+        if method is not None:
+            if method in _LIFECYCLE:
+                continue
+            if ci is not None:
+                locks = _method_may_lock(pc, tm, ci, method)
+                if locks is True or locks is None:
+                    continue
+        out.append(Finding(
+            CHECK_ID, CHECK_NAME, p, ln, 0, ERROR,
+            f"{key!r} is declared lock_guarded_shared but this write "
+            f"({via}) neither holds a lock at the site nor goes through "
+            f"a lock-acquiring method of {s.kind}; route the mutation "
+            "through the guarded API or reclassify"))
+    return out
+
+
+def _mhp_pair(tm: ThreadModel, roles) -> Optional[Tuple[str, str]]:
+    ordered = sorted(roles)
+    for i, a in enumerate(ordered):
+        for b in ordered[i:]:
+            if tm.mhp(a, b):
+                return a, b
+    return None
+
+
+def _check_shard_local(pc: ProjectContext, tm: ThreadModel, key: str,
+                       s) -> List[Finding]:
+    ci = pc.resolve_class(s.module, s.kind) \
+        if s.kind not in threadmodel.BARE_CONTAINER_KINDS else None
+    roles = set()
+    unlocked: List[Tuple[str, int, str]] = []
+    for p, ln, via in sorted(s.writes):
+        rs = tm.roles_at(p, ln)
+        roles |= rs
+        if not rs or tm.lock_set(p, ln) \
+                or threadmodel.locked_by_convention(tm.owner_qual(p, ln)):
+            continue
+        method = via[:-2] if via.endswith("()") else None
+        if method is not None and ci is not None:
+            locks = _method_may_lock(pc, tm, ci, method)
+            if locks is True or locks is None:
+                continue
+        unlocked.append((p, ln, via))
+    pair = _mhp_pair(tm, roles)
+    if pair is None or not unlocked:
+        return []
+    p, ln, via = unlocked[0]
+    a, b = pair
+    who = f"role {a} with itself (multi-instance)" if a == b \
+        else f"roles {a} and {b}"
+    return [Finding(
+        CHECK_ID, CHECK_NAME, s.path, s.line, 0, ERROR,
+        f"{key!r} is declared shard_local but is mutated from "
+        f"may-happen-in-parallel {who} with no lock at e.g. {p}:{ln} "
+        f"({via}); within one process the slices already interleave -- "
+        "guard it, key it per-thread (waive with the keying argument), "
+        "or reclassify")]
+
+
+def _check_globals(pc: ProjectContext, tm: ThreadModel,
+                   reg: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or is_test_path(rel):
+            continue
+        mod = pc.module_of_path(rel)
+        if mod is None:
+            continue
+        rel_mod = mod.name[len(PKG) + 1:] \
+            if mod.name.startswith(PKG + ".") else mod.name
+        for g in ctx.by_type(ast.Global):
+            roles = sorted(r for r in tm.roles_at(rel, g.lineno)
+                           if tm.roles[r].kind == "thread")
+            if not roles:
+                continue
+            for nm in g.names:
+                if nm in mod.module_locks:
+                    continue
+                key = f"{rel_mod}.{nm}"
+                if key in reg:
+                    continue
+                out.append(Finding(
+                    CHECK_ID, CHECK_NAME, rel, g.lineno, 0, ERROR,
+                    f"`global {nm}` rebind reached from thread role "
+                    f"{roles[0]} but {key!r} is not classified in "
+                    "SHARD_STATE_REGISTRY: an undeclared process-global "
+                    "written from concurrent code; classify it or push "
+                    "the state into an owned object"))
+    return out
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    from tools.analyze.checks import shard_state
+    tm = threadmodel.model(pc)
+    inventory, registry, _entry_lines, _reg_line = shard_state.build(pc)
+    reg = registry or {}
+    findings: List[Finding] = []
+    for key, s in sorted(inventory.items()):
+        cls = reg.get(key)
+        if cls == "lock_guarded_shared":
+            findings.extend(_check_lock_guarded(pc, tm, key, s))
+        elif cls == "shard_local":
+            findings.extend(_check_shard_local(pc, tm, key, s))
+    findings.extend(_check_globals(pc, tm, reg))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+# -- machine-readable report --------------------------------------------------
+
+def report(pc: ProjectContext) -> Tuple[dict, bool]:
+    """The ``--report thread-model`` JSON document and whether the tree
+    is clean (no unwaived TJA028-TJA032 findings)."""
+    from tools.analyze.checks import (
+        check_then_act, shard_state, unguarded_shared_state, wait_discipline,
+    )
+    from tools.analyze.checks import shutdown_ordering
+    tm = threadmodel.model(pc)
+    inventory, registry, _el, _rl = shard_state.build(pc)
+    reg = registry or {}
+    desc = tm.describe()
+
+    singletons = []
+    for key, s in sorted(inventory.items()):
+        evidence = []
+        for write, sites in ((True, s.writes), (False, s.reads)):
+            for p, ln, via in sorted(sites):
+                evidence.append({
+                    "path": p, "line": ln, "via": via, "write": write,
+                    "roles": sorted(tm.roles_at(p, ln)),
+                    "locks": sorted(tm.lock_set(p, ln)),
+                })
+        singletons.append({
+            "name": key, "kind": s.kind,
+            "classification": reg.get(key),
+            "evidence": evidence,
+        })
+
+    counts: Dict[str, int] = {}
+    modules = (unguarded_shared_state, check_then_act, wait_discipline,
+               shutdown_ordering)
+    for m in modules:
+        counts[m.CHECK_ID] = _unwaived(pc, m.check(pc))
+    counts[CHECK_ID] = _unwaived(pc, check(pc))
+
+    doc = {
+        "version": REPORT_VERSION,
+        "generated_by": f"tools.analyze {CHECK_ID} ({CHECK_NAME})",
+        "package": PKG,
+        "roles": desc["roles"],
+        "mhp": desc["mhp"],
+        "singletons": singletons,
+        "violations": counts,
+    }
+    ok = not any(counts.values())
+    return doc, ok
+
+
+def _unwaived(pc: ProjectContext, findings: List[Finding]) -> int:
+    n = 0
+    for f in findings:
+        fctx = pc.files.get(f.path)
+        if fctx is None or not fctx.waived(f.line, f.check_name):
+            n += 1
+    return n

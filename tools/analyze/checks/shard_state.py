@@ -59,18 +59,10 @@ CLASSIFICATIONS = frozenset({
     "constant", "shard_local", "lock_guarded_shared", "shard_hostile",
 })
 
-#: Method-name prefixes treated as reads; everything else called on a
-#: singleton is conservatively a mutation (the report records both).
-READ_PREFIXES = (
-    "get", "is_", "has_", "peek", "depth", "render", "snapshot", "to_",
-    "export", "format", "iter", "keys", "values", "items", "copy",
-    "summary", "describe", "count", "index", "armed", "bundle", "list",
-    "read", "collect", "lines",
-)
-
-
-def _is_read(method: str) -> bool:
-    return method.startswith(READ_PREFIXES)
+# Read/write method-name classification now lives in the thread-model
+# layer (the canonical copy); this pass and TJA028+ must agree on it.
+from tools.analyze.threadmodel import READ_PREFIXES  # noqa: F401  (re-export)
+from tools.analyze.threadmodel import is_read_method as _is_read
 
 
 @dataclass

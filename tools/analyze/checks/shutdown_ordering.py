@@ -1,0 +1,112 @@
+"""TJA031 shutdown-ordering: retained threads are joined, and not under
+a lock the thread itself takes.
+
+A class that stores its spawned thread (``self._thread = Thread(...)``
+or ``self._workers.append(th)``) and exposes a stop path
+(``stop``/``shutdown``/``shut_down``/``close``/``request_stop``) has
+made a lifecycle promise: shutdown reclaims the thread.  Two ways that
+promise silently breaks:
+
+- **Never joined.**  No stop path joins the retained handle, so the
+  thread outlives shutdown and races teardown -- flushing to a closed
+  sink, reconciling a deleted store, segfault-adjacent behaviour that
+  only shows under load.  WARNING at the spawn site (daemon threads are
+  still flagged: daemonhood changes process exit, not teardown races).
+
+- **Joined under the wrong lock.**  A stop path that joins while
+  holding a lock the role's closure also acquires deadlocks the first
+  time the thread happens to be blocked on that lock at shutdown --
+  stop() waits on the thread, the thread waits on stop()'s lock.
+  ERROR at the join site, naming the shared lock.
+
+Role/closure/lock facts all come from the thread-model layer; roles
+whose handle is never retained have no join obligation (the spawner
+provably cannot join them -- that is a design choice, not drift).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from tools.analyze import threadmodel
+from tools.analyze.findings import ERROR, Finding, WARNING
+from tools.analyze.project import MethodSummary, ProjectContext, _self_attr
+from tools.analyze.runner import register_project
+
+CHECK_ID, CHECK_NAME = "TJA031", "shutdown-ordering"
+
+
+def _is_join(n: ast.AST) -> bool:
+    return isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+        and n.func.attr == "join"
+
+
+def _join_sites(s: MethodSummary, attr, list_attr) -> List[int]:
+    """Lines in a stop summary that join the retained handle: a direct
+    ``self.<attr>.join(...)``, a join through a local alias
+    (``th = self._thread; th.join(...)``), or any loop-variable
+    ``.join(...)`` inside a ``for ... in self.<list_attr>:`` loop."""
+    aliases = set()
+    if attr is not None:
+        for n in ast.walk(s.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and _self_attr(n.value) == attr:
+                aliases.add(n.targets[0].id)
+    out: List[int] = []
+    for n in ast.walk(s.node):
+        if isinstance(n, ast.For) and list_attr is not None \
+                and _self_attr(n.iter) == list_attr:
+            for m in ast.walk(n):
+                if _is_join(m) and isinstance(m.func.value, ast.Name):
+                    out.append(m.lineno)
+        elif _is_join(n):
+            recv = n.func.value
+            if (attr is not None and _self_attr(recv) == attr) \
+                    or (isinstance(recv, ast.Name) and recv.id in aliases):
+                out.append(n.lineno)
+    return out
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    tm = threadmodel.model(pc)
+    findings: List[Finding] = []
+    for name in sorted(tm.roles):
+        role = tm.roles[name]
+        if role.kind != "thread" or role.owner_class is None:
+            continue
+        attr = role.thread_attr or role.thread_list_attr
+        if attr is None:
+            continue   # handle never retained: no join obligation
+        stops: List[Tuple[str, MethodSummary]] = \
+            tm.stop_summaries(role.owner_class)
+        if not stops:
+            continue
+        joined = False
+        for path, s in stops:
+            for line in _join_sites(s, role.thread_attr,
+                                    role.thread_list_attr):
+                joined = True
+                held = tm.lock_set(path, line) & tm.role_lock_ids(name)
+                if held:
+                    findings.append(Finding(
+                        CHECK_ID, CHECK_NAME, path, line, 0, ERROR,
+                        f"{s.qual} joins thread role {name} while holding "
+                        f"{', '.join(sorted(held))}, which the role's "
+                        "closure also acquires: if the thread is blocked "
+                        "on that lock at shutdown, stop() waits on the "
+                        "thread and the thread waits on stop() -- join "
+                        "outside the locked region"))
+        if not joined:
+            stop_names = ", ".join(sorted(s.qual for _p, s in stops))
+            findings.append(Finding(
+                CHECK_ID, CHECK_NAME, role.spawn_path, role.spawn_line, 0,
+                WARNING,
+                f"thread role {name} is retained as self.{attr} but no "
+                f"stop path ({stop_names}) joins it; the thread outlives "
+                "shutdown and races teardown -- join it (with a timeout) "
+                "from the stop path"))
+    findings.sort(key=Finding.sort_key)
+    return findings

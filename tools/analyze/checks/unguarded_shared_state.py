@@ -1,0 +1,155 @@
+"""TJA028 unguarded-shared-state: MHP-aware static race detection.
+
+TJA002 proves lock *discipline* (an attribute guarded somewhere is
+guarded everywhere) but says nothing about state that is never guarded
+at all -- and it has no notion of which threads actually run.  This
+pass closes that gap with the thread-model layer: two roles that may
+happen in parallel (MHP) touching the same shared object, at least one
+touch a write, and **disjoint lock-sets** at the two sites, is a data
+race the schedules will eventually find.
+
+Two object universes, both witness-based:
+
+- **module-global bare containers** (dicts/lists/sets/deques/counters
+  from the TJA027 inventory -- class-instance singletons own their
+  locking and are vetted by TJA032 instead);
+- **shared instance container attributes**: ``self.X = {}``-style attrs
+  whose owning class's methods are split across MHP roles (a runtime
+  poller thread and the reconcile worker that owns the runtime, say).
+  ``__init__`` writes are exempt -- construction happens-before any
+  spawn.
+
+The witness names both access chains (role, site, via, lock-set) and
+both spawn sites, so a reader can replay the interleaving.  A role
+whose closure does not reach the object contributes nothing; unreached
+code (CLI-only, test-only) never produces evidence.  GIL-atomic
+single-op patterns that are *deliberately* lock-free (monotonic stats
+counters read without the lock) are expected to carry a waiver naming
+that reasoning -- the waiver inventory lives in
+docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tools.analyze import threadmodel
+from tools.analyze.findings import ERROR, Finding
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+from tools.analyze.threadmodel import Access, ThreadModel
+
+CHECK_ID, CHECK_NAME = "TJA028", "unguarded-shared-state"
+
+
+def _witness_pair(tm: ThreadModel, accesses: List[Access]) \
+        -> Optional[Tuple[Access, str, Access, str]]:
+    """First (write access, role, other access, role) pair that is MHP
+    with disjoint lock-sets, or None.  Lock-sets are computed lazily and
+    only for role-reaching accesses."""
+    enriched = []
+    for a in sorted(accesses, key=lambda a: (a.path, a.line, a.via)):
+        if threadmodel.locked_by_convention(a.qual):
+            continue   # *_locked methods run with the owner's lock held
+        roles = sorted(tm.roles_of(a.qual))
+        if roles:
+            enriched.append((a, roles))
+    locks: Dict[Tuple[str, int], frozenset] = {}
+
+    def lock_set(a: Access) -> frozenset:
+        key = (a.path, a.line)
+        got = locks.get(key)
+        if got is None:
+            got = tm.lock_set(a.path, a.line)
+            locks[key] = got
+        return got
+
+    for i, (a1, roles1) in enumerate(enriched):
+        for a2, roles2 in enriched[i:]:
+            if not (a1.write or a2.write):
+                continue
+            pair = None
+            for ra in roles1:
+                for rb in roles2:
+                    if a1 is a2 and ra == rb:
+                        # the same site racing itself needs two instances
+                        if tm.mhp(ra, ra):
+                            pair = (ra, rb)
+                    elif tm.mhp(ra, rb):
+                        pair = (ra, rb)
+                    if pair:
+                        break
+                if pair:
+                    break
+            if pair is None:
+                continue
+            if lock_set(a1) & lock_set(a2):
+                continue
+            if a1.write:
+                return a1, pair[0], a2, pair[1]
+            return a2, pair[1], a1, pair[0]
+    return None
+
+
+def _spawn_site(tm: ThreadModel, role: str) -> str:
+    r = tm.roles.get(role)
+    if r is None or not r.spawn_path:
+        return role
+    return f"{r.spawn_path}:{r.spawn_line}"
+
+
+def _describe(tm: ThreadModel, a: Access, role: str) -> str:
+    locks = sorted(tm.lock_set(a.path, a.line))
+    held = "{" + ", ".join(locks) + "}" if locks else "no lock"
+    return (f"{'written' if a.write else 'read'} ({a.via}) at "
+            f"{a.path}:{a.line} by role {role} "
+            f"(spawned {_spawn_site(tm, role)}) under {held}")
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    tm = threadmodel.model(pc)
+    if not any(r.kind == "thread" for r in tm.roles.values()):
+        return []
+    findings: List[Finding] = []
+
+    # Module-global bare containers, from the shard-state inventory.
+    from tools.analyze.checks import shard_state
+    inventory, _reg, _lines, _rl = shard_state.build(pc)
+    for key, s in sorted(inventory.items()):
+        if s.kind not in threadmodel.BARE_CONTAINER_KINDS:
+            continue
+        accesses = [Access(path=p, line=ln, via=via, write=True,
+                           qual=tm.owner_qual(p, ln))
+                    for p, ln, via in s.writes]
+        accesses += [Access(path=p, line=ln, via=via, write=False,
+                            qual=tm.owner_qual(p, ln))
+                     for p, ln, via in s.reads]
+        hit = _witness_pair(tm, accesses)
+        if hit is None:
+            continue
+        w, wrole, o, orole = hit
+        findings.append(Finding(
+            CHECK_ID, CHECK_NAME, w.path, w.line, 0, ERROR,
+            f"module-global {key!r} ({s.kind}) is shared across "
+            f"may-happen-in-parallel threads with disjoint lock-sets: "
+            f"{_describe(tm, w, wrole)}; also "
+            f"{_describe(tm, o, orole)}; guard both sites under one lock "
+            "or make the state role-local"))
+
+    # Shared instance container attributes.
+    for (cls_qual, attr), accesses in sorted(tm.attr_accesses().items()):
+        hit = _witness_pair(tm, accesses)
+        if hit is None:
+            continue
+        w, wrole, o, orole = hit
+        findings.append(Finding(
+            CHECK_ID, CHECK_NAME, w.path, w.line, 0, ERROR,
+            f"instance attribute {cls_qual}.{attr} is shared across "
+            f"may-happen-in-parallel threads with disjoint lock-sets: "
+            f"{_describe(tm, w, wrole)}; also "
+            f"{_describe(tm, o, orole)}; guard both sites under one lock "
+            "or make the state role-local"))
+
+    findings.sort(key=Finding.sort_key)
+    return findings

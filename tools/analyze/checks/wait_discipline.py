@@ -1,0 +1,116 @@
+"""TJA030 wait-predicate-discipline: every blocking wait is survivable.
+
+Two failure shapes on ``threading`` wait primitives, both invisible to
+the lock passes because nothing deadlocks -- the process just stalls:
+
+- **Spurious/missed wakeup.**  ``Condition.wait()`` may return without
+  a ``notify`` and *must* return when the predicate became true before
+  the waiter got the lock back.  A wait that is not lexically re-checked
+  in a loop (``while not pred: cond.wait(...)``) acts on a predicate it
+  never verified.  ``Condition.wait_for`` builds the loop in and is
+  exempt.  This sub-rule is local and fires anywhere in non-test code.
+
+- **Unbounded park in a stoppable thread.**  ``Event.wait()`` or
+  ``Thread.join()`` with no timeout, executed inside a spawned role
+  whose owning class has a stop path (``stop``/``shutdown``/...),
+  parks that thread forever if the ``set()``/exit it waits for is
+  missed -- and ``stop()`` then hangs behind it.  The thread-model
+  layer supplies both facts: which role the wait runs in, and whether
+  that role's owner is stoppable.  Waits on the main thread (a CLI
+  parking on a shutdown event) are deliberate and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze import threadmodel
+from tools.analyze.findings import ERROR, FileContext, Finding, WARNING
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import ProjectContext
+from tools.analyze.runner import register_project
+from tools.analyze.threadmodel import ThreadModel
+
+CHECK_ID, CHECK_NAME = "TJA030", "wait-predicate-discipline"
+
+
+def _in_loop(ctx: FileContext, node: ast.AST) -> bool:
+    """Lexically inside a While/For within the enclosing function."""
+    anc = ctx.parents.get(id(node))
+    while anc is not None:
+        if isinstance(anc, (ast.While, ast.For)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            return False
+        anc = ctx.parents.get(id(anc))
+    return False
+
+
+def _unbounded(call: ast.Call) -> bool:
+    """True when the call carries no (non-None) timeout."""
+    if call.args:
+        return all(isinstance(a, ast.Constant) and a.value is None
+                   for a in call.args)
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return isinstance(kw.value, ast.Constant) \
+                and kw.value.value is None
+    return True
+
+
+def _stoppable_role(tm: ThreadModel, rel: str, line: int) -> Optional[str]:
+    """A spawned role containing this site whose owner has a stop path."""
+    for rname in sorted(tm.roles_at(rel, line)):
+        role = tm.roles[rname]
+        if role.kind == "thread" and tm.has_stop_path(role.owner_class):
+            return rname
+    return None
+
+
+@register_project(CHECK_ID, CHECK_NAME)
+def check(pc: ProjectContext) -> List[Finding]:
+    tm = threadmodel.model(pc)
+    findings: List[Finding] = []
+    for rel, ctx in sorted(pc.files.items()):
+        if ctx.tree is None or is_test_path(rel):
+            continue
+        if ".wait(" not in ctx.source and ".join(" not in ctx.source:
+            continue
+        for call in ctx.by_type(ast.Call):
+            fn = call.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr == "wait":
+                kind = tm.condition_kind(rel, call, fn.value)
+                if kind == "Condition" and not _in_loop(ctx, call):
+                    findings.append(Finding(
+                        CHECK_ID, CHECK_NAME, rel, call.lineno, 0, ERROR,
+                        "Condition.wait() outside a predicate loop: wakeups "
+                        "may be spurious and the predicate may already be "
+                        "stale when the lock is re-won; use `while not "
+                        "predicate: cond.wait(...)` or cond.wait_for(...)"))
+                elif kind == "Event" and _unbounded(call):
+                    rname = _stoppable_role(tm, rel, call.lineno)
+                    if rname is not None:
+                        findings.append(Finding(
+                            CHECK_ID, CHECK_NAME, rel, call.lineno, 0,
+                            WARNING,
+                            f"Event.wait() without a timeout inside thread "
+                            f"role {rname} whose owner has a stop path: a "
+                            "missed set() parks the thread forever and "
+                            "stop() hangs behind it; bound the wait and "
+                            "re-check the stop predicate"))
+            elif fn.attr == "join" and _unbounded(call):
+                rname = _stoppable_role(tm, rel, call.lineno)
+                if rname is not None:
+                    findings.append(Finding(
+                        CHECK_ID, CHECK_NAME, rel, call.lineno, 0, WARNING,
+                        f".join() without a timeout inside thread role "
+                        f"{rname} whose owner has a stop path: if the "
+                        "joined thread never exits, this role -- and the "
+                        "stop path waiting on it -- hang; join with a "
+                        "timeout and surface the straggler"))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -50,6 +50,15 @@ _TOKEN_NODES = frozenset(
                  ast.cmpop)
     for cls in base.__subclasses__())
 
+#: Node classes whose every field is a scalar or a token: enumerating their
+#: fields can never push a child.  Name + Constant alone are ~1/3 of the
+#: non-token nodes on this tree, so the fused walk skips their field loop
+#: outright (a visible slice of the lint budget).
+_LEAF_NODES = frozenset((
+    ast.Name, ast.Constant, ast.Pass, ast.Break, ast.Continue,
+    ast.Import, ast.ImportFrom, ast.Global, ast.Nonlocal, ast.alias,
+    ast.MatchSingleton, ast.TypeIgnore))
+
 
 def walk_fast(root) -> list:
     """``ast.walk`` equivalent returning a list (same BFS order, minus the
@@ -66,11 +75,14 @@ def walk_fast(root) -> list:
     out = [root]
     isinst, AST = isinstance, ast.AST
     tokens = _TOKEN_NODES
+    leaves = _LEAF_NODES
     push = out.append
     i = 0
     while i < len(out):
         n = out[i]
         i += 1
+        if n.__class__ in leaves:
+            continue
         d = n.__dict__
         for name in n._fields:
             v = d.get(name)
@@ -89,6 +101,10 @@ def walk_fast(root) -> list:
 #: during its single fused sweep.  One definition so the two stay in sync.
 _LOCAL_BARRIERS = {ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
                    ast.ClassDef}
+
+#: One-slot cache for cfg.build_cfg, filled on first FileContext.cfg() call
+#: (module-level import would be a cycle: cfg.py imports findings).
+_BUILD_CFG: list = [None]
 
 
 def fingerprint(f: Finding, occurrence: int) -> str:
@@ -164,6 +180,7 @@ class FileContext:
             isinst, AST = isinstance, ast.AST
             barriers = _LOCAL_BARRIERS
             tokens = _TOKEN_NODES
+            leaves = _LEAF_NODES
             push = nodes.append
             push(self.tree)
             # owners[i] is the _tja_local_walk list of nodes[i]'s nearest
@@ -179,13 +196,14 @@ class FileContext:
                 own = owners[i]
                 i += 1
                 cls = n.__class__
-                b = buckets.get(cls)
-                if b is None:
+                try:
+                    buckets[cls].append(n)
+                except KeyError:
                     buckets[cls] = [n]
-                else:
-                    b.append(n)
                 if own is not None:
                     own.append(n)
+                if cls in leaves:
+                    continue
                 if cls in barriers:
                     # Children belong to this barrier's own-body walk; the
                     # list is complete by the time _build_walk returns, and
@@ -231,7 +249,14 @@ class FileContext:
         for the same functions, and the project passes see the same
         FileContext objects the runner parsed, so each function body is
         built exactly once per run (the 2 s budget depends on it)."""
-        from tools.analyze.cfg import build_cfg  # local: avoid import cycle
+        build_cfg = _BUILD_CFG[0]
+        if build_cfg is None:
+            # Import deferred to first use (cfg.py imports this module); the
+            # cached slot keeps the import machinery off the per-call path --
+            # a function-local ``from`` import here re-ran _handle_fromlist
+            # once per cfg() call, a visible slice of the lint budget.
+            from tools.analyze.cfg import build_cfg
+            _BUILD_CFG[0] = build_cfg
         if self._cfgs is None:
             self._cfgs = {}
         key = id(func_node)

@@ -176,6 +176,118 @@ def _dotted(node: ast.expr) -> Optional[str]:
     return None
 
 
+class CallResolver:
+    """Resolution over the summary call/lock graph, with caches.
+
+    Grew up inside the TJA010 lock-order pass; promoted here once the
+    thread-model layer (tools/analyze/threadmodel.py) needed the same
+    callee/lock resolution to build role closures -- one resolver, one
+    set of caches, shared by every consumer of ``MethodSummary.calls``.
+    """
+
+    def __init__(self, pc: "ProjectContext"):
+        self.pc = pc
+        self._composites: Dict[str, List[ClassInfo]] = {}
+        self._creator: Dict[Tuple[str, str], Optional[Tuple[str, str]]] = {}
+
+    def composites(self, ci: ClassInfo) -> List[ClassInfo]:
+        got = self._composites.get(ci.qual)
+        if got is None:
+            got = self.pc.subclasses_including(ci)
+            self._composites[ci.qual] = got
+        return got
+
+    def lock_id(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                name: str) -> Optional[Tuple[str, str]]:
+        """(lock id, factory kind) for a raw acquisition name recorded in a
+        summary: a module-level lock, or a ``self.X`` attribute whose
+        creating class is found in the MRO of any composite the defining
+        class is mixed into.  None when the name is not provably a lock."""
+        if name in mod.module_locks:
+            return f"{mod.name}.{name}", mod.module_locks[name]
+        if cls is None:
+            return None
+        key = (cls.qual, name)
+        if key in self._creator:
+            return self._creator[key]
+        found: Optional[Tuple[str, str]] = None
+        for k in [cls] + self.composites(cls):
+            for c in self.pc.mro_classes(k):
+                if name in c.lock_attrs:
+                    found = (f"{c.qual}.{name}", c.lock_attrs[name])
+                    break
+            if found:
+                break
+        self._creator[key] = found
+        return found
+
+    def callee_summaries(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                         callee: tuple) -> List[Tuple[ModuleInfo,
+                                                      Optional[ClassInfo],
+                                                      MethodSummary]]:
+        kind = callee[0]
+        out: List[Tuple[ModuleInfo, Optional[ClassInfo], MethodSummary]] = []
+        if kind == "self" and cls is not None:
+            name = callee[1]
+            seen: Set[str] = set()
+            for k in self.composites(cls):
+                table = self.pc.mro_methods(k)
+                hit = table.get(name)
+                if hit is None:
+                    continue
+                ci, _node = hit
+                s = ci.summaries.get(name)
+                if s is not None and s.qual not in seen:
+                    seen.add(s.qual)
+                    out.append((self.pc.modules[ci.module], ci, s))
+            return out
+        if kind == "name":
+            name = callee[1]
+            if name in mod.fn_summaries:
+                return [(mod, None, mod.fn_summaries[name])]
+            target = mod.imports.get(name)
+            if target:
+                tmod, _, leaf = target.rpartition(".")
+                mi = self.pc.modules.get(tmod)
+                if mi is not None and leaf in mi.fn_summaries:
+                    return [(mi, None, mi.fn_summaries[leaf])]
+            return out
+        if kind == "attr":
+            leaf, meth = callee[1], callee[2]
+            ctor: Optional[Tuple[str, str]] = None   # (module, class name)
+            if cls is not None:
+                for k in [cls] + self.composites(cls):
+                    for c in self.pc.mro_classes(k):
+                        if leaf in c.attr_ctors:
+                            ctor = (c.module, c.attr_ctors[leaf])
+                            break
+                    if ctor:
+                        break
+            if ctor is None:
+                tgt, src_mod = mod.global_ctors.get(leaf), mod.name
+                if tgt is None:
+                    imp = mod.imports.get(leaf)
+                    if imp:
+                        m, _, l2 = imp.rpartition(".")
+                        mi = self.pc.modules.get(m)
+                        if mi is not None and l2 in mi.global_ctors:
+                            tgt, src_mod = mi.global_ctors[l2], m
+                if tgt is not None:
+                    ctor = (src_mod, tgt)
+            if ctor is not None:
+                ci = self.pc.resolve_class(ctor[0], ctor[1])
+                if ci is not None:
+                    table = self.pc.mro_methods(ci)
+                    hit = table.get(meth)
+                    if hit is not None:
+                        c2, _node = hit
+                        s = c2.summaries.get(meth)
+                        if s is not None:
+                            out.append((self.pc.modules[c2.module], c2, s))
+            return out
+        return out
+
+
 #: Node classes with no walk-relevant descendants (their only children are
 #: ctx/operator tokens); the body walker returns without recursing.
 _WALK_LEAVES = frozenset({

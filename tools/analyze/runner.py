@@ -64,13 +64,15 @@ def register_project(check_id: str, check_name: str):
 def _load_checks() -> None:
     # Import for side effect: each module @register's its pass.
     from tools.analyze.checks import (  # noqa: F401
-        broad_except, constant_drift, dead_reasons, digest_stability,
-        donation_discipline, env_contract, event_reasons, exception_escape,
-        finally_restore, host_sync_hot_loop, impure_capture, iteration_order,
-        lock_blocking, lock_discipline, lock_order, metric_drift,
-        orphaned_thread, phase_transitions, py_compat, recompile_hazard,
-        reconcile_purity, resource_leak, retry_backoff, shard_state,
-        status_discipline, tracer_safety, unseeded_randomness,
+        broad_except, check_then_act, constant_drift, dead_reasons,
+        digest_stability, donation_discipline, env_contract, event_reasons,
+        exception_escape, finally_restore, host_sync_hot_loop, impure_capture,
+        iteration_order, lock_blocking, lock_discipline, lock_order,
+        metric_drift, orphaned_thread, phase_transitions, py_compat,
+        recompile_hazard, reconcile_purity, resource_leak, retry_backoff,
+        shard_boundary, shard_state, shutdown_ordering, status_discipline,
+        tracer_safety, unguarded_shared_state, unseeded_randomness,
+        wait_discipline,
     )
 
 
@@ -277,6 +279,19 @@ RULE_HELP: Dict[str, str] = {
               "iterate sorted(...).",
     "TJA027": "Module-level mutable singletons must be classified in "
               "SHARD_STATE_REGISTRY (shard-state inventory).",
+    "TJA028": "State shared between may-happen-in-parallel threads with a "
+              "write and disjoint lock-sets is a data race; guard both "
+              "sites under one lock.",
+    "TJA029": "A test of shared state and the conditional mutation it "
+              "guards must be spanned by one lock (check-then-act race).",
+    "TJA030": "Condition.wait must sit in a predicate loop; unbounded "
+              "Event.wait/join inside a stoppable thread role parks it "
+              "forever.",
+    "TJA031": "Retained threads must be joined by their owner's stop path, "
+              "and never under a lock the thread itself acquires.",
+    "TJA032": "SHARD_STATE_REGISTRY classifications must hold against the "
+              "thread model: lock_guarded access is locked, shard_local is "
+              "not raced, globals rebound from threads are declared.",
 }
 
 #: check_id -> SARIF defaultConfiguration level.  Checks that emit both
@@ -284,7 +299,7 @@ RULE_HELP: Dict[str, str] = {
 #: still carry the exact severity.
 RULE_DEFAULT_LEVELS: Dict[str, str] = {
     "TJA004": "warning", "TJA018": "warning", "TJA019": "warning",
-    "TJA021": "warning",
+    "TJA021": "warning", "TJA030": "warning", "TJA031": "warning",
 }
 
 

@@ -1,0 +1,805 @@
+"""Whole-program concurrency model: thread roles, MHP, lock-sets.
+
+TJA027 classifies *singletons*; the lock passes (TJA002/TJA010/TJA016)
+reason about locks in a vacuum.  Neither answers the question ROADMAP
+item 3 (controller scale-out) actually turns on: **which threads run
+concurrently against which shared state, and under which locks**.  This
+layer models the process's real thread topology once per
+``ProjectContext`` (BUILD_COUNT-memoized like ``cfg``/``jit_boundary``/
+``determinism``) and the TJA028-TJA032 passes consume it:
+
+1. **Thread-role inference.**  Every ``threading.Thread(target=...)``
+   spawn site in non-test code becomes a role; the target callable is
+   resolved (``self._loop`` through mixin composites, module functions,
+   nested ``def`` pump bodies, ``obj.method`` through inferred
+   constructor types) and its interprocedural call closure is computed
+   over the same ``MethodSummary`` call graph TJA010 uses (one shared
+   ``CallResolver``).  The main thread joins as a synthetic role rooted
+   at the ``cmd`` entry point.
+
+2. **May-happen-in-parallel (MHP).**  Two distinct roles may run in
+   parallel unless their spawn sites live in different workload
+   programs (``workloads/serve.py`` threads never share a process with
+   ``workloads/train.py`` threads); a role MHPs with *itself* iff
+   multiple instances can exist -- spawned in a loop, spawned per
+   constructed instance (``__init__``/multi-site constructors, e.g. one
+   pump per workqueue), or spawned by a role that is itself multiple
+   (one runtime poller per tracked job, created by the worker pool).
+
+3. **Lock-sets.**  ``lock_set(path, line)`` is the set of lock ids
+   lexically held at a statement -- ``with`` regions resolved through
+   ``CallResolver.lock_id`` (mixin-aware), built lazily per file and
+   only for files a pass actually flags, so the 2 s lint budget holds.
+
+Everything is witness-based and conservative in the same sense as the
+rest of the analyzer: dynamic spawns, executor pools, and cross-process
+shared memory are invisible; code reachable from *no* role contributes
+no concurrency evidence (it may be dead, test-only, or CLI-only -- the
+passes only report what the model can prove runs in parallel).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.analyze.findings import FileContext
+from tools.analyze.jit_boundary import is_test_path
+from tools.analyze.project import (
+    CallResolver, ClassInfo, MethodSummary, ModuleInfo, ProjectContext,
+    _BodyWalker, _dotted, _mutable_kind, _self_attr,
+)
+
+#: Times a ThreadModel was actually constructed (not returned from the
+#: per-ProjectContext memo) -- tests assert built-once per run.
+BUILD_COUNT = 0
+
+PKG = "trainingjob_operator_tpu"
+
+#: Inventory kinds that are bare containers/counters (no methods of their
+#: own to lock): the race passes reason about their accesses directly.
+#: Class-instance singletons own their locking and are vetted through
+#: their class's methods instead (TJA032 evidence).
+BARE_CONTAINER_KINDS = frozenset({
+    "dict", "list", "set", "bytearray", "deque", "defaultdict",
+    "Counter", "OrderedDict", "ChainMap", "count",
+})
+
+#: Method names that constitute a stop path on a role's owning class.
+STOP_METHOD_NAMES = ("stop", "shutdown", "shut_down", "close",
+                     "request_stop")
+
+#: Method-name prefixes treated as reads when called on a shared object;
+#: everything else is conservatively a mutation.  Canonical copy (the
+#: TJA027 shard-state pass imports it).
+READ_PREFIXES = (
+    "get", "is_", "has_", "peek", "depth", "render", "snapshot", "to_",
+    "export", "format", "iter", "keys", "values", "items", "copy",
+    "summary", "describe", "count", "index", "armed", "bundle", "list",
+    "read", "collect", "lines", "span", "window", "traces",
+)
+
+
+def is_read_method(method: str) -> bool:
+    return method.startswith(READ_PREFIXES)
+
+
+def locked_by_convention(qual: str) -> bool:
+    """The ``_locked`` suffix convention: a method named ``*_locked`` is
+    only ever called with its object's lock already held, so its accesses
+    are guarded even though no ``with`` region is lexically visible."""
+    return qual.rpartition(".")[2].endswith("_locked")
+
+
+@dataclass
+class ThreadRole:
+    """One spawn site (or the synthetic main thread)."""
+    name: str
+    kind: str = "thread"                   # "thread" | "main"
+    spawn_path: str = ""
+    spawn_line: int = 0
+    entries: Tuple[str, ...] = ()          # resolved target summary quals
+    target: str = ""                       # raw target text for the report
+    daemon: bool = False
+    multi: bool = False                    # >1 instance may exist (self-MHP)
+    domain: str = "shared"                 # process-compatibility group
+    owner_qual: str = ""                   # qual of the spawning function
+    owner_class: Optional[str] = None      # class qual owning the spawn site
+    owner_method: str = ""
+    thread_attr: Optional[str] = None      # ``self.X = Thread(...)``
+    thread_list_attr: Optional[str] = None # ``self.X.append(t)``
+    closure: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class Access:
+    """One witnessed touch of a shared object."""
+    path: str
+    line: int
+    via: str
+    write: bool
+    qual: str                              # owning summary qual ("" = module)
+
+
+def _domain_of(module_name: str) -> str:
+    """Process-compatibility group for a spawn site.  Each workload
+    program is its own process; everything else (controller, client,
+    obs, runtime, utils -- importable from any process) is 'shared'."""
+    parts = module_name.split(".")
+    for i, part in enumerate(parts):
+        if part == "workloads" and i + 1 < len(parts):
+            return f"workloads.{parts[i + 1]}"
+    return "shared"
+
+
+def _event_factory(value: ast.expr) -> bool:
+    """True for ``threading.Event()``-shaped constructor calls."""
+    if not isinstance(value, ast.Call):
+        return False
+    d = _dotted(value.func)
+    return d is not None and d.rpartition(".")[2] == "Event"
+
+
+class ThreadModel:
+    """The built model.  Construct via ``model(pc)``, never directly."""
+
+    def __init__(self, pc: ProjectContext):
+        self.pc = pc
+        self.resolver = CallResolver(pc)
+        self.roles: Dict[str, ThreadRole] = {}
+        #: class qual -> {container attr -> definition line}.
+        self.container_attrs: Dict[str, Dict[str, int]] = {}
+        #: class qual -> set of ``threading.Event()`` attr names.
+        self.event_attrs: Dict[str, Set[str]] = {}
+        #: qual -> (mod, class, summary) for every summary incl. synthetics.
+        self._summaries: Dict[str, Tuple[ModuleInfo, Optional[ClassInfo],
+                                         MethodSummary]] = {}
+        self._qual_roles: Dict[str, Set[str]] = {}
+        self._lock_regions: Dict[str, List[Tuple[int, int, str]]] = {}
+        self._fn_spans: Dict[str, List[Tuple[int, int, str]]] = {}
+        self._role_locks: Dict[str, FrozenSet[str]] = {}
+        self._closure_memo: Dict[Tuple[str, ...], FrozenSet[str]] = {}
+        self._attr_accesses: Optional[
+            Dict[Tuple[str, str], List[Access]]] = None
+        self._spawns: List[dict] = []      # raw spawn records (for widening)
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        self._index_summaries()
+        for rel, ctx in sorted(self.pc.files.items()):
+            if ctx.tree is None or is_test_path(rel):
+                continue
+            mod = self.pc.module_of_path(rel)
+            if mod is None:
+                continue
+            self._collect_file(rel, ctx, mod)
+        self._add_main_role()
+        for role in self.roles.values():
+            role.closure = self._closure(role.entries)
+        self._refine_multi()
+        for name, role in self.roles.items():
+            for q in role.closure:
+                self._qual_roles.setdefault(q, set()).add(name)
+
+    def _index_summaries(self) -> None:
+        for mod in self.pc.modules.values():
+            for s in mod.fn_summaries.values():
+                self._summaries[s.qual] = (mod, None, s)
+            for ci in mod.classes.values():
+                for s in ci.summaries.values():
+                    self._summaries[s.qual] = (mod, ci, s)
+
+    def _collect_file(self, rel: str, ctx: FileContext,
+                      mod: ModuleInfo) -> None:
+        by_node = {id(ci.node): ci for ci in mod.classes.values()}
+        parents = ctx.parents
+
+        def owner_class_of(node: ast.AST) -> Optional[ClassInfo]:
+            anc = parents.get(id(node))
+            while anc is not None:
+                if isinstance(anc, ast.ClassDef):
+                    return by_node.get(id(anc))
+                anc = parents.get(id(anc))
+            return None
+
+        # Container/Event attribute inference (``self.X = {}`` /
+        # ``self.X = threading.Event()``), one sweep over the cached
+        # Assign bucket -- same trick as ProjectContext._index_module.
+        for sub in ctx.by_type(ast.Assign):
+            kind = _mutable_kind(sub.value)
+            is_event = kind is None and _event_factory(sub.value)
+            if kind is None and not is_event:
+                continue
+            attrs = [a for a in (_self_attr(t) for t in sub.targets)
+                     if a is not None]
+            if not attrs:
+                continue
+            owner = owner_class_of(sub)
+            if owner is None:
+                continue
+            for attr in attrs:
+                if is_event:
+                    self.event_attrs.setdefault(owner.qual, set()).add(attr)
+                elif attr not in owner.lock_attrs:
+                    self.container_attrs.setdefault(owner.qual, {})\
+                        .setdefault(attr, sub.lineno)
+
+        if "Thread(" not in ctx.source:
+            return
+        for call in ctx.by_type(ast.Call):
+            if not self._thread_ctor(call, mod):
+                continue
+            self._record_spawn(rel, ctx, mod, by_node, call)
+
+    @staticmethod
+    def _thread_ctor(call: ast.Call, mod: ModuleInfo) -> bool:
+        d = _dotted(call.func)
+        if d is None or d.rpartition(".")[2] != "Thread":
+            return False
+        if d == "Thread":
+            return mod.imports.get("Thread", "threading.Thread") \
+                == "threading.Thread"
+        head = d.partition(".")[0]
+        return mod.imports.get(head, head) == "threading"
+
+    def _record_spawn(self, rel: str, ctx: FileContext, mod: ModuleInfo,
+                      by_node: Dict[int, ClassInfo], call: ast.Call) -> None:
+        parents = ctx.parents
+        names: List[str] = []
+        in_loop = False
+        fn_node: Optional[ast.AST] = None
+        owner_ci: Optional[ClassInfo] = None
+        anc = parents.get(id(call))
+        while anc is not None:
+            if isinstance(anc, (ast.For, ast.While)) and fn_node is None:
+                in_loop = True
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if fn_node is None:
+                    fn_node = anc
+                names.append(anc.name)
+            elif isinstance(anc, ast.ClassDef):
+                if owner_ci is None:
+                    owner_ci = by_node.get(id(anc))
+                names.append(anc.name)
+            anc = parents.get(id(anc))
+        names.reverse()
+        owner_qual = mod.name + ("." + ".".join(names) if names else "")
+        owner_method = fn_node.name if fn_node is not None else ""
+
+        target = None
+        daemon = False
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "daemon":
+                daemon = isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True
+
+        thread_attr = thread_list = local = None
+        p = parents.get(id(call))
+        if isinstance(p, ast.Assign) and len(p.targets) == 1:
+            t = p.targets[0]
+            a = _self_attr(t)
+            if a is not None:
+                thread_attr = a
+            elif isinstance(t, ast.Name):
+                local = t.id
+        elif isinstance(p, ast.Call) and isinstance(p.func, ast.Attribute) \
+                and p.func.attr == "append":
+            thread_list = _self_attr(p.func.value)
+        if local is not None and fn_node is not None:
+            for n in ast.walk(fn_node):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "append" and n.args \
+                        and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id == local:
+                    a = _self_attr(n.func.value)
+                    if a is not None:
+                        thread_list = a
+                        break
+
+        entries, target_text = self._resolve_target(
+            mod, owner_ci, fn_node, owner_qual, target)
+        rel_mod = mod.name[len(PKG) + 1:] \
+            if mod.name.startswith(PKG + ".") else mod.name
+        leaf = target_text.rpartition(".")[2] or "thread"
+        name = f"{leaf}@{rel_mod}:{call.lineno}"
+        self.roles[name] = ThreadRole(
+            name=name, spawn_path=rel, spawn_line=call.lineno,
+            entries=tuple(sorted(entries)), target=target_text,
+            daemon=daemon, multi=in_loop or owner_method == "__init__",
+            domain=_domain_of(mod.name), owner_qual=owner_qual,
+            owner_class=owner_ci.qual if owner_ci is not None else None,
+            owner_method=owner_method, thread_attr=thread_attr,
+            thread_list_attr=thread_list)
+        self._spawns.append({"path": rel, "line": call.lineno})
+
+    def _resolve_target(self, mod: ModuleInfo, owner_ci: Optional[ClassInfo],
+                        fn_node: Optional[ast.AST], owner_qual: str,
+                        target: Optional[ast.expr]) -> Tuple[List[str], str]:
+        """(entry summary quals, raw target text) for a spawn's target."""
+        if target is None:
+            return [], "<no-target>"
+        text = _dotted(target) or "<dynamic>"
+        attr = _self_attr(target)
+        if attr is not None and owner_ci is not None:
+            hits = self.resolver.callee_summaries(mod, owner_ci,
+                                                  ("self", attr))
+            return [s.qual for _m, _c, s in hits], text
+        if isinstance(target, ast.Name):
+            # A nested pump body defined in the spawning function (or an
+            # enclosing one) is a deferred execution context the project
+            # summaries deliberately exclude; synthesize its summary here
+            # so the role still gets a closure.
+            if fn_node is not None:
+                for n in ast.walk(fn_node):
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))\
+                            and n is not fn_node and n.name == target.id:
+                        qual = f"{owner_qual}.{n.name}"
+                        if qual not in self._summaries:
+                            locks: Set[str] = set()
+                            if owner_ci is not None:
+                                for c in self.pc.mro_classes(owner_ci):
+                                    locks |= set(c.lock_attrs)
+                            s = MethodSummary(qual=qual, node=n)
+                            _BodyWalker(s, locks,
+                                        set(mod.module_locks)).walk(n, [])
+                            self._summaries[qual] = (mod, owner_ci, s)
+                        return [qual], text
+            hits = self.resolver.callee_summaries(mod, owner_ci,
+                                                  ("name", target.id))
+            return [s.qual for _m, _c, s in hits], text
+        if isinstance(target, ast.Attribute):
+            recv = target.value
+            leaf = recv.id if isinstance(recv, ast.Name) else (
+                _self_attr(recv) or (recv.attr
+                                     if isinstance(recv, ast.Attribute)
+                                     else None))
+            if leaf is not None:
+                hits = self.resolver.callee_summaries(
+                    mod, owner_ci, ("attr", leaf, target.attr))
+                return [s.qual for _m, _c, s in hits], text
+        return [], text
+
+    def _add_main_role(self) -> None:
+        """The main thread, rooted at the operator ``cmd`` entry point."""
+        entries: List[str] = []
+        path, line = "", 0
+        for mod in self.pc.modules.values():
+            if "cmd" not in mod.name.split("."):
+                continue
+            s = mod.fn_summaries.get("main")
+            if s is not None:
+                entries.append(s.qual)
+                if mod.ctx is not None and not path:
+                    path = mod.ctx.path
+                    line = getattr(s.node, "lineno", 0)
+        self.roles["main"] = ThreadRole(
+            name="main", kind="main", spawn_path=path, spawn_line=line,
+            entries=tuple(sorted(entries)), target="<main>", domain="shared")
+
+    def _closure(self, entries: Tuple[str, ...]) -> FrozenSet[str]:
+        key = tuple(sorted(entries))
+        got = self._closure_memo.get(key)
+        if got is not None:
+            return got
+        seen: Set[str] = set(entries)
+        stack = [q for q in entries if q in self._summaries]
+        while stack:
+            rec = self._summaries.get(stack.pop())
+            if rec is None:
+                continue
+            mod, cls, s = rec
+            for call in {c[:-1] for c in s.calls}:
+                for _m, _c, s2 in self.resolver.callee_summaries(
+                        mod, cls, call):
+                    if s2.qual not in seen:
+                        seen.add(s2.qual)
+                        stack.append(s2.qual)
+        got = frozenset(seen)
+        self._closure_memo[key] = got
+        return got
+
+    def _refine_multi(self) -> None:
+        """Mark roles whose owning object is constructed more than once
+        (or by an already-multiple role) as multi-instance."""
+        interesting: Dict[str, List[str]] = {}   # ctor leaf -> role names
+        for name, role in self.roles.items():
+            if role.multi or role.owner_class is None:
+                continue
+            ci = self.pc.classes.get(role.owner_class)
+            if ci is None:
+                continue
+            for c in self.resolver.composites(ci):
+                interesting.setdefault(c.name, []).append(name)
+        if not interesting:
+            return
+        sites: Dict[str, List[Tuple[bool, str]]] = {}  # role -> (in_loop, qual)
+        for rel, ctx in self.pc.files.items():
+            if ctx.tree is None or is_test_path(rel):
+                continue
+            parents = ctx.parents
+            for call in ctx.by_type(ast.Call):
+                d = _dotted(call.func)
+                if d is None:
+                    continue
+                roles = interesting.get(d.rpartition(".")[2])
+                if not roles:
+                    continue
+                in_loop = False
+                names: List[str] = []
+                anc = parents.get(id(call))
+                fn_seen = False
+                while anc is not None:
+                    if isinstance(anc, (ast.For, ast.While)) and not fn_seen:
+                        in_loop = True
+                    elif isinstance(anc, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        fn_seen = True
+                        names.append(anc.name)
+                    elif isinstance(anc, ast.ClassDef):
+                        names.append(anc.name)
+                    anc = parents.get(id(anc))
+                names.reverse()
+                mod = self.pc.module_of_path(rel)
+                qual = (mod.name + ("." + ".".join(names) if names else "")
+                        if mod is not None else "")
+                for rname in roles:
+                    sites.setdefault(rname, []).append((in_loop, qual))
+        for _round in range(2):   # one propagation hop: worker-made makers
+            changed = False
+            for rname, recs in sites.items():
+                role = self.roles[rname]
+                if role.multi:
+                    continue
+                multi = len(recs) >= 2 or any(in_loop for in_loop, _q in recs)
+                if not multi:
+                    for _in_loop, qual in recs:
+                        q = self._norm_qual(qual)
+                        for other in self.roles.values():
+                            if other.multi and q in other.closure:
+                                multi = True
+                                break
+                        if multi:
+                            break
+                if multi:
+                    role.multi = True
+                    changed = True
+            if not changed:
+                break
+
+    # -- queries -------------------------------------------------------------
+
+    def mhp(self, a: str, b: str) -> bool:
+        """May roles ``a`` and ``b`` run in parallel?"""
+        ra, rb = self.roles.get(a), self.roles.get(b)
+        if ra is None or rb is None:
+            return False
+        if a == b:
+            return ra.multi
+        if ra.domain == rb.domain:
+            return True
+        return "shared" in (ra.domain, rb.domain)
+
+    def _norm_qual(self, qual: str) -> str:
+        """Strip nested-def components until a known summary qual."""
+        q = qual
+        while q and q not in self._summaries:
+            head, _, _leaf = q.rpartition(".")
+            if not head:
+                return qual
+            q = head
+        return q or qual
+
+    def roles_of(self, qual: str) -> FrozenSet[str]:
+        """Role names whose closure contains (the summary owning) ``qual``."""
+        if not qual:
+            return frozenset()
+        got = self._qual_roles.get(qual)
+        if got is None:
+            got = self._qual_roles.get(self._norm_qual(qual), set())
+        return frozenset(got)
+
+    def owner_qual(self, path: str, line: int) -> str:
+        """Qual of the innermost function containing ``path:line``
+        ('' for module level)."""
+        spans = self._fn_spans.get(path)
+        if spans is None:
+            spans = []
+            ctx = self.pc.files.get(path)
+            if ctx is not None and ctx.tree is not None:
+                mod = self.pc.module_of_path(path)
+                base = mod.name if mod is not None else ""
+                parents = ctx.parents
+                for kind in (ast.FunctionDef, ast.AsyncFunctionDef):
+                    for fn in ctx.by_type(kind):
+                        names = [fn.name]
+                        anc = parents.get(id(fn))
+                        while anc is not None:
+                            if isinstance(anc, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef,
+                                                ast.ClassDef)):
+                                names.append(anc.name)
+                            anc = parents.get(id(anc))
+                        names.reverse()
+                        qual = (base + "." if base else "") + ".".join(names)
+                        spans.append((fn.lineno, fn.end_lineno or fn.lineno,
+                                      qual))
+            self._fn_spans[path] = spans
+        best, best_start = "", -1
+        for start, end, qual in spans:
+            if start <= line <= end and start > best_start:
+                best, best_start = qual, start
+        return best
+
+    def roles_at(self, path: str, line: int) -> FrozenSet[str]:
+        return self.roles_of(self.owner_qual(path, line))
+
+    def lock_set(self, path: str, line: int) -> FrozenSet[str]:
+        """Lock ids lexically held at ``path:line`` (``with`` regions,
+        mixin-aware).  Built lazily per file."""
+        regions = self._lock_regions.get(path)
+        if regions is None:
+            regions = self._build_regions(path)
+            self._lock_regions[path] = regions
+        return frozenset(lid for start, end, lid in regions
+                         if start <= line <= end)
+
+    def _build_regions(self, path: str) -> List[Tuple[int, int, str]]:
+        out: List[Tuple[int, int, str]] = []
+        ctx = self.pc.files.get(path)
+        if ctx is None or ctx.tree is None:
+            return out
+        mod = self.pc.module_of_path(path)
+        if mod is None:
+            return out
+        by_node = {id(ci.node): ci for ci in mod.classes.values()}
+        parents = ctx.parents
+        for kind in (ast.With, ast.AsyncWith):
+            for w in ctx.by_type(kind):
+                names: List[str] = []
+                for item in w.items:
+                    expr = item.context_expr
+                    attr = _self_attr(expr)
+                    if attr is not None:
+                        names.append(attr)
+                    elif isinstance(expr, ast.Name) \
+                            and expr.id in mod.module_locks:
+                        names.append(expr.id)
+                if not names or not w.body:
+                    continue
+                owner = None
+                anc = parents.get(id(w))
+                while anc is not None:
+                    if isinstance(anc, ast.ClassDef):
+                        owner = by_node.get(id(anc))
+                        break
+                    anc = parents.get(id(anc))
+                for name in names:
+                    hit = self.resolver.lock_id(mod, owner, name)
+                    if hit is not None:
+                        out.append((w.body[0].lineno,
+                                    w.end_lineno or w.lineno, hit[0]))
+        return out
+
+    def role_lock_ids(self, role_name: str) -> FrozenSet[str]:
+        """Every lock id a role's closure may acquire."""
+        got = self._role_locks.get(role_name)
+        if got is None:
+            acc: Set[str] = set()
+            role = self.roles.get(role_name)
+            for q in (role.closure if role is not None else ()):
+                rec = self._summaries.get(q)
+                if rec is None:
+                    continue
+                mod, cls, s = rec
+                for name in s.acquires:
+                    hit = self.resolver.lock_id(mod, cls, name)
+                    if hit is not None:
+                        acc.add(hit[0])
+            got = frozenset(acc)
+            self._role_locks[role_name] = got
+        return got
+
+    def stop_summaries(self, class_qual: str) \
+            -> List[Tuple[str, MethodSummary]]:
+        """(defining file path, summary) for every stop-path method of a
+        class (across composites)."""
+        ci = self.pc.classes.get(class_qual)
+        if ci is None:
+            return []
+        out: List[Tuple[str, MethodSummary]] = []
+        seen: Set[str] = set()
+        for k in self.resolver.composites(ci):
+            for c in self.pc.mro_classes(k):
+                for name in STOP_METHOD_NAMES:
+                    s = c.summaries.get(name)
+                    if s is not None and s.qual not in seen:
+                        seen.add(s.qual)
+                        owner_mod = self.pc.modules.get(c.module)
+                        path = owner_mod.ctx.path \
+                            if owner_mod is not None \
+                            and owner_mod.ctx is not None else ""
+                        out.append((path, s))
+        return out
+
+    def has_stop_path(self, class_qual: Optional[str]) -> bool:
+        return bool(class_qual and self.stop_summaries(class_qual))
+
+    def condition_kind(self, path: str, node: ast.AST,
+                       receiver: ast.expr) -> Optional[str]:
+        """'Condition'/'Lock'/'RLock' when ``receiver`` names a lock-
+        factory attribute or module lock, 'Event' for an Event attr,
+        else None."""
+        mod = self.pc.module_of_path(path)
+        if mod is None:
+            return None
+        attr = _self_attr(receiver)
+        if attr is None:
+            if isinstance(receiver, ast.Name):
+                return mod.module_locks.get(receiver.id)
+            return None
+        ctx = self.pc.files.get(path)
+        owner = None
+        if ctx is not None:
+            by_node = {id(ci.node): ci for ci in mod.classes.values()}
+            anc = ctx.parents.get(id(node))
+            while anc is not None:
+                if isinstance(anc, ast.ClassDef):
+                    owner = by_node.get(id(anc))
+                    break
+                anc = ctx.parents.get(id(anc))
+        if owner is None:
+            return None
+        for k in [owner] + self.resolver.composites(owner):
+            for c in self.pc.mro_classes(k):
+                kind = c.lock_attrs.get(attr)
+                if kind is not None:
+                    return kind
+                if attr in self.event_attrs.get(c.qual, ()):
+                    return "Event"
+        return None
+
+    # -- shared instance attributes ------------------------------------------
+
+    def attr_accesses(self) -> Dict[Tuple[str, str], List[Access]]:
+        """(defining class qual, attr) -> accesses, for container attrs of
+        classes whose methods span >= 2 roles.  Computed lazily (only the
+        race passes pay for it)."""
+        if self._attr_accesses is not None:
+            return self._attr_accesses
+        acc: Dict[Tuple[str, str], List[Access]] = {}
+        attr_names = set()
+        for attrs in self.container_attrs.values():
+            attr_names.update(attrs)
+        if not attr_names:
+            self._attr_accesses = acc
+            return acc
+        for rel, ctx in self.pc.files.items():
+            if ctx.tree is None or is_test_path(rel):
+                continue
+            mod = self.pc.module_of_path(rel)
+            if mod is None or not mod.classes:
+                continue
+            self._collect_attr_file(rel, ctx, mod, attr_names, acc)
+        self._attr_accesses = acc
+        return acc
+
+    def _defining_class(self, owner: ClassInfo, attr: str) -> Optional[str]:
+        """Class qual whose code creates container ``attr``, looked up
+        through the full composite (a mixin method's ``self`` is really
+        the composing class, whose ``__init__`` may own the attribute)."""
+        for k in self.resolver.composites(owner):
+            for c in self.pc.mro_classes(k):
+                if attr in self.container_attrs.get(c.qual, ()):
+                    return c.qual
+        return None
+
+    def _collect_attr_file(self, rel: str, ctx: FileContext, mod: ModuleInfo,
+                           attr_names: Set[str],
+                           acc: Dict[Tuple[str, str], List[Access]]) -> None:
+        by_node = {id(ci.node): ci for ci in mod.classes.values()}
+        parents = ctx.parents
+
+        def note(node: ast.AST, target: ast.expr, via: str,
+                 write: bool) -> None:
+            attr = _self_attr(target)
+            if attr is None or attr not in attr_names:
+                return
+            names: List[str] = []
+            owner = None
+            anc = parents.get(id(node))
+            while anc is not None:
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    names.append(anc.name)
+                elif isinstance(anc, ast.ClassDef):
+                    if owner is None:
+                        owner = by_node.get(id(anc))
+                    names.append(anc.name)
+                anc = parents.get(id(anc))
+            if owner is None or not names:
+                return
+            if names[0] in ("__init__", "__new__"):
+                return   # construction happens-before any spawn
+            defining = self._defining_class(owner, attr)
+            if defining is None:
+                return
+            names.reverse()
+            qual = f"{mod.name}." + ".".join(names)
+            acc.setdefault((defining, attr), []).append(
+                Access(path=rel, line=node.lineno, via=via, write=write,
+                       qual=qual))
+
+        for call in ctx.by_type(ast.Call):
+            fn = call.func
+            if isinstance(fn, ast.Attribute):
+                note(call, fn.value, f"{fn.attr}()",
+                     not is_read_method(fn.attr))
+            elif isinstance(fn, ast.Name) and fn.id == "next" and call.args:
+                note(call, call.args[0], "next()", True)
+        for node in ctx.by_type(ast.Assign):
+            for t in node.targets:
+                if not isinstance(t, (ast.Subscript, ast.Attribute)):
+                    continue
+                if _self_attr(t) is not None:
+                    note(node, t, "rebind", True)
+                else:
+                    note(node, t.value, "store", True)
+        for node in ctx.by_type(ast.AugAssign):
+            t = node.target
+            if isinstance(t, (ast.Subscript, ast.Attribute)):
+                if _self_attr(t) is not None:
+                    note(node, t, "augmented store", True)
+                else:
+                    note(node, t.value, "augmented store", True)
+        for node in ctx.by_type(ast.Delete):
+            for t in node.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)) \
+                        and _self_attr(t) is None:
+                    note(node, t.value, "delete", True)
+        for node in ctx.by_type(ast.Subscript):
+            if isinstance(node.ctx, ast.Load):
+                note(node, node.value, "subscript", False)
+        for node in ctx.by_type(ast.For):
+            note(node, node.iter, "iterate", False)
+
+    # -- report --------------------------------------------------------------
+
+    def describe(self) -> dict:
+        """Roles + MHP matrix for the thread_model.json report."""
+        names = sorted(self.roles)
+        roles = []
+        for n in names:
+            r = self.roles[n]
+            roles.append({
+                "name": n,
+                "kind": r.kind,
+                "spawn": {"path": r.spawn_path, "line": r.spawn_line},
+                "target": r.target,
+                "entries": sorted(r.entries),
+                "daemon": r.daemon,
+                "multi": r.multi,
+                "domain": r.domain,
+                "owner": r.owner_qual or None,
+                "owner_class": r.owner_class,
+                "thread_attr": r.thread_attr or r.thread_list_attr,
+                "closure_size": len(r.closure),
+                "closure": sorted(r.closure),
+            })
+        mhp = {a: sorted(b for b in names if self.mhp(a, b)) for a in names}
+        return {"roles": roles, "mhp": mhp}
+
+
+def model(pc: ProjectContext) -> ThreadModel:
+    """The memoized per-ProjectContext concurrency model."""
+    got = getattr(pc, "_thread_model", None)
+    if got is None:
+        global BUILD_COUNT
+        BUILD_COUNT += 1
+        got = ThreadModel(pc)
+        got._build()
+        pc._thread_model = got
+    return got

@@ -606,9 +606,10 @@ SHARD_STATE_REGISTRY = {
     "obs.trace._THREAD_SPANS": SHARD_STATE_LOCAL,
     "obs.telemetry._published": SHARD_STATE_LOCK_GUARDED,
     "runtime.localproc._port_cursor": SHARD_STATE_LOCK_GUARDED,
-    # The event sequence counter total-orders events across every job in
-    # the process; per-shard counters would interleave ambiguously in a
-    # merged stream.  ROADMAP item 3's first refactor target: replace
-    # with (shard_id, seq) pairs or a per-job counter.
-    "utils.events._seq": SHARD_STATE_HOSTILE,
+    # The event sequencer total-orders events per shard: lock-guarded
+    # (epoch, shard, seq) keys, so a sharded fleet's merged stream sorts
+    # without cross-shard coordination.  Retired the registry's last
+    # shard_hostile entry (a bare itertools.count): ROADMAP item 3's
+    # first refactor target, closed by the EventSeq API.
+    "utils.events.EVENT_SEQ": SHARD_STATE_LOCK_GUARDED,
 }

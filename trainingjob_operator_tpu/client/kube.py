@@ -264,6 +264,10 @@ class Reflector:
 
     def stop(self) -> None:
         self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=2.0)
+            self._thread = None
 
 
 #: Kinds the controller consumes through informers/listers.

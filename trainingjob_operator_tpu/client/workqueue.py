@@ -222,6 +222,9 @@ class RateLimitingQueue:
             self._waiting.clear()
             self._waiting_deadlines.clear()
             self._cond.notify_all()
+        # Join outside the condition: the pump re-acquires it to observe
+        # _shutdown, so joining under the lock would deadlock shutdown.
+        self._pump.join(timeout=2.0)
 
     def _pump_waiting(self) -> None:
         while True:

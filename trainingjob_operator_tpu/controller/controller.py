@@ -13,7 +13,7 @@ import logging
 import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from trainingjob_operator_tpu.api import constants
 from trainingjob_operator_tpu.api.defaults import set_defaults
@@ -138,6 +138,20 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         # lister relists per scrape/tick.
         self._job_keys: Set[str] = set()
         self._job_keys_lock = threading.Lock()
+        # Per-job reconcile memory consumed by the PodReconciler mixin.
+        # Created here rather than lazily at first use so construction
+        # happens-before the worker pool: two workers lazily installing
+        # the same table would each get their own dict and silently drop
+        # the other's entries.  Every key derives from the job (uid or
+        # namespace/name) and the workqueue serializes a given job onto
+        # one worker at a time, so per-key access needs no extra lock.
+        self._gang_release_backoff: Dict[str, Tuple[float, int]] = {}
+        self._crashloop: Dict[str, dict] = {}
+        self._exited_reported: Dict[str, bool] = {}
+        self._waiting_errors: Dict[str, float] = {}
+        self._flap_episodes: Dict[str, dict] = {}
+        self._flap_first_seen: Dict[str, float] = {}
+        self._flap_pending: Dict[str, Tuple[float, float]] = {}
 
         # Handler registration (reference: controller.go:118-156).
         job_informer.add_event_handler(
@@ -327,6 +341,9 @@ class TrainingJobController(PodReconciler, ServiceReconciler, StatusManager):
         self.work_queue.shut_down()
         for th in self._workers:
             th.join(timeout=2)
+        if self._resync_thread is not None:
+            self._resync_thread.join(timeout=2)
+            self._resync_thread = None
 
     def _incident_event_tap(self, obj: Any, reason: str,
                             message: str) -> None:

@@ -891,6 +891,10 @@ class TelemetrySink:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        th = self._thread
+        if th is not None:
+            th.join(timeout=2.0)
+            self._thread = None
 
 
 # -- emitter (workload side) --------------------------------------------------

@@ -8,16 +8,59 @@ class objects through the clientset, so tests and the CLI can assert on them.
 
 from __future__ import annotations
 
-import itertools
 import logging
+import threading
 from collections import deque
-from typing import Any
+from typing import Any, Tuple
 
 from trainingjob_operator_tpu.core.objects import Event, ObjectMeta, new_uid, now
 
 log = logging.getLogger("trainingjob.events")
 
-_seq = itertools.count()
+
+class EventSeq:
+    """Process-wide event sequencer: lock-guarded ``(epoch, shard, seq)``.
+
+    Replaces the bare ``itertools.count()`` module global -- the
+    registry's last ``shard_hostile`` entry.  The tuple key is unique and
+    totally ordered: ``epoch`` distinguishes operator incarnations
+    (default 0; a deployment that persists events across restarts passes
+    its restart counter -- wall clock would break same-seed digest
+    determinism), ``shard`` distinguishes shards in a sharded
+    deployment, ``seq`` is the in-process counter, all advanced and read
+    under one lock.  ``next_suffix()`` renders the key fixed-width so
+    lexicographic name order equals allocation order in listings.
+    """
+
+    def __init__(self, epoch: int = 0, shard: int = 0):
+        self._lock = threading.Lock()
+        self._epoch = int(epoch)
+        self._shard = int(shard)
+        self._seq = 0
+
+    def configure(self, *, epoch: "int | None" = None,
+                  shard: "int | None" = None) -> None:
+        """Set the incarnation/shard coordinates (sharded deployments
+        call this once at startup, before recording)."""
+        with self._lock:
+            if epoch is not None:
+                self._epoch = int(epoch)
+            if shard is not None:
+                self._shard = int(shard)
+
+    def next_key(self) -> Tuple[int, int, int]:
+        with self._lock:
+            key = (self._epoch, self._shard, self._seq)
+            self._seq += 1
+            return key
+
+    def next_suffix(self) -> str:
+        epoch, shard, seq = self.next_key()
+        return f"{epoch:03d}-{shard:02d}-{seq:06d}"
+
+
+#: Module singleton (SHARD_STATE_REGISTRY: lock_guarded_shared).
+EVENT_SEQ = EventSeq()
 
 
 class EventRecorder:
@@ -32,6 +75,10 @@ class EventRecorder:
         self._cs = clientset
         self._component = component
         self._created: "deque[tuple[str, str]]" = deque()
+        # Guards the retention ledger: every controller worker records
+        # through one shared recorder, and the len-check/popleft prune is
+        # a check-then-act sequence.
+        self._created_lock = threading.Lock()
 
     def set_sink(self, sink: Any) -> None:
         """``sink(obj, reason, message)`` observes every recorded event
@@ -55,8 +102,9 @@ class EventRecorder:
                 # Unique across operator restarts: on a persistent backend a
                 # process-local counter would collide with a previous run's
                 # events (409) and drop them; the uid suffix never collides,
-                # the counter keeps same-moment events ordered in listings.
-                name=f"{meta.name}.{next(_seq):06d}.{new_uid()[:8]}",
+                # the (epoch, shard, seq) suffix keeps same-moment events
+                # ordered in listings and distinct across shards.
+                name=f"{meta.name}.{EVENT_SEQ.next_suffix()}.{new_uid()[:8]}",
                 namespace=meta.namespace or "default",
             ),
             involved_kind=obj.KIND,
@@ -72,9 +120,12 @@ class EventRecorder:
                 "%s %s %s/%s: %s", etype, reason, meta.namespace, meta.name, message)
         try:
             self._cs.events.create(ev)
-            self._created.append((ev.namespace, ev.name))
-            while len(self._created) > self.MAX_EVENTS:
-                old_ns, old_name = self._created.popleft()
+            with self._created_lock:
+                self._created.append((ev.namespace, ev.name))
+                expired = []
+                while len(self._created) > self.MAX_EVENTS:
+                    expired.append(self._created.popleft())
+            for old_ns, old_name in expired:
                 try:
                     self._cs.events.delete(old_ns, old_name)
                 except KeyError:

@@ -246,6 +246,11 @@ class KubeLeaderElector:
 
     def stop(self) -> None:
         self._stop.set()
+        th = self._renewer
+        # The renew loop itself may end up here via on_lost: never
+        # self-join.
+        if th is not None and th is not threading.current_thread():
+            th.join(timeout=2.0)
 
 
 def _micro_ts(ts: float) -> str:
